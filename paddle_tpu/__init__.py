@@ -1,0 +1,71 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's capabilities.
+
+Public surface mirrors `import paddle` (reference: python/paddle/__init__.py):
+tensors + eager autograd, nn, optimizer, io, amp, jit, distributed, vision.
+The execution substrate is JAX/XLA on TPU: eager ops dispatch tiny cached XLA
+executables; `jit.to_static` captures whole graphs; distributed parallelism
+rides `jax.sharding.Mesh` + shard_map collectives over ICI/DCN.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# Full dtype surface (int64/float64 parity with the reference); default float
+# stays float32 via paddle_tpu defaults — x64 only widens what users ask for.
+_jax.config.update("jax_enable_x64", True)
+
+# core
+from paddle_tpu.core.dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+)
+from paddle_tpu.core.dtype import bool_ as bool  # noqa: F401
+from paddle_tpu.core.device import (  # noqa: F401
+    CPUPlace, TPUPlace, device_count, get_device, is_compiled_with_tpu,
+    set_device, synchronize,
+)
+from paddle_tpu.core.flags import get_flags, set_flags  # noqa: F401
+from paddle_tpu.core.tensor import Tensor, is_tensor, to_tensor  # noqa: F401
+from paddle_tpu.autograd.tape import enable_grad, no_grad, set_grad_enabled  # noqa: F401
+
+# ops (also installs Tensor methods)
+from paddle_tpu.ops import *  # noqa: F401,F403
+from paddle_tpu.ops import seed  # noqa: F401
+
+# subpackages (imported lazily-ish but exposed as attributes)
+from paddle_tpu import amp  # noqa: F401
+from paddle_tpu import autograd  # noqa: F401
+from paddle_tpu import device  # noqa: F401
+from paddle_tpu import distributed  # noqa: F401
+from paddle_tpu import framework  # noqa: F401
+from paddle_tpu import io  # noqa: F401
+from paddle_tpu import jit  # noqa: F401
+from paddle_tpu import metric  # noqa: F401
+from paddle_tpu import nn  # noqa: F401
+from paddle_tpu import optimizer  # noqa: F401
+from paddle_tpu import profiler  # noqa: F401
+from paddle_tpu import static  # noqa: F401
+from paddle_tpu import utils  # noqa: F401
+from paddle_tpu import vision  # noqa: F401
+
+from paddle_tpu.framework.io_ import load, save  # noqa: F401
+from paddle_tpu.nn.initializer import ParamAttr  # noqa: F401
+
+__version__ = "0.1.0"
+
+grad = autograd.grad
+
+
+def disable_static(*a, **k):
+    """Eager mode is the default; kept for API parity."""
+
+
+def enable_static(*a, **k):
+    raise NotImplementedError(
+        "legacy static-graph Program mode is not supported; use paddle_tpu.jit.to_static "
+        "(whole-program XLA compilation) instead"
+    )
+
+
+def in_dynamic_mode():
+    return True

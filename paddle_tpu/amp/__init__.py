@@ -1,0 +1,220 @@
+"""Automatic mixed precision.
+
+Reference parity: paddle.amp — `auto_cast` (amp/auto_cast.py), `GradScaler`
+(amp/grad_scaler.py), O1/O2 white/black lists (amp/amp_lists.py), AMP branch in
+generated ad_funcs (eager_gen.py:565).
+
+TPU-native design: the low-precision dtype is **bfloat16** (MXU-native; no loss
+scaling required for typical models, but GradScaler is provided for parity and
+for float16). O1 autocasts whitelisted-op float inputs at the dispatch seam
+(core.tensor.apply_op consults `current_amp_state`); O2 casts parameters.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import tensor as _tensor_mod
+from paddle_tpu.core.dtype import convert_dtype, to_jax_dtype
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "is_bfloat16_supported",
+           "is_float16_supported", "white_list", "black_list"]
+
+# O1 lists (reference: amp/amp_lists.py WHITE_LIST/BLACK_LIST)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "einsum", "conv2d", "conv1d", "conv3d", "mv",
+    "linear", "flash_attention", "sdpa",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax_with_cross_entropy",
+    "cross_entropy", "mean", "sum", "softmax", "log_softmax", "norm", "var", "std",
+    "rsqrt", "sqrt", "divide", "pow", "erf", "erfinv", "cumsum",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def current_amp_state():
+    return _state
+
+
+def _amp_cast_hook(op_name: str, vals):
+    """Called from apply_op: cast float32 inputs of whitelisted ops to amp dtype."""
+    if not _state.enabled:
+        return vals
+    if _state.level == "O2":
+        # O2: cast everything float except blacklist
+        if op_name in BLACK_LIST or op_name in _state.custom_black:
+            target = jnp.float32
+        else:
+            target = _state.dtype
+    else:
+        if op_name in (_state.custom_white | (WHITE_LIST - _state.custom_black)):
+            target = _state.dtype
+        elif op_name in (BLACK_LIST | _state.custom_black):
+            target = jnp.float32
+        else:
+            return vals
+    out = []
+    for v in vals:
+        if hasattr(v, "dtype") and v.dtype in (np.float32, np.dtype(np.float32), jnp.bfloat16, np.float16) and v.dtype != target:
+            if jnp.issubdtype(v.dtype, np.floating):
+                v = v.astype(target)
+        out.append(v)
+    return tuple(out)
+
+
+# install the dispatch hook (the eager_gen.py:565 AMP-branch analog)
+_tensor_mod._amp_hook = _amp_cast_hook
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = to_jax_dtype(convert_dtype(dtype))
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white, _state.custom_black) = prev
+
+
+autocast = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None,
+             save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype, keeping fp32 master
+    weights in the optimizer (reference: amp_initialize, auto_cast.py:316)."""
+    d = to_jax_dtype(convert_dtype(dtype))
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    for m in model_list:
+        for p in m.parameters():
+            if jnp.issubdtype(p._value.dtype, np.floating):
+                p._set_value(p._value.astype(d))
+    if optimizers is not None:
+        opt_list = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        for o in opt_list:
+            if hasattr(o, "_use_master_weights"):
+                o._use_master_weights = True
+        if not isinstance(optimizers, (list, tuple)):
+            return models, optimizers
+        return models, optimizers
+    return models if isinstance(models, (list, tuple)) else model_list[0]
+
+
+def is_bfloat16_supported(place=None):
+    return True
+
+
+def is_float16_supported(place=None):
+    return True
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: amp/grad_scaler.py). With bfloat16 this
+    is effectively identity (init scale 1 recommended), but float16 training
+    uses the full dynamic-scale state machine."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list():
+            if p.grad is not None:
+                g = p.grad._value * inv
+                finite = bool(jnp.isfinite(g).all())
+                found = found or not finite
+                p.grad._set_value(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        from paddle_tpu.core.tensor import to_tensor
+
+        return to_tensor(self._scale)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state["good_steps"]
+        self._bad_steps = state["bad_steps"]

@@ -1,0 +1,97 @@
+"""User autograd API (reference: python/paddle/autograd — backward, PyLayer,
+jacobian/hessian at autograd/autograd.py:450,544)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd.tape import (  # noqa: F401
+    GradNode,
+    backward,
+    enable_grad,
+    grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from paddle_tpu.autograd.py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+__all__ = [
+    "backward", "no_grad", "enable_grad", "set_grad_enabled", "grad",
+    "jacobian", "hessian", "PyLayer", "PyLayerContext",
+]
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False):
+    """`paddle.grad` analog: returns grads of `outputs` wrt `inputs` without
+    polluting `.grad` on other leaves (reference: eager Grad backward.cc:464)."""
+    from paddle_tpu.core.tensor import Tensor
+
+    single_in = isinstance(inputs, Tensor)
+    outs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    ins = [inputs] if single_in else list(inputs)
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.incubate.functional.grad_fn (jax.grad "
+            "composition) for higher-order derivatives"
+        )
+
+    # snapshot + clear .grad, run tape, read, restore
+    saved = [(t, t.grad) for t in ins]
+    for t in ins:
+        t.grad = None
+    retain = bool(retain_graph) if retain_graph is not None else False
+    backward(outs, grad_outputs, retain_graph=retain)
+    grads = []
+    for t in ins:
+        if t.grad is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the inputs received no gradient; pass allow_unused=True "
+                    "to get None instead"
+                )
+            grads.append(None)
+        else:
+            grads.append(t.grad)
+    for t, g in saved:
+        t.grad = g
+    return grads[0] if single_in else grads
+
+
+def _functionalize(func, xs):
+    vals = [x._value for x in xs]
+
+    def f(*arrs):
+        from paddle_tpu.core.tensor import Tensor
+
+        outs = func(*[Tensor(a, stop_gradient=False) for a in arrs])
+        return outs._value if isinstance(outs, Tensor) else outs
+
+    return f, vals
+
+
+def jacobian(func_or_ys, xs, batch_axis=None):
+    """Dense jacobian via jax.jacrev over the functionalized op graph."""
+    from paddle_tpu.core.tensor import Tensor
+
+    if callable(func_or_ys):
+        single = isinstance(xs, Tensor)
+        xs_l = [xs] if single else list(xs)
+        f, vals = _functionalize(func_or_ys, xs_l)
+        jac = jax.jacrev(f, argnums=tuple(range(len(vals))))(*vals)
+        if single:
+            return Tensor(jac[0])
+        return [Tensor(j) for j in jac]
+    raise NotImplementedError("jacobian over a recorded tape requires callable form")
+
+
+def hessian(func, xs, batch_axis=None):
+    from paddle_tpu.core.tensor import Tensor
+
+    single = isinstance(xs, Tensor)
+    xs_l = [xs] if single else list(xs)
+    f, vals = _functionalize(func, xs_l)
+    hess = jax.hessian(f, argnums=tuple(range(len(vals))))(*vals)
+    if single:
+        return Tensor(hess[0][0])
+    return [[Tensor(h) for h in row] for row in hess]

@@ -1,0 +1,94 @@
+"""PyLayer: user-defined custom autograd ops.
+
+Reference parity: `paddle.autograd.PyLayer` (python/paddle/autograd/py_layer.py).
+The forward runs eagerly on device buffers; the user backward is spliced into the
+tape as a GradNode whose vjp calls the Python `backward` staticmethod.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import tape as _tape
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+def _tensor_cls():
+    from paddle_tpu.core.tensor import Tensor
+
+    return Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with @staticmethod forward(ctx, *args) and backward(ctx, *grads)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        Tensor = _tensor_cls()
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        record = _tape.grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+
+        with _tape.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        outs_list = list(outs) if multi else [outs]
+        out_tensors = [o if isinstance(o, Tensor) else Tensor(jnp.asarray(o)) for o in outs_list]
+
+        if record:
+            templates = [(t._value.shape, t._value.dtype) for t in out_tensors]
+
+            def vjp_fn(ct):
+                cts = ct if isinstance(ct, tuple) else (ct,)
+                ct_tensors = [Tensor(c) for c in cts]
+                with _tape.no_grad():
+                    gin = cls.backward(ctx, *ct_tensors)
+                if not isinstance(gin, (tuple, list)):
+                    gin = (gin,)
+                out = []
+                gi = iter(gin)
+                for a in args:
+                    if isinstance(a, Tensor):
+                        g = next(gi, None)
+                        out.append(None if g is None else (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+                return out
+
+            node = _tape.GradNode(vjp_fn, tensor_inputs, templates, name=cls.__name__)
+            for i, t in enumerate(out_tensors):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._output_index = i
+        return tuple(out_tensors) if multi else out_tensors[0]
+
+
+# torch-style alias used by some reference code paths
+PyLayer.forward.__isabstractmethod__ = False

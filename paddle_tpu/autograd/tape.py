@@ -1,0 +1,244 @@
+"""Define-by-run autograd engine.
+
+Reference parity: the eager autograd layer in paddle/fluid/eager —
+`AutogradMeta` (autograd_meta.h:61), `GradNodeBase` (grad_node_info.h:197),
+`GradTensorHolder` (grad_tensor_holder.h) and the engine `RunBackward`
+(backward.cc:105) / `Backward` (backward.cc:439).
+
+TPU-native design: instead of per-op hand-written GradNodes produced by codegen,
+every eager op records ONE `GradNode` holding the `jax.vjp` linearization of its
+(pure, jax-traceable) forward function. Residuals live inside the vjp closure as
+device buffers (the analog of `TensorWrapper` saved tensors, eager/tensor_wrapper.h).
+`backward()` runs the nodes in reverse topological order and feeds cotangents
+through the stored vjp functions — all compute stays on-device, dispatched async
+by XLA.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "GradNode",
+    "backward",
+    "grad_enabled",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class _NoGrad(contextlib.ContextDecorator):
+    """`paddle.no_grad` analog — usable as context manager and decorator."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class _EnableGrad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+def no_grad():
+    return _NoGrad()
+
+
+def enable_grad():
+    return _EnableGrad()
+
+
+def _is_float0(x) -> bool:
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+class GradNode:
+    """One recorded op in the tape.
+
+    Holds the vjp function over all tensor inputs, strong refs to the input
+    Tensors (for graph connectivity + leaf accumulation), and output templates
+    (shape/dtype) used to materialize zero cotangents for unused outputs.
+    """
+
+    __slots__ = (
+        "vjp_fn",
+        "inputs",
+        "out_templates",
+        "name",
+        "hooks",
+        "released",
+        "__weakref__",
+    )
+
+    def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], out_templates, name: str = "op"):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.out_templates = out_templates  # list[(shape, jax_dtype)]
+        self.name = name
+        self.hooks = None
+        self.released = False
+
+    @property
+    def n_outputs(self):
+        return len(self.out_templates)
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = []
+        self.released = True
+
+    def apply(self, cotangents: list):
+        if self.released:
+            raise RuntimeError(
+                f"grad node '{self.name}' was already released; call backward with "
+                "retain_graph=True to backprop through the same graph twice"
+            )
+        full = []
+        for ct, (shape, dtype) in zip(cotangents, self.out_templates):
+            if ct is None:
+                ct = jnp.zeros(shape, dtype)
+            full.append(ct)
+        out = full[0] if len(full) == 1 else tuple(full)
+        return self.vjp_fn(out)
+
+
+def _accumulate(a, b):
+    return b if a is None else a + b
+
+
+def _topo_order(roots: list[GradNode]) -> list[GradNode]:
+    """Reverse-topological order over producer edges (consumers before producers)."""
+    seen: set[int] = set()
+    order: list[GradNode] = []
+    stack: list[tuple[GradNode, bool]] = [(r, False) for r in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            prod = t._grad_node
+            if prod is not None and id(prod) not in seen:
+                stack.append((prod, False))
+    order.reverse()
+    return order
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """Run reverse-mode AD from `tensors` (engine: reference backward.cc:105).
+
+    grad_tensors: optional seed cotangents (Tensors/arrays); defaults to ones
+    for 0-dim float outputs, mirroring `loss.backward()` semantics.
+    """
+    from paddle_tpu.core.tensor import Tensor  # cycle-free at call time
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # cotangent accumulator: id(node) -> list per output slot
+    cot: dict[int, list] = {}
+    node_by_id: dict[int, GradNode] = {}
+    roots: list[GradNode] = []
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError("cannot call backward() on a tensor with stop_gradient=True")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward seeds "
+                    f"(got shape {t.shape})"
+                )
+            seed = jnp.ones(t._value.shape, t._value.dtype)
+        else:
+            seed = g._value if isinstance(g, Tensor) else jnp.asarray(g, t._value.dtype)
+        node = t._grad_node
+        if node is None:
+            t._accumulate_grad(seed)
+            continue
+        if id(node) not in cot:
+            cot[id(node)] = [None] * node.n_outputs
+            node_by_id[id(node)] = node
+            roots.append(node)
+        idx = t._output_index
+        cot[id(node)][idx] = _accumulate(cot[id(node)][idx], seed)
+
+    order = _topo_order(roots)
+
+    for node in order:
+        slots = cot.pop(id(node), None)
+        if slots is None or all(s is None for s in slots):
+            continue
+        if node.hooks:
+            for h in node.hooks:
+                slots = h(slots)
+        in_cts = node.apply(slots)
+        for t, ct in zip(node.inputs, in_cts):
+            if ct is None or _is_float0(ct) or t.stop_gradient:
+                continue
+            prod = t._grad_node
+            if prod is None:
+                if t._hooks:
+                    for h in t._hooks:
+                        new = h(ct)
+                        if new is not None:
+                            ct = new._value if isinstance(new, Tensor) else new
+                t._accumulate_grad(ct)
+            else:
+                key = id(prod)
+                if key not in cot:
+                    cot[key] = [None] * prod.n_outputs
+                    node_by_id[key] = prod
+                if t._hooks:
+                    for h in t._hooks:
+                        new = h(ct)
+                        if new is not None:
+                            ct = new._value if isinstance(new, Tensor) else new
+                idx = t._output_index
+                cot[key][idx] = _accumulate(cot[key][idx], ct)
+                # intermediate tensors marked as retaining grads also get .grad
+                if t._retain_grads:
+                    t._accumulate_grad(ct)
+        if not retain_graph:
+            node.release()

@@ -1,0 +1,1 @@
+from paddle_tpu.core import device, dtype, flags, tensor  # noqa: F401

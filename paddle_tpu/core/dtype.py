@@ -1,0 +1,150 @@
+"""Dtype system for the TPU-native framework.
+
+Reference parity: PaddlePaddle's dtype surface (`paddle.float32`, `paddle.bfloat16`, ...)
+defined via phi DataType (reference: paddle/phi/common/data_type.h). Here dtypes are thin
+named wrappers over numpy/jax dtypes so they can be passed straight into jax.numpy ops,
+while printing as ``paddle.float32``-style names for API familiarity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "DType",
+    "float16",
+    "float32",
+    "float64",
+    "bfloat16",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "uint8",
+    "bool_",
+    "complex64",
+    "complex128",
+    "convert_dtype",
+    "to_jax_dtype",
+    "set_default_dtype",
+    "get_default_dtype",
+    "is_floating_dtype",
+    "is_integer_dtype",
+]
+
+
+class DType:
+    """A framework dtype: a named wrapper over a numpy dtype usable anywhere jax accepts one."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.np_dtype == other.np_dtype
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.np_dtype)
+
+    # numpy interop: lets jnp.asarray(x, dtype=<DType>) work directly.
+    @property
+    def dtype(self):  # numpy protocol
+        return self.np_dtype
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+    @property
+    def is_floating_point(self):
+        return jnp.issubdtype(self.np_dtype, np.floating)
+
+
+float16 = DType("float16", np.float16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+uint8 = DType("uint8", np.uint8)
+bool_ = DType("bool", np.bool_)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = [
+    float16,
+    float32,
+    float64,
+    bfloat16,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    bool_,
+    complex64,
+    complex128,
+]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_BY_NP = {d.np_dtype: d for d in _ALL}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype) -> DType:
+    """Coerce a string / numpy dtype / DType into a framework DType."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _BY_NAME:
+            return _BY_NAME[dtype]
+        return _BY_NP.get(np.dtype(dtype)) or DType(dtype, np.dtype(dtype))
+    npd = np.dtype(dtype)
+    d = _BY_NP.get(npd)
+    if d is None:
+        d = DType(npd.name, npd)
+        _BY_NP[npd] = d
+    return d
+
+
+def to_jax_dtype(dtype):
+    """Framework dtype -> numpy dtype suitable for jax APIs. None passes through."""
+    if dtype is None:
+        return None
+    return convert_dtype(dtype).np_dtype
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not jnp.issubdtype(d.np_dtype, np.floating):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> DType:
+    return _default_dtype
+
+
+def is_floating_dtype(dtype) -> bool:
+    return jnp.issubdtype(to_jax_dtype(dtype), np.floating)
+
+
+def is_integer_dtype(dtype) -> bool:
+    return jnp.issubdtype(to_jax_dtype(dtype), np.integer)

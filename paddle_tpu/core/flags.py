@@ -1,0 +1,94 @@
+"""Global flag registry.
+
+Reference parity: the gflags-compatible registry in paddle/common/flags.{h,cc}
+(registration macro flags.h:343) + `paddle.set_flags`/`get_flags`
+(python/paddle/base/framework.py:109). Flags are registered with a type, default
+and help string; values can be overridden from the environment via ``FLAGS_<name>``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flag", "flags_snapshot"]
+
+_lock = threading.Lock()
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: type
+    default: Any
+    help: str
+    value: Any
+
+
+_REGISTRY: dict[str, _Flag] = {}
+
+
+def _coerce(typ: type, raw: Any) -> Any:
+    if typ is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return typ(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "", type: type | None = None):
+    """Register a flag. Environment variable FLAGS_<name> overrides the default."""
+    typ = type or (bool if isinstance(default, bool) else default.__class__)
+    with _lock:
+        if name in _REGISTRY:
+            return _REGISTRY[name]
+        value = default
+        env = os.environ.get(f"FLAGS_{name}")
+        if env is not None:
+            value = _coerce(typ, env)
+        f = _Flag(name, typ, default, help, value)
+        _REGISTRY[name] = f
+        return f
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags analog: update registered flags by name (with or without FLAGS_ prefix)."""
+    for key, val in flags.items():
+        name = key[6:] if key.startswith("FLAGS_") else key
+        with _lock:
+            if name not in _REGISTRY:
+                raise KeyError(f"unknown flag: {key}")
+            f = _REGISTRY[name]
+            f.value = _coerce(f.type, val)
+
+
+def get_flags(keys) -> dict:
+    if isinstance(keys, str):
+        keys = [keys]
+    out = {}
+    for key in keys:
+        name = key[6:] if key.startswith("FLAGS_") else key
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown flag: {key}")
+        out[key] = _REGISTRY[name].value
+    return out
+
+
+def flag(name: str):
+    """Fast read of a flag's current value."""
+    return _REGISTRY[name].value
+
+
+def flags_snapshot() -> dict:
+    with _lock:
+        return {k: f.value for k, f in _REGISTRY.items()}
+
+
+# --- core flags (analogs of the most-used FLAGS_* in the reference) ---
+define_flag("check_nan_inf", False, "check outputs for nan/inf after each op (eager)")
+define_flag("eager_op_jit", True, "jit-cache single-op executables in eager dispatch")
+define_flag("default_device", "", "override default device, e.g. 'tpu' or 'cpu'")
+define_flag("allocator_strategy", "auto_growth", "allocator strategy label (XLA manages HBM)")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
+define_flag("use_pallas_attention", True, "use the Pallas flash-attention kernel when available")

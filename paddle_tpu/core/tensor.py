@@ -1,0 +1,335 @@
+"""Eager Tensor: a jax.Array plus autograd metadata.
+
+Reference parity: `paddle::Tensor` / eager tensor (reference:
+paddle/phi/api/include/tensor.h:82, pybind eager_method.cc) with
+`AutogradMeta` folded in (paddle/fluid/eager/autograd_meta.h:61).
+
+TPU-native design: the storage IS a `jax.Array` — a PJRT buffer handed to XLA.
+Every op dispatches through `apply_op`, which runs a pure jax function on the
+underlying buffers (XLA compiles + caches each op executable, the analog of the
+reference's KernelFactory dispatch, phi/core/kernel_factory.h:316) and, when
+gradients are required, records a GradNode via `jax.vjp`. Most tensor methods
+(matmul, reshape, ...) are installed by `paddle_tpu.ops` at import time to keep
+the op library in one place (mirrors how the reference generates tensor methods
+from ops.yaml).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd import tape as _tape
+from paddle_tpu.core import dtype as _dtype_mod
+from paddle_tpu.core.device import current_jax_device
+from paddle_tpu.core.flags import flag
+
+__all__ = ["Tensor", "to_tensor", "apply_op", "is_tensor"]
+
+
+class Tensor:
+    """Eager tensor with define-by-run autograd.
+
+    Paddle semantics preserved: `stop_gradient` defaults to True for user-created
+    tensors; Parameters flip it to False; `.backward()` seeds the tape engine.
+    """
+
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_output_index",
+        "_retain_grads",
+        "_hooks",
+        "name",
+        "persistable",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._output_index = 0
+        self._retain_grads = False
+        self._hooks = None
+        self.name = name
+        self.persistable = False
+
+    # ---- basic properties -------------------------------------------------
+    @property
+    def shape(self) -> list:
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return _dtype_mod.convert_dtype(self._value.dtype)
+
+    @property
+    def place(self):
+        from paddle_tpu.core.device import Place
+
+        try:
+            dev = list(self._value.devices())[0]
+            return Place("cpu" if dev.platform == "cpu" else "tpu", dev.id)
+        except Exception:
+            return Place("cpu", 0)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        return self.transpose(list(range(self.ndim))[::-1])
+
+    # ---- conversion -------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("truth value of a multi-element Tensor is ambiguous")
+        return bool(self._value)
+
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"stop_gradient={self.stop_gradient},\n{np.asarray(self._value)})"
+        )
+
+    # ---- autograd ---------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _tape.backward([self], [grad_tensor] if grad_tensor is not None else None, retain_graph)
+
+    def _accumulate_grad(self, ct):
+        if self.grad is None:
+            self.grad = Tensor(ct, stop_gradient=True)
+        else:
+            self.grad = Tensor(self.grad._value + ct, stop_gradient=True)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook: Callable):
+        """Register a grad hook: hook(grad) -> grad | None (eager/hooks.h analog)."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_s):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True, name=self.name)
+
+    def clone(self) -> "Tensor":
+        return apply_op(lambda x: x + 0, self, name="clone")
+
+    # in-place value swap (optimizer updates); keeps autograd identity as leaf
+    def _set_value(self, new_value):
+        if isinstance(new_value, Tensor):
+            new_value = new_value._value
+        self._value = new_value
+
+    def set_value(self, new_value):
+        if isinstance(new_value, (np.ndarray, list, tuple, float, int)):
+            new_value = jnp.asarray(new_value, self._value.dtype)
+        self._set_value(new_value)
+
+    def copy_(self, other, blocking=True):
+        self._set_value(other._value if isinstance(other, Tensor) else jnp.asarray(other))
+        return self
+
+    # jax pytree-friendly value access
+    @property
+    def value(self):
+        return self._value
+
+    def block_until_ready(self):
+        self._value.block_until_ready()
+        return self
+
+    def __hash__(self):
+        return id(self)
+
+    def element_size(self):
+        return self._value.dtype.itemsize
+
+    def cpu(self):
+        cpu_dev = jax.devices("cpu")[0]
+        return Tensor(jax.device_put(self._value, cpu_dev), self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """`paddle.to_tensor` analog: materialize data as a device buffer."""
+    if isinstance(data, Tensor):
+        val = data._value
+        if dtype is not None:
+            val = val.astype(_dtype_mod.to_jax_dtype(dtype))
+        return Tensor(val, stop_gradient=stop_gradient)
+    jdt = _dtype_mod.to_jax_dtype(dtype)
+    if jdt is None and not isinstance(data, np.ndarray):
+        # python floats/lists take the default float dtype (paddle semantics);
+        # numpy arrays keep their exact dtype
+        probe = np.asarray(data)
+        if probe.dtype == np.float64:
+            jdt = _dtype_mod.get_default_dtype().np_dtype
+    if place is not None:
+        dev = place.jax_device() if hasattr(place, "jax_device") else place
+    else:
+        dev = current_jax_device()
+    val = jax.device_put(np.asarray(data, dtype=jdt) if jdt is not None else np.asarray(data), dev)
+    return Tensor(val, stop_gradient=stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# Eager dispatch
+# ---------------------------------------------------------------------------
+
+_jit_cache: dict = {}
+
+# installed by paddle_tpu.amp: (op_name, vals) -> vals with autocast applied
+_amp_hook = None
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _nan_check(name, vals):
+    for v in vals:
+        if jnp.issubdtype(v.dtype, np.floating) and not bool(jnp.isfinite(v).all()):
+            raise FloatingPointError(f"nan/inf detected in output of op '{name}'")
+
+
+def apply_op(fn: Callable, *tensor_args, name: str | None = None, n_outputs: int | None = None,
+             **static_kwargs):
+    """Execute one op eagerly with optional tape recording.
+
+    `fn(*arrays, **static_kwargs)` must be a pure jax function of its array
+    args; `tensor_args` may mix Tensors and raw arrays/scalars (raw args are
+    treated as constants). Returns Tensor or tuple of Tensors matching fn's
+    output structure. This is the single seam every op goes through — the
+    analog of the generated `*_ad_func` + phi api call chain (SURVEY §3.1).
+    """
+    name = name or getattr(fn, "__name__", "op")
+    tensors = [a for a in tensor_args if isinstance(a, Tensor)]
+    vals = tuple(_unwrap(a) for a in tensor_args)
+    if _amp_hook is not None:
+        vals = _amp_hook(name, vals)
+
+    if static_kwargs:
+        import functools
+
+        f = functools.partial(fn, **static_kwargs)
+    else:
+        f = fn
+
+    record = (
+        _tape.grad_enabled()
+        and any(not t.stop_gradient for t in tensors)
+    )
+
+    if record:
+        out_vals, vjp_fn = jax.vjp(lambda *a: f(*a), *vals)
+        multi = isinstance(out_vals, (tuple, list))
+        outs_list = list(out_vals) if multi else [out_vals]
+        templates = [(o.shape, o.dtype) for o in outs_list]
+
+        # vjp over *all* positional args; map cotangents back to tensor args only
+        positions = [i for i, a in enumerate(tensor_args) if isinstance(a, Tensor)]
+
+        def node_vjp(ct):
+            all_cts = vjp_fn(ct)
+            return [all_cts[i] for i in positions]
+
+        node = _tape.GradNode(node_vjp, tensors, templates, name=name)
+        out_tensors = []
+        for i, o in enumerate(outs_list):
+            t = Tensor(o, stop_gradient=False)
+            t._grad_node = node
+            t._output_index = i
+            out_tensors.append(t)
+        if flag("check_nan_inf"):
+            _nan_check(name, outs_list)
+        if multi:
+            return tuple(out_tensors)
+        return out_tensors[0]
+
+    out_vals = f(*vals)
+    multi = isinstance(out_vals, (tuple, list))
+    outs_list = list(out_vals) if multi else [out_vals]
+    if flag("check_nan_inf"):
+        _nan_check(name, outs_list)
+    outs = [Tensor(o, stop_gradient=True) for o in outs_list]
+    return tuple(outs) if multi else outs[0]
+
+
+# register Tensor as a jax pytree leaf-with-unwrap so jitted code can take Tensors
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), t.stop_gradient),
+    lambda aux, children: Tensor(children[0], stop_gradient=aux),
+)

@@ -1,0 +1,99 @@
+"""`paddle.device` analog (reference: python/paddle/device/__init__.py).
+
+Streams/events map onto XLA async dispatch: every op is issued asynchronously
+on the device's execution stream; `synchronize()` is the barrier. Explicit
+Stream/Event objects are provided for API parity and express ordering via
+`block_until_ready` on the producing buffers.
+"""
+from __future__ import annotations
+
+import time
+
+from paddle_tpu.core.device import (  # noqa: F401
+    CPUPlace,
+    Place,
+    TPUPlace,
+    current_jax_device,
+    current_place,
+    device_count,
+    get_all_devices,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+    synchronize,
+)
+
+__all__ = [
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "synchronize", "is_compiled_with_tpu", "Place", "TPUPlace", "CPUPlace",
+    "Stream", "Event", "current_stream", "stream_guard",
+]
+
+
+class Event:
+    """Stream event (reference: python/paddle/device/__init__.py Event). On XLA
+    the dependency graph orders work; record/synchronize capture host-visible
+    completion of everything issued so far."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False):
+        self._t = None
+        self.enable_timing = enable_timing
+
+    def record(self, stream=None):
+        if self.enable_timing:
+            synchronize()
+            self._t = time.perf_counter()
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+    def elapsed_time(self, end_event):
+        if self._t is None or end_event._t is None:
+            return 0.0
+        return (end_event._t - self._t) * 1000.0
+
+
+class Stream:
+    """Execution stream. XLA runs one ordered async stream per device; extra
+    streams are modeled as the same ordered queue (correct, conservatively)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *a):
+        return False

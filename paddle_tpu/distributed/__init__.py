@@ -1,0 +1,28 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed).
+
+Collectives ride XLA over the ICI/DCN mesh (see collective.py); hybrid
+parallelism lives in `fleet`; semi-automatic sharding in `auto_parallel`
+(ProcessMesh/shard_tensor -> GSPMD).
+"""
+from paddle_tpu.distributed.env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from paddle_tpu.distributed.mesh import build_mesh, get_mesh, set_mesh  # noqa: F401
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    P2POp, Group, ReduceOp, all_gather, all_gather_object, all_reduce,
+    all_to_all, all_to_all_single, barrier, batch_isend_irecv, broadcast,
+    broadcast_object_list, gather, get_group, irecv, isend, new_group, recv,
+    reduce, reduce_scatter, scatter, send, stream, wait,
+)
+from paddle_tpu.distributed.parallel import (  # noqa: F401
+    DataParallel, init_parallel_env, is_initialized,
+)
+from paddle_tpu.distributed import checkpoint  # noqa: F401
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed import utils  # noqa: F401
+from paddle_tpu.distributed.auto_parallel.api import (  # noqa: F401
+    ProcessMesh, Replicate, Shard, Partial, dtensor_from_fn, reshard,
+    shard_dataloader, shard_layer, shard_optimizer, shard_tensor, to_static,
+)
+from paddle_tpu.distributed.utils.moe_utils import global_gather, global_scatter  # noqa: F401
+from paddle_tpu.distributed.spawn import spawn  # noqa: F401
+from paddle_tpu.distributed.launch.main import launch  # noqa: F401
+from paddle_tpu.distributed import rpc  # noqa: F401

@@ -1,0 +1,5 @@
+from paddle_tpu.distributed.auto_parallel.api import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    get_placements, reshard, shard_dataloader, shard_layer, shard_optimizer,
+    shard_tensor, to_static,
+)

@@ -1,0 +1,5 @@
+from paddle_tpu.distributed.checkpoint.save_state_dict import save_state_dict  # noqa: F401
+from paddle_tpu.distributed.checkpoint.load_state_dict import load_state_dict  # noqa: F401
+from paddle_tpu.distributed.checkpoint.metadata import (  # noqa: F401
+    LocalTensorIndex, LocalTensorMetadata, Metadata,
+)

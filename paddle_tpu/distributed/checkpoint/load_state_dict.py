@@ -1,0 +1,73 @@
+"""Sharded checkpoint load with resharding (reference:
+distributed/checkpoint/load_state_dict.py). Shards are reassembled into the
+global array from metadata, then device_put with the destination tensor's
+sharding — loading under a DIFFERENT parallelism layout than the save
+(resharded resume) falls out of the global-array reconstruction."""
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["load_state_dict"]
+
+
+def _flatten_tensors(sd, prefix=""):
+    out = {}
+    for k, v in sd.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten_tensors(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, offload=False):
+    meta_files = glob.glob(os.path.join(path, "*.metadata"))
+    if not meta_files:
+        raise FileNotFoundError(f"no .metadata in {path}")
+    with open(meta_files[0], "rb") as f:
+        meta = pickle.load(f)
+    shard_data = {}
+    for data_file in glob.glob(os.path.join(path, "*.distcp")):
+        with open(data_file, "rb") as f:
+            shard_data.update(pickle.load(f))
+
+    flat = _flatten_tensors(state_dict)
+    for key, target in flat.items():
+        if key not in meta.state_dict_metadata:
+            continue
+        metas = meta.state_dict_metadata[key]
+        # reconstruct the global array
+        if len(metas) == 1 and metas[0].global_offset == (0,) * len(metas[0].local_shape):
+            arr = shard_data[(key, metas[0].global_offset)]
+        else:
+            gshape = [0] * len(metas[0].local_shape)
+            for m in metas:
+                for d in range(len(gshape)):
+                    gshape[d] = max(gshape[d], m.global_offset[d] + m.local_shape[d])
+            arr = np.zeros(gshape, dtype=metas[0].dtype)
+            for m in metas:
+                sl = tuple(slice(o, o + s) for o, s in zip(m.global_offset, m.local_shape))
+                arr[sl] = shard_data[(key, m.global_offset)]
+        if isinstance(target, Tensor):
+            val = jnp.asarray(arr, target._value.dtype)
+            shard = getattr(target._value, "sharding", None)
+            if shard is not None:
+                try:
+                    val = jax.device_put(val, shard)
+                except (ValueError, RuntimeError):
+                    pass
+            target._set_value(val)
+        else:
+            state_dict[key] = arr
+    return state_dict

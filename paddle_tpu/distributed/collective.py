@@ -1,0 +1,330 @@
+"""Collective communication API.
+
+Reference parity: python/paddle/distributed/communication/* (all_reduce,
+all_gather, reduce_scatter, broadcast, scatter, send/recv, batch_isend_irecv)
+over ProcessGroup* (fluid/distributed/collective/process_group.h:47).
+
+TPU-native design (SURVEY §5 'Distributed communication backend'): collectives
+are COMPILED INTO sharded programs as XLA collectives (`lax.psum`,
+`all_gather`, `psum_scatter`, `ppermute`, `all_to_all`) over named mesh axes —
+the ProcessGroupXLA seam. Two contexts:
+
+1. Inside a shard_map'd/jitted region (`in_collective_context()` true): ops
+   lower to lax collectives over the group's mesh axes. This is the hot path —
+   XLA schedules them on ICI with compute overlap (the analog of NCCL comm
+   streams + the reference's CommContext).
+2. Eager/host level: the global-SPMD view means every host holds the full
+   logical value, so intra-process "collectives" are arithmetic identities
+   (all_reduce of an already-global tensor = itself). They exist for API
+   parity and for CPU-mesh multiprocess tests.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.distributed.env import get_rank, get_world_size
+from paddle_tpu.distributed.mesh import get_mesh, mesh_axis_size
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
+    "all_gather_object", "all_to_all", "all_to_all_single", "reduce",
+    "reduce_scatter", "broadcast", "broadcast_object_list", "scatter", "gather",
+    "send", "recv", "isend", "irecv", "barrier", "wait", "P2POp",
+    "batch_isend_irecv", "stream",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+@dataclass
+class Group:
+    """A communication group = a set of named mesh axes (or explicit ranks for
+    host-level groups). id 0 is the global group over every mesh axis."""
+
+    id: int = 0
+    axes: tuple = ()  # mesh axis names this group spans (in-graph lowering)
+    ranks: tuple = ()  # host-level rank list (eager semantics / parity)
+
+    @property
+    def nranks(self) -> int:
+        if self.axes:
+            return int(np.prod([mesh_axis_size(a) for a in self.axes])) or 1
+        return len(self.ranks) if self.ranks else get_world_size()
+
+    @property
+    def rank(self) -> int:
+        r = get_rank()
+        if self.ranks:
+            return self.ranks.index(r) if r in self.ranks else -1
+        return r
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if self.ranks else rank
+
+    @property
+    def process_group(self):
+        return self
+
+
+_GROUPS: dict[int, Group] = {}
+_next_gid = [1]
+
+
+def _global_group() -> Group:
+    if 0 not in _GROUPS:
+        mesh = get_mesh()
+        axes = tuple(mesh.axis_names) if mesh is not None else ()
+        _GROUPS[0] = Group(id=0, axes=axes, ranks=tuple(range(get_world_size())))
+    return _GROUPS[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axes=None) -> Group:
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(id=gid, axes=tuple(axes or ()), ranks=tuple(ranks or ()))
+    _GROUPS[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _global_group()
+    return _GROUPS[gid]
+
+
+def _axis_names(group: Group | None):
+    g = group if group is not None else _global_group()
+    return g.axes if g.axes else None
+
+
+def in_collective_context() -> bool:
+    """True when called under a jax trace that binds mesh axis names (shard_map)."""
+    try:
+        return bool(jax.core.get_axis_env() and jax.core.get_axis_env().axis_sizes)
+    except Exception:
+        # jax>=0.5 moved axis env; probe by attempting a cheap lookup
+        try:
+            jax.lax.axis_index("_probe_nonexistent_axis")
+        except NameError:
+            return False
+        except Exception as e:
+            return "unbound axis name" not in str(e)
+        return False
+
+
+def _bound_axes(axes):
+    """Subset of `axes` that are bound in the current trace (inside shard_map)."""
+    if not axes:
+        return ()
+    bound = []
+    for a in axes:
+        try:
+            jax.lax.axis_index(a)  # raises NameError if not bound
+            bound.append(a)
+        except Exception:
+            pass
+    return tuple(bound)
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group | None = None, sync_op=True):
+    axes = _bound_axes(_axis_names(group))
+    if not axes:
+        return tensor  # global view: already reduced
+    def f(v):
+        if op == ReduceOp.AVG:
+            n = int(np.prod([mesh_axis_size(a) for a in axes]))
+            return jax.lax.psum(v, axes) / n
+        if op == ReduceOp.PROD:
+            return jnp.exp(jax.lax.psum(jnp.log(v), axes))
+        return _REDUCERS[op](v, axes)
+
+    out = apply_op(f, tensor, name="all_reduce")
+    tensor._set_value(out._value)
+    tensor._grad_node = out._grad_node
+    tensor._output_index = out._output_index
+    tensor.stop_gradient = out.stop_gradient
+    return tensor
+
+
+def all_gather(tensor_list: list, tensor: Tensor, group: Group | None = None, sync_op=True):
+    axes = _bound_axes(_axis_names(group))
+    if not axes:
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor.clone())
+            return tensor_list
+        return tensor
+    ax = axes[0]
+    out = apply_op(lambda v: jax.lax.all_gather(v, ax), tensor, name="all_gather")
+    n = out.shape[0]
+    if isinstance(tensor_list, list):
+        from paddle_tpu.ops.manipulation import unbind
+
+        tensor_list.extend(unbind(out, 0))
+        return tensor_list
+    return out
+
+
+def all_gather_object(object_list: list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    axes = _bound_axes(_axis_names(group))
+    src = tensor_or_tensor_list
+    if isinstance(src, list):
+        from paddle_tpu.ops.manipulation import concat
+
+        src = concat(src, axis=0)
+    if not axes:
+        tensor._set_value(src._value)
+        return tensor
+    ax = axes[0]
+    out = apply_op(lambda v: jax.lax.psum_scatter(v, ax, tiled=True), src, name="reduce_scatter")
+    tensor._set_value(out._value)
+    tensor._grad_node = out._grad_node
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # global-SPMD view: value already replicated
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor._set_value(tensor_list[get_rank() if get_rank() < len(tensor_list) else 0]._value)
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is not None:
+        gather_list.append(tensor.clone())
+    return gather_list
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    axes = _bound_axes(_axis_names(group))
+    from paddle_tpu.ops.manipulation import concat, split
+
+    stacked = concat([t.unsqueeze(0) for t in in_tensor_list], axis=0)
+    if not axes:
+        out_tensor_list.extend(t.squeeze(0) for t in split(stacked, len(in_tensor_list), 0))
+        return out_tensor_list
+    ax = axes[0]
+    out = apply_op(lambda v: jax.lax.all_to_all(v, ax, 0, 0, tiled=False), stacked, name="all_to_all")
+    out_tensor_list.extend(t.squeeze(0) for t in split(out, out.shape[0], 0))
+    return out_tensor_list
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None,
+                      group=None, sync_op=True):
+    axes = _bound_axes(_axis_names(group))
+    if not axes:
+        out_tensor._set_value(in_tensor._value)
+        return out_tensor
+    ax = axes[0]
+    out = apply_op(lambda v: jax.lax.all_to_all(v, ax, 0, 0, tiled=True), in_tensor,
+                   name="all_to_all_single")
+    out_tensor._set_value(out._value)
+    out_tensor._grad_node = out._grad_node
+    return out_tensor
+
+
+# ---- p2p: inside pipeline programs these lower to ppermute ---------------
+
+def _ppermute(tensor, axis, shift):
+    n = mesh_axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return apply_op(lambda v: jax.lax.ppermute(v, axis, perm), tensor, name="ppermute")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    axes = _bound_axes(_axis_names(group))
+    if axes:
+        return _ppermute(tensor, axes[0], +1)
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+@dataclass
+class P2POp:
+    op: object
+    tensor: Tensor
+    peer: int
+    group: Group | None = None
+
+
+def batch_isend_irecv(p2p_op_list: Sequence[P2POp]):
+    """reference: communication/batch_isend_irecv.py. In-graph pipeline p2p is
+    expressed as one ppermute per direction (XLA batches them on ICI)."""
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def barrier(group=None):
+    from paddle_tpu.core.device import synchronize
+
+    synchronize()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    tensor._value.block_until_ready()
+    return tensor
+
+
+class stream:
+    """paddle.distributed.stream namespace parity: same ops, explicit sync flags."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    send = staticmethod(send)
+    recv = staticmethod(recv)
+    all_to_all = staticmethod(all_to_all)
+    scatter = staticmethod(scatter)

@@ -1,0 +1,79 @@
+"""Distributed environment (reference: python/paddle/distributed/parallel.py
+ParallelEnv + env-var contract at parallel.py:1017-1046).
+
+TPU-native model: ONE Python process per host drives all local TPU chips via
+SPMD (jax); "rank" at the host level is `jax.process_index()` (the analog of
+PADDLE_TRAINER_ID for multi-host), while per-chip parallelism is expressed by
+shardings on the global mesh rather than per-chip processes. The reference's
+env contract is still honored for launch compatibility: PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM seed the logical rank when set (e.g. by
+`python -m paddle_tpu.distributed.launch` or by the CPU-mesh test harness).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["ParallelEnv", "get_rank", "get_world_size"]
+
+
+class ParallelEnv:
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "-1"))
+        self._world = int(os.getenv("PADDLE_TRAINERS_NUM", "-1"))
+
+    @property
+    def rank(self) -> int:
+        if self._rank >= 0:
+            return self._rank
+        try:
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def world_size(self) -> int:
+        if self._world > 0:
+            return self._world
+        try:
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.getenv("PADDLE_LOCAL_RANK", str(self.rank)))
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def dev_id(self) -> int:
+        return self.local_rank
+
+    @property
+    def device_type(self) -> str:
+        return "tpu"
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def current_endpoint(self):
+        return os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+
+def get_rank(group=None) -> int:
+    if group is not None and hasattr(group, "rank"):
+        return group.rank
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None and hasattr(group, "nranks"):
+        return group.nranks
+    return ParallelEnv().world_size

@@ -1,0 +1,112 @@
+"""Fleet: hybrid-parallel facade (reference: python/paddle/distributed/fleet —
+Fleet at fleet/fleet.py:100, init at :167, distributed_optimizer at :1326,
+model dispatch fleet/model.py:140).
+
+TPU-native: `init(strategy)` builds the HybridCommunicateGroup over the global
+ICI mesh; `distributed_model` wraps by parallel mode (TP layer rewrite already
+done by mpu layers; PP wraps in PipelineParallel; DP is the default SPMD data
+axis); `distributed_optimizer` wraps with HybridParallelOptimizer (grad sync +
+cross-group clip + sharding)."""
+from __future__ import annotations
+
+from paddle_tpu.distributed.fleet.base.distributed_strategy import DistributedStrategy  # noqa: F401
+from paddle_tpu.distributed.fleet.rng import get_rng_state_tracker  # noqa: F401
+from paddle_tpu.distributed.fleet.topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, ParallelMode,
+)
+
+__all__ = ["DistributedStrategy", "init", "fleet", "get_hybrid_communicate_group",
+           "distributed_model", "distributed_optimizer", "get_rng_state_tracker",
+           "worker_index", "worker_num", "ParallelMode", "utils", "meta_parallel",
+           "recompute"]
+
+_hcg: list = [None]
+_strategy: list = [None]
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """reference: fleet/fleet.py:167."""
+    global _hcg
+    strategy = strategy or DistributedStrategy()
+    _strategy[0] = strategy
+    hc = strategy.hybrid_configs
+    topo = CommunicateTopology(
+        hybrid_group_names=["pipe", "data", "sharding", "sep", "model"],
+        dims=[hc["pp_degree"], hc["dp_degree"], hc["sharding_degree"],
+              hc["sep_degree"], hc["mp_degree"]],
+    )
+    _hcg[0] = HybridCommunicateGroup(topo)
+    return _hcg[0]
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _hcg[0] is None:
+        init()
+    return _hcg[0]
+
+
+def get_strategy() -> DistributedStrategy:
+    return _strategy[0] or DistributedStrategy()
+
+
+def distributed_model(model):
+    """reference: fleet/model.py:140 — wrap by ParallelMode."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import PipelineParallel
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers import PipelineLayer
+    from paddle_tpu.distributed.fleet.meta_parallel.tensor_parallel import TensorParallel
+    from paddle_tpu.distributed.parallel import DataParallel
+
+    hcg = get_hybrid_communicate_group()
+    mode = hcg.get_parallel_mode()
+    if isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, get_strategy())
+    if mode == ParallelMode.TENSOR_PARALLEL:
+        return TensorParallel(model, hcg, get_strategy())
+    if mode in (ParallelMode.DATA_PARALLEL, ParallelMode.SHARDING_PARALLEL):
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference: fleet/fleet.py:1326 — wrap with HybridParallelOptimizer."""
+    from paddle_tpu.distributed.fleet.meta_optimizers.hybrid_parallel_optimizer import (
+        HybridParallelOptimizer,
+    )
+
+    hcg = get_hybrid_communicate_group()
+    return HybridParallelOptimizer(optimizer, hcg, strategy or get_strategy())
+
+
+def worker_index():
+    from paddle_tpu.distributed.env import get_rank
+
+    return get_rank()
+
+
+def worker_num():
+    from paddle_tpu.distributed.env import get_world_size
+
+    return get_world_size()
+
+
+def barrier_worker():
+    from paddle_tpu.distributed.collective import barrier
+
+    barrier()
+
+
+class _FleetModule:
+    """`fleet.fleet` object parity."""
+
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+
+
+fleet = _FleetModule()
+
+from paddle_tpu.distributed.fleet import meta_parallel  # noqa: F401,E402
+from paddle_tpu.distributed.fleet import utils  # noqa: F401,E402
+from paddle_tpu.distributed.fleet.recompute import recompute  # noqa: F401,E402

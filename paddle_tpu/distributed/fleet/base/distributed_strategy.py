@@ -1,0 +1,64 @@
+"""DistributedStrategy (reference: fleet/base/distributed_strategy.py, backed by
+framework/distributed_strategy.proto). Plain-Python config object holding the
+hybrid_configs {dp/mp/pp/sharding/sep degree} plus the strategy toggles the
+TPU build honors (amp, recompute, gradient_merge, sharding)."""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class _HybridConfig(dict):
+    DEFAULTS = {
+        "dp_degree": 1,
+        "mp_degree": 1,
+        "pp_degree": 1,
+        "sharding_degree": 1,
+        "sep_degree": 1,
+        "ep_degree": 1,
+        "order": ["pipe", "data", "sharding", "sep", "model"],
+        "mp_configs": {},
+        "pp_configs": {},
+    }
+
+    def __init__(self, *a, **k):
+        super().__init__(self.DEFAULTS)
+        self.update(*a, **k)
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = _HybridConfig()
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_fp16": False,
+                            "custom_white_list": [], "custom_black_list": [], "dtype": "bfloat16"}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "degree": 1}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.heter_ccl_mode = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.without_graph_optimization = False
+        self.a_sync = False
+
+    @property
+    def hybrid_configs_dict(self):
+        return dict(self.hybrid_configs)
+
+    def __setattr__(self, k, v):
+        if k == "hybrid_configs" and isinstance(v, dict) and not isinstance(v, _HybridConfig):
+            cfg = _HybridConfig()
+            cfg.update(v)
+            object.__setattr__(self, k, cfg)
+            return
+        object.__setattr__(self, k, v)
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid_configs={dict(self.hybrid_configs)})"

@@ -1,0 +1,99 @@
+"""Tensor-parallel communication primitives.
+
+Reference parity: fleet/layers/mpu/mp_ops.py — the identity/allreduce autograd
+pairs (`_c_identity` forward=identity backward=allreduce, `_mp_allreduce`
+forward=allreduce backward=identity), concat/split along mp group.
+
+TPU-native: inside a compiled sharded program these are `lax.psum` /
+`all_gather` over the "mp" mesh axis with jax's own transpose rules giving the
+same fwd/bwd pairing; eagerly (global view) they are identities. Implemented
+with custom_vjp so the pairing is explicit and matches Megatron semantics
+exactly rather than relying on transposition.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.distributed.collective import _bound_axes
+
+__all__ = ["_c_identity", "_mp_allreduce", "_c_concat", "_c_split",
+           "mp_axis_bound", "MP_AXIS"]
+
+MP_AXIS = "mp"
+
+
+def mp_axis_bound() -> bool:
+    return bool(_bound_axes((MP_AXIS,)))
+
+
+# -- identity fwd / psum bwd (column-parallel input) ------------------------
+@jax.custom_vjp
+def _identity_fwd_psum_bwd(x):
+    return x
+
+
+def _ifpb_fwd(x):
+    return x, None
+
+
+def _ifpb_bwd(_, g):
+    if _bound_axes((MP_AXIS,)):
+        g = jax.lax.psum(g, MP_AXIS)
+    return (g,)
+
+
+_identity_fwd_psum_bwd.defvjp(_ifpb_fwd, _ifpb_bwd)
+
+
+# -- psum fwd / identity bwd (row-parallel output) --------------------------
+@jax.custom_vjp
+def _psum_fwd_identity_bwd(x):
+    if _bound_axes((MP_AXIS,)):
+        return jax.lax.psum(x, MP_AXIS)
+    return x
+
+
+def _pfib_fwd(x):
+    return _psum_fwd_identity_bwd(x), None
+
+
+def _pfib_bwd(_, g):
+    return (g,)
+
+
+_psum_fwd_identity_bwd.defvjp(_pfib_fwd, _pfib_bwd)
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    return apply_op(_identity_fwd_psum_bwd, tensor, name="c_identity")
+
+
+def _mp_allreduce(tensor, group=None, use_calc_stream=True, use_model_parallel=True):
+    return apply_op(_psum_fwd_identity_bwd, tensor, name="mp_allreduce")
+
+
+def _c_concat(tensor, group=None):
+    """all-gather along last dim over mp axis (fwd); slice (bwd)."""
+
+    def f(v):
+        if _bound_axes((MP_AXIS,)):
+            return jax.lax.all_gather(v, MP_AXIS, axis=v.ndim - 1, tiled=True)
+        return v
+
+    return apply_op(f, tensor, name="c_concat")
+
+
+def _c_split(tensor, group=None):
+    """split last dim, keep local shard (fwd); all-gather (bwd)."""
+
+    def f(v):
+        if _bound_axes((MP_AXIS,)):
+            n = jax.lax.axis_size(MP_AXIS)
+            i = jax.lax.axis_index(MP_AXIS)
+            sz = v.shape[-1] // n
+            return jax.lax.dynamic_slice_in_dim(v, i * sz, sz, axis=v.ndim - 1)
+        return v
+
+    return apply_op(f, tensor, name="c_split")

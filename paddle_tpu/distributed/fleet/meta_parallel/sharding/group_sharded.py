@@ -1,0 +1,165 @@
+"""ZeRO group sharding stages 2/3.
+
+Reference parity: fleet/meta_parallel/sharding —
+`GroupShardedOptimizerStage2` (group_sharded_optimizer_stage2.py:53),
+`GroupShardedStage2` (group_sharded_stage2.py:46),
+`GroupShardedStage3` (group_sharded_stage3.py:85).
+
+TPU-native design: sharding is expressed through ARRAY SHARDINGS, not manual
+slicing. Optimizer state arrays are placed with a NamedSharding over the
+"sharding"/"dp" mesh axis (ZeRO-1/2); stage-3 additionally shards the
+parameters themselves, with XLA's GSPMD inserting the on-demand all-gathers
+before each use (the reference's stage-3 `_build_forward_pre_hook` allgather)
+and reduce-scatters after backward — fused into the compiled step. On one chip
+(tests) everything degenerates to dense training with identical numerics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.mesh import get_mesh, mesh_axis_size
+
+__all__ = ["GroupShardedStage2", "GroupShardedStage3", "GroupShardedOptimizerStage2",
+           "group_sharded_parallel", "shard_array_over"]
+
+
+def shard_array_over(val, axis_name: str, mesh=None):
+    """Place `val` sharded on dim-0 over `axis_name` (pad-free only when
+    divisible; else keep replicated — correctness first)."""
+    mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.shape or mesh.shape[axis_name] <= 1:
+        return val
+    if val.ndim == 0 or val.shape[0] % mesh.shape[axis_name] != 0:
+        return val
+    try:
+        return jax.device_put(val, NamedSharding(mesh, PartitionSpec(axis_name)))
+    except (ValueError, RuntimeError):
+        return val
+
+
+class GroupShardedOptimizerStage2:
+    """Optimizer-state (+grad) sharding. Wraps any paddle_tpu Optimizer: state
+    arrays get dp/sharding-axis placement at creation (reference
+    group_sharded_optimizer_stage2.py:53)."""
+
+    def __init__(self, params, optim, group=None, offload=False, device="tpu",
+                 dp_group=None, **kwargs):
+        self._optim = optim
+        self._axis = "sharding" if mesh_axis_size("sharding") > 1 else "dp"
+        self._offload = offload
+        # intercept state creation to shard it
+        orig_init_state = optim._init_state
+
+        def sharded_init_state(p):
+            st = orig_init_state(p)
+            return {k: shard_array_over(v, self._axis) for k, v in st.items()}
+
+        optim._init_state = sharded_init_state
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_optim"], name)
+
+    def step(self):
+        self._optim.step()
+
+    def clear_grad(self, *a, **k):
+        self._optim.clear_grad()
+
+    def state_dict(self):
+        return self._optim.state_dict()
+
+    def set_state_dict(self, s):
+        return self._optim.set_state_dict(s)
+
+
+class _ShardedModelBase:
+    def __init__(self, layer, optimizer=None, group=None, **kwargs):
+        self._layers = layer
+        self._optim = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self):
+        return self._layers.parameters()
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+
+class GroupShardedStage2(_ShardedModelBase):
+    """ZeRO-2: grads + optimizer state sharded (reference group_sharded_stage2.py:46).
+    Grad reduce-scatter is fused into the compiled step by GSPMD when the
+    optimizer state carries the sharding axis."""
+
+    def __init__(self, layer, sharding_optimizer, group=None, sync_buffers=False,
+                 buffer_max_size=2 ** 23, auto_refresh_trainable=True, device="tpu",
+                 dp_group=None, **kwargs):
+        super().__init__(layer, sharding_optimizer, group)
+
+    def to(self, *a, **k):
+        return self
+
+
+class GroupShardedStage3(_ShardedModelBase):
+    """ZeRO-3: parameters themselves sharded (reference group_sharded_stage3.py:85).
+    Parameter arrays are placed sharded over the axis; GSPMD all-gathers on use."""
+
+    def __init__(self, layer, optimizer=None, group=None, sync_buffers=False,
+                 device="tpu", segment_size=2 ** 20, pretrain_sync_models=True,
+                 offload=False, sync_comm=False, dp_group=None, **kwargs):
+        super().__init__(layer, optimizer, group)
+        axis = "sharding" if mesh_axis_size("sharding") > 1 else "dp"
+        for p in layer.parameters():
+            p._set_value(shard_array_over(p._value, axis))
+
+    def get_all_parameters(self, convert2cpu=False):
+        """reference stage3 API: materialize full params."""
+        mesh = get_mesh()
+        for p in self._layers.parameters():
+            if mesh is not None:
+                try:
+                    p._set_value(jax.device_put(
+                        p._value, NamedSharding(mesh, PartitionSpec())))
+                except (ValueError, RuntimeError):
+                    pass
+        return self._layers.parameters()
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """reference: python/paddle/distributed/sharding/group_sharded.py
+    group_sharded_parallel — assemble model/optimizer/scaler by level 'os'|'os_g'|'p_g_os'."""
+    if level in ("os", "os_g"):
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer, group, offload=offload)
+        mdl = GroupShardedStage2(model, opt, group) if level == "os_g" else model
+        return mdl, opt, scaler
+    if level == "p_g_os":
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer, group, offload=offload)
+        mdl = GroupShardedStage3(model, opt, group, offload=offload)
+        return mdl, opt, scaler
+    raise ValueError(f"unknown group_sharded level {level}")

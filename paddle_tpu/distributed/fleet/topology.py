@@ -1,0 +1,256 @@
+"""Hybrid-parallel topology.
+
+Reference parity: `CommunicateTopology` / `HybridCommunicateGroup`
+(fleet/base/topology.py:65,:178) — the N-D logical rank mesh over axes
+["pp", "dp", "sharding", "sep", "mp"] with one comm group per axis and fused
+groups (axis creation order pp->mp->sep->sharding->dp, topology.py:223-244).
+
+TPU-native: ranks index logical mesh coordinates of the global
+`jax.sharding.Mesh` (distributed.mesh). A "comm group" is a named mesh axis —
+its collectives compile to ICI collectives — so group construction is pure
+bookkeeping (no NCCL communicator bring-up / uniqueId exchange needed).
+"""
+from __future__ import annotations
+
+from itertools import product
+
+import numpy as np
+
+from paddle_tpu.distributed.collective import Group, new_group
+from paddle_tpu.distributed.env import get_rank
+from paddle_tpu.distributed.mesh import build_mesh, get_mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "ParallelMode"]
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class CommunicateTopology:
+    """reference: fleet/base/topology.py:65."""
+
+    def __init__(self, hybrid_group_names=("pipe", "data", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world = int(np.prod(self._dims))
+        arr = np.arange(self._world).reshape(self._dims)
+        self._rank_of_coord = arr
+        self._coord_of_rank = {int(arr[c]): c for c in product(*[range(d) for d in self._dims])}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return int(self._rank_of_coord[coord])
+
+    def get_coord(self, rank):
+        return self._coord_of_rank[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on `axis_name` equals index."""
+        ax = self._parallel_names.index(axis_name)
+        return sorted(
+            int(self._rank_of_coord[c])
+            for c in product(*[range(d) for d in self._dims])
+            if c[ax] == index
+        )
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups along `axis_name` (one group per fixed setting
+        of the other axes) — reference topology.py get_comm_list."""
+        ax = self._parallel_names.index(axis_name)
+        other = [range(d) for i, d in enumerate(self._dims) if i != ax]
+        groups = []
+        for combo in product(*other):
+            ranks = []
+            for i in range(self._dims[ax]):
+                coord = list(combo)
+                coord.insert(ax, i)
+                ranks.append(int(self._rank_of_coord[tuple(coord)]))
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = list(self.get_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return int(self._rank_of_coord[tuple(coord)])
+
+
+# map reference axis names -> mesh axis names used by paddle_tpu.distributed.mesh
+_AXIS_TO_MESH = {"data": "dp", "pipe": "pp", "model": "mp", "sharding": "sharding", "sep": "sep"}
+
+
+class HybridCommunicateGroup:
+    """reference: fleet/base/topology.py:178."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = get_rank()
+        self.nranks = topology.world_size()
+
+        self._dp_degree = topology.get_dim("data")
+        self._mp_degree = topology.get_dim("model")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in topology.get_hybrid_group_names() else 1
+
+        # build / validate the physical mesh lazily: only when devices allow
+        self._ensure_mesh()
+
+        rank = min(self.global_rank, self.nranks - 1)
+        coord = topology.get_coord(rank)
+        names = topology.get_hybrid_group_names()
+        self._coord = dict(zip(names, coord))
+
+        # per-axis groups (mesh-axis backed)
+        self._dp_group = new_group(axes=("dp",), ranks=self._ranks_in("data"))
+        self._mp_group = new_group(axes=("mp",), ranks=self._ranks_in("model"))
+        self._pp_group = new_group(axes=("pp",), ranks=self._ranks_in("pipe"))
+        self._sharding_group = new_group(axes=("sharding",), ranks=self._ranks_in("sharding"))
+        self._sep_group = new_group(axes=("sep",), ranks=self._ranks_in("sep")) if self._sep_degree > 1 else None
+        # fused dp+sharding group for grad sync (reference topology dp_sharding fusion)
+        self._dp_sharding_group = new_group(axes=("dp", "sharding"))
+        self._check_group = new_group(axes=tuple())
+
+    def _ensure_mesh(self):
+        import jax
+
+        ndev = len(jax.devices())
+        axes = {"pp": self._pp_degree, "dp": self._dp_degree,
+                "sharding": self._sharding_degree, "sep": self._sep_degree,
+                "mp": self._mp_degree}
+        need = int(np.prod(list(axes.values())))
+        if need == ndev:
+            build_mesh(axes)
+        elif get_mesh() is None and ndev >= 1:
+            # logical topology larger than physical devices (tests on 1 chip):
+            # keep a degenerate mesh; sharded compilation uses dryrun meshes.
+            build_mesh({"dp": ndev})
+
+    def _ranks_in(self, axis_name):
+        rank = min(self.global_rank, self.nranks - 1)
+        coord = self._topo.get_coord(rank)
+        names = self._topo.get_hybrid_group_names()
+        idx = {n: c for n, c in zip(names, coord)}
+        ax = names.index(axis_name)
+        ranks = []
+        for i in range(self._topo.get_dim(axis_name)):
+            c = [idx[n] for n in names]
+            c[ax] = i
+            ranks.append(self._topo.get_rank(**dict(zip(names, c))))
+        return tuple(ranks)
+
+    # ---- mode -------------------------------------------------------------
+    def get_parallel_mode(self):
+        """reference topology.py:285-322 mode selection."""
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._dp_degree == 1 and self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._mp_degree == 1 and self._pp_degree == 1:
+            return ParallelMode.DATA_PARALLEL
+        if self._mp_degree > 1 and self._pp_degree == 1:
+            return ParallelMode.TENSOR_PARALLEL
+        return ParallelMode.PIPELINE_PARALLEL
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # ---- data parallel ----------------------------------------------------
+    def get_data_parallel_rank(self):
+        return self._coord["data"]
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0] if self._dp_group.ranks else 0
+
+    # ---- model (tensor) parallel -------------------------------------------
+    def get_model_parallel_rank(self):
+        return self._coord["model"]
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0] if self._mp_group.ranks else 0
+
+    # ---- pipeline ----------------------------------------------------------
+    def get_stage_id(self):
+        return self._coord["pipe"]
+
+    def get_pipe_parallel_rank(self):
+        return self._coord["pipe"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return (self._pp_group,)
+
+    # ---- sharding ----------------------------------------------------------
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._sharding_group.ranks[0] if self._sharding_group.ranks else 0
+
+    # ---- sep ----------------------------------------------------------------
+    def get_sep_parallel_rank(self):
+        return self._coord.get("sep", 0)
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    # ---- fused -------------------------------------------------------------
+    def get_dp_sharding_parallel_group(self):
+        return self._dp_sharding_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._check_group
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id, **kwargs)

@@ -1,0 +1,4 @@
+from paddle_tpu.distributed.fleet.utils import hybrid_parallel_util  # noqa: F401
+from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils  # noqa: F401
+from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401
+from paddle_tpu.distributed.fleet.recompute import recompute  # noqa: F401

@@ -1,0 +1,46 @@
+"""Hybrid-parallel grad sync helpers.
+
+Reference parity: fleet/utils/hybrid_parallel_util.py —
+`fused_allreduce_gradients` (:241), broadcast_*_params helpers.
+
+TPU-native: on the logical-global view, dp grads are already the global sum
+(SPMD); inside a shard_map'd step the psum is explicit. These helpers apply
+the explicit psum when an axis is bound, matching the eager-collective path.
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.distributed.collective import _bound_axes
+
+__all__ = ["fused_allreduce_gradients", "broadcast_dp_parameters",
+           "broadcast_mp_parameters", "broadcast_sharding_parameters",
+           "sync_params_buffers"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """reference :241 — allreduce every grad over the dp(+sep) group."""
+    axes = _bound_axes(("dp", "sep"))
+    if not axes:
+        return
+    for p in parameter_list:
+        if p.grad is not None:
+            g = apply_op(lambda v: jax.lax.psum(v, axes), p.grad, name="fused_allreduce")
+            p.grad._set_value(g._value)
+
+
+def broadcast_dp_parameters(model, hcg):
+    """global-SPMD: one logical copy, nothing to broadcast."""
+
+
+def broadcast_mp_parameters(model, hcg):
+    pass
+
+
+def broadcast_sharding_parameters(model, hcg):
+    pass
+
+
+def sync_params_buffers(model, comm_group=None, src_rank=0, is_model_parallel=False):
+    pass

@@ -1,0 +1,187 @@
+"""Megatron-style sequence parallelism inside the TP group.
+
+Reference parity: fleet/utils/sequence_parallel_utils.py — `ScatterOp` (:85),
+`AllGatherOp` (:111), `ReduceScatterOp` (:127), `ColumnSequenceParallelLinear`
+(:427), `RowSequenceParallelLinear`, `register_sequence_parallel_allreduce_hooks`
+(:192), `mark_as_sequence_parallel_parameter`.
+
+TPU-native: the sequence dim is sharded over the "mp" axis between attention
+blocks; scatter/all-gather become lax collectives with custom-vjp pairing
+(all_gather fwd <-> reduce_scatter bwd) compiled onto ICI.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.distributed.collective import _bound_axes
+from paddle_tpu.distributed.fleet.layers.mpu.mp_ops import MP_AXIS
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["ScatterOp", "AllGatherOp", "ReduceScatterOp", "scatter", "all_gather",
+           "reduce_scatter", "identity_in_fwd_allreduce_in_bwd",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+def _bound():
+    return bool(_bound_axes((MP_AXIS,)))
+
+
+# all_gather fwd (seq dim 0) <-> reduce_scatter bwd
+@jax.custom_vjp
+def _allgather_seq(x):
+    if _bound():
+        return jax.lax.all_gather(x, MP_AXIS, axis=0, tiled=True)
+    return x
+
+
+def _ag_fwd(x):
+    return _allgather_seq(x), None
+
+
+def _ag_bwd(_, g):
+    if _bound():
+        return (jax.lax.psum_scatter(g, MP_AXIS, scatter_dimension=0, tiled=True),)
+    return (g,)
+
+
+_allgather_seq.defvjp(_ag_fwd, _ag_bwd)
+
+
+# scatter fwd (slice local seq shard) <-> all_gather bwd
+@jax.custom_vjp
+def _scatter_seq(x):
+    if _bound():
+        n = jax.lax.axis_size(MP_AXIS)
+        i = jax.lax.axis_index(MP_AXIS)
+        sz = x.shape[0] // n
+        return jax.lax.dynamic_slice_in_dim(x, i * sz, sz, axis=0)
+    return x
+
+
+def _sc_fwd(x):
+    return _scatter_seq(x), None
+
+
+def _sc_bwd(_, g):
+    if _bound():
+        return (jax.lax.all_gather(g, MP_AXIS, axis=0, tiled=True),)
+    return (g,)
+
+
+_scatter_seq.defvjp(_sc_fwd, _sc_bwd)
+
+
+# reduce_scatter fwd <-> all_gather bwd
+@jax.custom_vjp
+def _reduce_scatter_seq(x):
+    if _bound():
+        return jax.lax.psum_scatter(x, MP_AXIS, scatter_dimension=0, tiled=True)
+    return x
+
+
+def _rs_fwd(x):
+    return _reduce_scatter_seq(x), None
+
+
+def _rs_bwd(_, g):
+    if _bound():
+        return (jax.lax.all_gather(g, MP_AXIS, axis=0, tiled=True),)
+    return (g,)
+
+
+_reduce_scatter_seq.defvjp(_rs_fwd, _rs_bwd)
+
+
+def scatter(x):
+    return apply_op(_scatter_seq, x, name="sp_scatter")
+
+
+def all_gather(x):
+    return apply_op(_allgather_seq, x, name="sp_allgather")
+
+
+def reduce_scatter(x):
+    return apply_op(_reduce_scatter_seq, x, name="sp_reduce_scatter")
+
+
+# PyLayer-style aliases matching the reference class names
+class ScatterOp:
+    apply = staticmethod(scatter)
+
+
+class AllGatherOp:
+    apply = staticmethod(all_gather)
+
+
+class ReduceScatterOp:
+    apply = staticmethod(reduce_scatter)
+
+
+def identity_in_fwd_allreduce_in_bwd(x):
+    from paddle_tpu.distributed.fleet.layers.mpu.mp_ops import _c_identity
+
+    return _c_identity(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """reference :192 — allreduce grads of sequence-parallel params (LayerNorm
+    etc.) over the mp group after backward. Implemented as tensor grad hooks."""
+
+    def make_hook():
+        def hook(grad):
+            axes = _bound_axes((MP_AXIS,))
+            if axes:
+                return apply_op(lambda v: jax.lax.psum(v, axes), grad, name="sp_allreduce")
+            return grad
+
+        return hook
+
+    for p in model.parameters():
+        if getattr(p, "sequence_parallel", False):
+            p.register_hook(make_hook())
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """reference :427 — allgather(seq) -> column linear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features], weight_attr,
+                                            default_initializer=I.XavierNormal())
+        self.weight._mp_pspec = (None, MP_AXIS)
+        self.bias = self.create_parameter([out_features], None, is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        x = all_gather(x)
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowSequenceParallelLinear(Layer):
+    """row linear -> reduce_scatter(seq)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features], weight_attr,
+                                            default_initializer=I.XavierNormal())
+        self.weight._mp_pspec = (MP_AXIS, None)
+        self.bias = self.create_parameter([out_features], None, is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        out = reduce_scatter(out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out

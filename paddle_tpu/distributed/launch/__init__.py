@@ -1,0 +1,1 @@
+from paddle_tpu.distributed.launch.main import launch  # noqa: F401

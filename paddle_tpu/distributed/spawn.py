@@ -1,0 +1,38 @@
+"""paddle.distributed.spawn analog (reference: distributed/spawn.py).
+
+TPU-native: a single SPMD process drives all local chips, so spawn() runs the
+function once in-process for nprocs covering local devices; true multi-host
+launches go through paddle_tpu.distributed.launch which sets the process env
+(the reference env contract) before exec.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+
+__all__ = ["spawn"]
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    if nprocs in (-1, 0, 1):
+        # SPMD: one driving process
+        func(*args)
+        return None
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank), "PADDLE_TRAINERS_NUM": str(nprocs)}
+
+        def _target(rank=rank, env=env):
+            os.environ.update(env)
+            func(*args)
+
+        p = ctx.Process(target=_target, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+            if p.exitcode:
+                raise RuntimeError(f"spawned rank failed with exit code {p.exitcode}")
+    return procs

@@ -1,0 +1,1 @@
+from paddle_tpu.distributed.utils.moe_utils import global_gather, global_scatter  # noqa: F401

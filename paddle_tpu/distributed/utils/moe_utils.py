@@ -1,0 +1,48 @@
+"""MoE dispatch collectives.
+
+Reference parity: `global_scatter` / `global_gather`
+(distributed/utils/moe_utils.py:20; CUDA ops
+fluid/operators/collective/global_{scatter,gather}_op.*) — the all-to-all
+expert dispatch primitives.
+
+TPU-native: inside the compiled expert-parallel region these lower to
+`lax.all_to_all` over the "ep"/"mp" mesh axis (ICI all-to-all); at the eager
+global view they perform the equivalent host-side regrouping so single-chip
+MoE works identically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.distributed.collective import _bound_axes, _axis_names
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Dispatch rows of x to experts across ranks (reference moe_utils.py:20).
+
+    x: [n_tokens, d]; local_count[i]: rows to send to expert i (len = n_expert *
+    world_size); global_count[i]: rows to receive. In-graph this is an
+    all_to_all over the expert axis; the dense-form MoE layer
+    (paddle_tpu.incubate.moe) uses fixed-capacity tensors instead, which is the
+    TPU-friendly layout (static shapes for XLA).
+    """
+    axes = _bound_axes(_axis_names(group))
+    if axes:
+        ax = axes[0]
+        return apply_op(lambda v: jax.lax.all_to_all(v, ax, 0, 0, tiled=True), x,
+                        name="global_scatter")
+    return x
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter (reference moe_utils.py: global_gather)."""
+    axes = _bound_axes(_axis_names(group))
+    if axes:
+        ax = axes[0]
+        return apply_op(lambda v: jax.lax.all_to_all(v, ax, 0, 0, tiled=True), x,
+                        name="global_gather")
+    return x

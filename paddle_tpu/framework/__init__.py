@@ -1,0 +1,20 @@
+"""Framework glue (reference: python/paddle/framework + python/paddle/base/framework.py)."""
+from paddle_tpu.framework.io_ import load, save  # noqa: F401
+from paddle_tpu.core.flags import get_flags, set_flags  # noqa: F401
+from paddle_tpu.ops.random_state import seed, default_generator  # noqa: F401
+
+
+def get_default_dtype():
+    from paddle_tpu.core.dtype import get_default_dtype as g
+
+    return g()
+
+
+def set_default_dtype(d):
+    from paddle_tpu.core.dtype import set_default_dtype as s
+
+    return s(d)
+
+
+def in_dynamic_mode():
+    return True

@@ -1,0 +1,60 @@
+"""Serialization: paddle.save / paddle.load analogs.
+
+Reference parity: python/paddle/framework/io.py:743 (save) / :985 (load).
+Format: a pickle of the object tree with Tensors replaced by numpy arrays
+(tagged), so checkpoints are host-portable. Distributed sharded checkpointing
+lives in paddle_tpu.distributed.checkpoint.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_TENSOR_TAG = "__paddle_tpu_tensor__"
+
+
+def _pack(obj: Any):
+    if isinstance(obj, Tensor):
+        return {_TENSOR_TAG: True, "data": np.asarray(obj._value), "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy: bool = False):
+    if isinstance(obj, dict):
+        if obj.get(_TENSOR_TAG):
+            if return_numpy:
+                return obj["data"]
+            return Tensor(jnp.asarray(obj["data"]), stop_gradient=obj.get("stop_gradient", True))
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

@@ -1,0 +1,1 @@
+from paddle_tpu.jit.api import InputSpec, not_to_static, save, load, to_static  # noqa: F401

@@ -1,0 +1,220 @@
+"""Graph capture: `to_static` whole-program XLA compilation.
+
+Reference parity: paddle.jit.to_static (python/paddle/jit/api.py:173) which
+captures Python into a static Program via SOT bytecode translation
+(jit/sot/translate.py:32) and runs it on PirInterpreter. TPU-native design:
+capture-by-trace into ONE compiled XLA program — `jax.jit` over a purely
+functional form of the layer/function. The eager tape is bypassed inside the
+capture; gradients of a captured function flow through `jax.vjp` of the whole
+program, so backward is whole-graph compiled too (the analog of the reference's
+static backward pass construction, ir_backward.py).
+
+No bytecode translator is needed: our eager ops are pure jax functions of
+`Tensor._value`, so ordinary Python execution under jax tracers IS the capture.
+Data-dependent Python control flow must use paddle_tpu.jit.cond/while_loop
+(-> lax.cond / lax.while_loop), mirroring how SOT falls back on control-flow ops.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd import tape as _tape
+from paddle_tpu.core.dtype import to_jax_dtype
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["InputSpec", "to_static", "not_to_static", "save", "load", "cond", "while_loop", "scan"]
+
+
+class InputSpec:
+    """Shape/dtype declaration (reference: paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _unwrap_tree(x):
+    return jax.tree_util.tree_map(
+        lambda v: v._value if isinstance(v, Tensor) else v, x,
+        is_leaf=lambda v: isinstance(v, Tensor),
+    )
+
+
+class StaticFunction:
+    """A captured callable: params are implicit inputs, the body is one XLA program."""
+
+    def __init__(self, fn: Callable, layer=None, input_spec=None, backend=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        functools.update_wrapper(self, fn, updated=())
+        self._params: list[Tensor] | None = None
+        self._jitted = None
+
+    # -- functionalization --------------------------------------------------
+    def _collect_params(self):
+        if self._layer is not None:
+            return list(self._layer.parameters())
+        return []
+
+    def _pure(self, param_vals: Sequence, args_vals: tuple, kwargs_vals: dict):
+        """Run fn with params + inputs bound to (possibly traced) buffers."""
+        params = self._params
+        old = [p._value for p in params]
+        try:
+            for p, v in zip(params, param_vals):
+                p._set_value(v)
+            t_args = jax.tree_util.tree_map(lambda v: Tensor(v) if _is_arr(v) else v, args_vals)
+            t_kwargs = jax.tree_util.tree_map(lambda v: Tensor(v) if _is_arr(v) else v, kwargs_vals)
+            with _tape.no_grad():
+                out = self._fn(*t_args, **t_kwargs)
+            return _unwrap_tree(out)
+        finally:
+            for p, v in zip(params, old):
+                p._set_value(v)
+
+    def __call__(self, *args, **kwargs):
+        if self._params is None:
+            self._params = self._collect_params()
+        params = self._params
+        args_vals = _unwrap_tree(args)
+        kwargs_vals = _unwrap_tree(kwargs)
+
+        needs_grad = _tape.grad_enabled() and any(not p.stop_gradient for p in params)
+        in_grad = _tape.grad_enabled() and any(
+            isinstance(t, Tensor) and not t.stop_gradient
+            for t in jax.tree_util.tree_leaves(args, is_leaf=lambda v: isinstance(v, Tensor))
+        )
+
+        if needs_grad or in_grad:
+            # whole-program forward + whole-program vjp through the tape
+            flat_p = [p._value for p in params]
+
+            def f(*pv):
+                return self._pure(pv, args_vals, kwargs_vals)
+
+            out = apply_op(f, *params, name=f"to_static:{self._fn.__name__}")
+            return _rewrap(out)
+
+        if self._jitted is None:
+            self._jitted = jax.jit(
+                lambda pv, av, kv: self._pure(pv, av, kv),
+            )
+        out_vals = self._jitted([p._value for p in params], args_vals, kwargs_vals)
+        return jax.tree_util.tree_map(lambda v: Tensor(v) if _is_arr(v) else v, out_vals)
+
+    @property
+    def code(self):
+        import inspect
+
+        try:
+            return inspect.getsource(self._fn)
+        except OSError:
+            return "<source unavailable>"
+
+    def concrete_program(self):
+        return self._jitted
+
+
+def _is_arr(v):
+    return isinstance(v, (jax.Array, np.ndarray)) or hasattr(v, "shape") and hasattr(v, "dtype")
+
+
+def _rewrap(out):
+    # apply_op returns Tensor or tuple of Tensors for tuple outputs
+    return out
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """Decorator/wrapper: compile a function or Layer.forward to one XLA program."""
+
+    def wrap(fn):
+        from paddle_tpu.nn.layer.layers import Layer
+
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, layer=fn, input_spec=input_spec, backend=backend)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, layer=None, input_spec=input_spec, backend=backend)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+# ---- compiler-friendly control flow (lax wrappers) ------------------------
+
+def cond(pred, true_fn, false_fn, *operands):
+    """paddle.static.nn.cond analog -> lax.cond (traceable branch select)."""
+    p = pred._value if isinstance(pred, Tensor) else pred
+    vals = _unwrap_tree(operands)
+
+    def tf(ops):
+        return _unwrap_tree(true_fn(*jax.tree_util.tree_map(Tensor, ops)))
+
+    def ff(ops):
+        return _unwrap_tree(false_fn(*jax.tree_util.tree_map(Tensor, ops)))
+
+    out = jax.lax.cond(p, tf, ff, vals)
+    return jax.tree_util.tree_map(Tensor, out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    vals = _unwrap_tree(loop_vars)
+
+    def c(v):
+        r = cond_fn(*jax.tree_util.tree_map(Tensor, v))
+        return r._value if isinstance(r, Tensor) else r
+
+    def b(v):
+        return _unwrap_tree(body_fn(*jax.tree_util.tree_map(Tensor, v)))
+
+    out = jax.lax.while_loop(c, b, vals)
+    return jax.tree_util.tree_map(Tensor, out)
+
+
+def scan(body_fn, init, xs):
+    init_v = _unwrap_tree(init)
+    xs_v = _unwrap_tree(xs)
+
+    def b(carry, x):
+        c, y = body_fn(jax.tree_util.tree_map(Tensor, carry), jax.tree_util.tree_map(Tensor, x))
+        return _unwrap_tree(c), _unwrap_tree(y)
+
+    carry, ys = jax.lax.scan(b, init_v, xs_v)
+    return jax.tree_util.tree_map(Tensor, carry), jax.tree_util.tree_map(Tensor, ys)
+
+
+# ---- save / load (deployment artifacts) -----------------------------------
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize a layer: params + config. (Reference: paddle.jit.save producing
+    inference programs; here the artifact is params + a module path, since XLA
+    recompiles the program from code at load time.)"""
+    from paddle_tpu.framework.io_ import save as _save
+
+    state = layer.state_dict() if hasattr(layer, "state_dict") else layer
+    _save({"state_dict": state, "class": type(layer).__module__ + "." + type(layer).__name__},
+          path + ".pdparams")
+
+
+def load(path, **configs):
+    from paddle_tpu.framework.io_ import load as _load
+
+    return _load(path + ".pdparams")

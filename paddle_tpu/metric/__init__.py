@@ -1,0 +1,112 @@
+"""Metrics (reference: python/paddle/metric — Accuracy/Precision/Recall/Auc)."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "accuracy"]
+
+
+def accuracy(input, label, k=1):
+    """Top-k accuracy (reference: python/paddle/metric/metrics.py accuracy)."""
+    import jax.numpy as jnp
+
+    logits = input._value
+    lab = label._value.reshape(-1)
+    topk_idx = jnp.argsort(logits, axis=-1)[..., ::-1][..., :k]
+    correct = (topk_idx == lab[:, None]).any(axis=-1)
+    return Tensor(jnp.mean(correct.astype(jnp.float32)))
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name="acc"):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        pred_np = np.asarray(pred._value if isinstance(pred, Tensor) else pred)
+        lab = np.asarray(label._value if isinstance(label, Tensor) else label).reshape(-1)
+        maxk = max(self.topk)
+        idx = np.argsort(-pred_np, axis=-1)[:, :maxk]
+        correct = idx == lab[:, None]
+        return Tensor(np.asarray(correct, np.float32))
+
+    def update(self, correct):
+        c = np.asarray(correct._value if isinstance(correct, Tensor) else correct)
+        for i, k in enumerate(self.topk):
+            self.total[i] += c[:, :k].any(axis=-1).sum()
+            self.count[i] += c.shape[0]
+        return self.accumulate()
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds) > 0.5
+        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels).astype(bool)
+        self.tp += int((p & l).sum())
+        self.fp += int((p & ~l).sum())
+
+    def accumulate(self):
+        ap = self.tp + self.fp
+        return self.tp / ap if ap else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds) > 0.5
+        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels).astype(bool)
+        self.tp += int((p & l).sum())
+        self.fn += int((~p & l).sum())
+
+    def accumulate(self):
+        al = self.tp + self.fn
+        return self.tp / al if al else 0.0
+
+    def name(self):
+        return self._name

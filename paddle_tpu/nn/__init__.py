@@ -1,0 +1,40 @@
+"""paddle_tpu.nn — layers + functional (reference: python/paddle/nn)."""
+from paddle_tpu.nn.layer.layers import (  # noqa: F401
+    Identity, Layer, LayerDict, LayerList, Parameter, ParameterList, Sequential,
+)
+from paddle_tpu.nn.layer.common import (  # noqa: F401
+    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Embedding,
+    Flatten, Linear, Pad1D, Pad2D, Unfold, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D,
+)
+from paddle_tpu.nn.layer.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D  # noqa: F401
+from paddle_tpu.nn.layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm2D,
+    LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm,
+)
+from paddle_tpu.nn.layer.pooling import (  # noqa: F401
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D,
+    AvgPool2D, MaxPool1D, MaxPool2D,
+)
+from paddle_tpu.nn.layer.activation import (  # noqa: F401
+    CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
+    LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6, SELU,
+    Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign, Swish, Tanh,
+    Tanhshrink, ThresholdedReLU,
+)
+from paddle_tpu.nn.layer.loss import (  # noqa: F401
+    BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
+    HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss, NLLLoss,
+    SmoothL1Loss, TripletMarginLoss,
+)
+from paddle_tpu.nn.layer.transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
+    TransformerEncoder, TransformerEncoderLayer,
+)
+from paddle_tpu.nn.layer.rnn import GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell  # noqa: F401
+
+from paddle_tpu.nn import functional  # noqa: F401
+from paddle_tpu.nn import initializer  # noqa: F401
+from paddle_tpu.nn.initializer import ParamAttr  # noqa: F401
+from paddle_tpu.nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from paddle_tpu.nn.utils_ import parameters_to_vector, vector_to_parameters  # noqa: F401

@@ -1,0 +1,979 @@
+"""Functional NN ops (reference: python/paddle/nn/functional).
+
+Every function lowers to XLA-friendly jax ops: convs via lax.conv_general_dilated
+(MXU), attention via Pallas flash attention when available (reference analog:
+nn/functional/flash_attention.py:147 wrapping third_party/flashattn), with an
+XLA softmax fallback. NCHW layout is the API default (paddle convention); XLA
+re-lays-out internally for the TPU.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dtype import to_jax_dtype
+from paddle_tpu.core.flags import flag
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.ops.random_state import default_generator
+
+__all__ = [
+    # activations
+    "relu", "relu6", "gelu", "sigmoid", "silu", "swish", "tanh", "softmax",
+    "log_softmax", "leaky_relu", "elu", "selu", "celu", "hardshrink",
+    "hardsigmoid", "hardswish", "hardtanh", "mish", "softplus", "softshrink",
+    "softsign", "tanhshrink", "thresholded_relu", "log_sigmoid", "glu",
+    "prelu", "rrelu", "maxout",
+    # linear / embedding
+    "linear", "embedding", "one_hot", "bilinear",
+    # conv / pool
+    "conv1d", "conv2d", "conv3d", "conv2d_transpose", "max_pool1d",
+    "max_pool2d", "avg_pool1d", "avg_pool2d", "adaptive_avg_pool1d",
+    "adaptive_avg_pool2d", "adaptive_max_pool2d", "unfold", "interpolate",
+    "upsample", "pixel_shuffle",
+    # norm
+    "layer_norm", "batch_norm", "instance_norm", "group_norm", "rms_norm",
+    "local_response_norm", "normalize",
+    # dropout
+    "dropout", "dropout2d", "alpha_dropout",
+    # losses
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "cosine_similarity",
+    "ctc_loss", "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+    "label_smooth", "square_error_cost", "sigmoid_focal_loss",
+    # attention
+    "scaled_dot_product_attention", "flash_attention", "sequence_mask", "pad",
+    "temperature_scaled_softmax",
+]
+
+from paddle_tpu.ops.manipulation import pad  # noqa: F401  (re-export)
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+def _act(fn, name):
+    def op(x, *args, **kwargs):
+        return apply_op(lambda v: fn(v, *args, **kwargs), _t(x), name=name)
+
+    op.__name__ = name
+    return op
+
+
+relu = _act(jax.nn.relu, "relu")
+relu6 = _act(jax.nn.relu6, "relu6")
+sigmoid = _act(jax.nn.sigmoid, "sigmoid")
+silu = _act(jax.nn.silu, "silu")
+swish = _act(jax.nn.silu, "swish")
+tanh = _act(jnp.tanh, "tanh")
+softplus = _act(jax.nn.softplus, "softplus")
+softsign = _act(jax.nn.soft_sign, "softsign")
+log_sigmoid = _act(jax.nn.log_sigmoid, "log_sigmoid")
+mish = _act(jax.nn.mish, "mish")
+
+
+def gelu(x, approximate=False):
+    return apply_op(lambda v: jax.nn.gelu(v, approximate=approximate), _t(x), name="gelu")
+
+
+def softmax(x, axis=-1, dtype=None):
+    d = to_jax_dtype(dtype)
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply_op(f, _t(x), name="softmax")
+
+
+def temperature_scaled_softmax(x, temperature=1.0, axis=-1):
+    return apply_op(lambda v: jax.nn.softmax(v / temperature, axis=axis), _t(x), name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None):
+    d = to_jax_dtype(dtype)
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply_op(f, _t(x), name="log_softmax")
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return apply_op(lambda v: jax.nn.leaky_relu(v, negative_slope), _t(x), name="leaky_relu")
+
+
+def elu(x, alpha=1.0):
+    return apply_op(lambda v: jax.nn.elu(v, alpha), _t(x), name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return apply_op(
+        lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), _t(x), name="selu"
+    )
+
+
+def celu(x, alpha=1.0):
+    return apply_op(lambda v: jax.nn.celu(v, alpha), _t(x), name="celu")
+
+
+def hardshrink(x, threshold=0.5):
+    return apply_op(
+        lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), _t(x), name="hardshrink"
+    )
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5):
+    return apply_op(
+        lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), _t(x), name="hardsigmoid"
+    )
+
+
+def hardswish(x):
+    return apply_op(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, _t(x), name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return apply_op(lambda v: jnp.clip(v, min, max), _t(x), name="hardtanh")
+
+
+def softshrink(x, threshold=0.5):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)),
+        _t(x), name="softshrink",
+    )
+
+
+def tanhshrink(x):
+    return apply_op(lambda v: v - jnp.tanh(v), _t(x), name="tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0):
+    return apply_op(lambda v: jnp.where(v > threshold, v, 0.0), _t(x), name="thresholded_relu")
+
+
+def glu(x, axis=-1):
+    def f(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+
+    return apply_op(f, _t(x), name="glu")
+
+
+def prelu(x, weight):
+    return apply_op(
+        lambda v, w: jnp.where(v > 0, v, _reshape_prelu(w, v) * v), _t(x), _t(weight), name="prelu"
+    )
+
+
+def _reshape_prelu(w, v):
+    if w.size == 1:
+        return w.reshape(())
+    shape = [1] * v.ndim
+    shape[1] = w.size
+    return w.reshape(shape)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True):
+    if not training:
+        return apply_op(lambda v: jnp.where(v >= 0, v, v * (lower + upper) / 2), _t(x), name="rrelu")
+    key = default_generator.next_key()
+
+    def f(v):
+        slope = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+        return jnp.where(v >= 0, v, v * slope)
+
+    return apply_op(f, _t(x), name="rrelu")
+
+
+def maxout(x, groups, axis=1):
+    def f(v):
+        shape = list(v.shape)
+        c = shape[axis]
+        shape[axis] = c // groups
+        shape.insert(axis + 1, groups)
+        return jnp.max(v.reshape(shape), axis=axis + 1)
+
+    return apply_op(f, _t(x), name="maxout")
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; W is [in, out] (paddle convention, nn/functional/common.py)."""
+    from paddle_tpu.ops.linalg import _prec
+
+    if bias is None:
+        return apply_op(lambda v, w: jnp.matmul(v, w, precision=_prec()), _t(x), _t(weight), name="linear")
+    return apply_op(
+        lambda v, w, b: jnp.matmul(v, w, precision=_prec()) + b,
+        _t(x), _t(weight), _t(bias), name="linear",
+    )
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    def f(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply_op(f, _t(x), _t(weight), name="embedding")
+
+
+def one_hot(x, num_classes):
+    from paddle_tpu.ops.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    def f(a, b, w):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out
+
+    out = apply_op(f, _t(x1), _t(x2), _t(weight), name="bilinear")
+    if bias is not None:
+        out = out + _t(bias)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# convolution / pooling
+# ---------------------------------------------------------------------------
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    strides = _pair(stride, nd)
+    dils = _pair(dilation, nd)
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()  # SAME / VALID
+    else:
+        p = _pair(padding, nd) if not (isinstance(padding, (list, tuple)) and isinstance(padding[0], (list, tuple))) else padding
+        pad_cfg = [(int(pi), int(pi)) for pi in p] if not isinstance(p[0], tuple) else p
+    chan = "NCHW"[: 2 + nd] if nd == 2 else ("NCH" if nd == 1 else "NCDHW")
+    if nd == 1:
+        dn = jax.lax.conv_dimension_numbers(x._value.shape, weight._value.shape, ("NCH", "OIH", "NCH"))
+    elif nd == 2:
+        dn = jax.lax.conv_dimension_numbers(x._value.shape, weight._value.shape, ("NCHW", "OIHW", "NCHW"))
+    else:
+        dn = jax.lax.conv_dimension_numbers(x._value.shape, weight._value.shape, ("NCDHW", "OIDHW", "NCDHW"))
+
+    def f(v, w, *maybe_b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad_cfg,
+            rhs_dilation=dils, dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if maybe_b:
+            b = maybe_b[0]
+            out = out + b.reshape((1, -1) + (1,) * nd)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply_op(f, *[_t(a) for a in args], name=f"conv{nd}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL"):
+    return _conv_nd(_t(x), _t(weight), bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW"):
+    if data_format == "NHWC":
+        x = _t(x).transpose([0, 3, 1, 2])
+        out = _conv_nd(x, _t(weight), bias, stride, padding, dilation, groups, 2, "NCHW")
+        return out.transpose([0, 2, 3, 1])
+    return _conv_nd(_t(x), _t(weight), bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW"):
+    return _conv_nd(_t(x), _t(weight), bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, output_size=None, data_format="NCHW"):
+    strides = _pair(stride)
+    pads = _pair(padding)
+    dils = _pair(dilation)
+    dn = jax.lax.conv_dimension_numbers(x._value.shape if isinstance(x, Tensor) else x.shape,
+                                        weight._value.shape if isinstance(weight, Tensor) else weight.shape,
+                                        ("NCHW", "IOHW", "NCHW"))
+    opad = _pair(output_padding)
+    pad_cfg = [
+        (dils[i] * (  # transpose conv padding transform
+            (weight._value.shape[2 + i] - 1)) - pads[i],
+         dils[i] * ((weight._value.shape[2 + i] - 1)) - pads[i] + opad[i])
+        for i in range(2)
+    ]
+
+    def f(v, w, *maybe_b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=(1, 1), padding=pad_cfg, lhs_dilation=strides,
+            rhs_dilation=dils, dimension_numbers=dn, feature_group_count=groups,
+        )
+        # IOHW kernel: flip spatial dims for true transpose semantics
+        if maybe_b:
+            out = out + maybe_b[0].reshape(1, -1, 1, 1)
+        return out
+
+    w = _t(weight)
+    wv = jnp.flip(w._value, axis=(2, 3))
+    wt = Tensor(wv, stop_gradient=w.stop_gradient)
+    wt._grad_node = None
+    # keep autograd: express flip as an op on the original weight
+    flip_w = apply_op(lambda u: jnp.flip(u, axis=(2, 3)), w, name="flip")
+    args = (_t(x), flip_w) if bias is None else (_t(x), flip_w, _t(bias))
+    return apply_op(f, *args, name="conv2d_transpose")
+
+
+def _pool(x, kernel, stride, padding, nd, reducer, init, data_format, count_include_pad=True, ceil_mode=False):
+    ks = _pair(kernel, nd)
+    st = _pair(stride if stride is not None else kernel, nd)
+    pd = _pair(padding, nd)
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+
+    def f(v):
+        if reducer == "max":
+            return jax.lax.reduce_window(v, -jnp.inf, jax.lax.max, window, strides, pads)
+        s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, pads)
+        if count_include_pad:
+            return s / float(np.prod(ks))
+        ones = jnp.ones_like(v)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return s / cnt
+
+    return apply_op(f, _t(x), name=f"{reducer}_pool{nd}d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, "max", -np.inf, data_format, ceil_mode=ceil_mode)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False):
+    return _pool(x, kernel_size, stride, padding, 1, "max", -np.inf, "NCL", ceil_mode=ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", 0.0, data_format,
+                 count_include_pad=not exclusive or padding == 0)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", 0.0, "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    os = _pair(output_size)
+    x = _t(x)
+    h, w = x._value.shape[2], x._value.shape[3]
+    if h % os[0] == 0 and w % os[1] == 0:
+        return _pool(x, (h // os[0], w // os[1]), (h // os[0], w // os[1]), 0, 2, "avg", 0.0, data_format)
+    # general: mean over computed windows via interpolation-style reduction
+    def f(v):
+        vh = v.reshape(v.shape[0], v.shape[1], os[0], h // os[0] if h % os[0] == 0 else -1, w)
+        raise NotImplementedError("adaptive_avg_pool2d requires divisible sizes for now")
+
+    return apply_op(f, x, name="adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool1d(x, output_size):
+    x = _t(x)
+    l = x._value.shape[2]
+    os = int(output_size)
+    return _pool(x, l // os, l // os, 0, 1, "avg", 0.0, "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False):
+    os = _pair(output_size)
+    x = _t(x)
+    h, w = x._value.shape[2], x._value.shape[3]
+    return _pool(x, (h // os[0], w // os[1]), (h // os[0], w // os[1]), 0, 2, "max", -np.inf, "NCHW")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    dl = _pair(dilations)
+
+    def f(v):
+        n, c, h, w = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=ks, window_strides=st,
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=dl,
+            dimension_numbers=jax.lax.conv_dimension_numbers(v.shape, (1, 1) + ks, ("NCHW", "OIHW", "NCHW")),
+        )
+        # [N, C*kh*kw, OH, OW] -> [N, C*kh*kw, L]
+        return patches.reshape(n, patches.shape[1], -1)
+
+    return apply_op(f, _t(x), name="unfold")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                data_format="NCHW"):
+    x = _t(x)
+    n, c, h, w = x._value.shape
+    if size is None:
+        sf = _pair(scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    else:
+        size = _pair(size)
+    method = {"nearest": "nearest", "bilinear": "bilinear", "bicubic": "cubic",
+              "linear": "linear", "area": "nearest"}[mode]
+
+    def f(v):
+        return jax.image.resize(v, (v.shape[0], v.shape[1], size[0], size[1]), method=method)
+
+    return apply_op(f, x, name="interpolate")
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = int(upscale_factor)
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c // (r * r), r, r, h, w)
+        v = v.transpose(0, 1, 4, 2, 5, 3)
+        return v.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply_op(f, _t(x), name="pixel_shuffle")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    nd = len(tuple(normalized_shape))
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - nd, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v - mean), axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        if wb:
+            if len(wb) == 2:
+                out = out * wb[0] + wb[1]
+            elif weight is not None:
+                out = out * wb[0]
+            else:
+                out = out + wb[0]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(f, *[_t(a) for a in args], name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1):
+    """RMSNorm (LLaMA-family); fused by XLA, with a Pallas kernel available via
+    paddle_tpu.ops.pallas.rmsnorm for long rows."""
+
+    def f(v, *w):
+        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=axis, keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = [x] if weight is None else [x, weight]
+    return apply_op(f, *[_t(a) for a in args], name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None):
+    x = _t(x)
+    nd = x._value.ndim
+    axes = tuple(i for i in range(nd) if i != 1)
+    shape = [1] * nd
+    shape[1] = x._value.shape[1]
+
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        def f(v, *wb):
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+            out = (v - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(shape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(shape)
+            return out, mean, var
+
+        args = [x] + [_t(a) for a in (weight, bias) if a is not None]
+        out, mean, var = apply_op(f, *args, name="batch_norm")
+        # update running stats host-side (buffers)
+        if running_mean is not None:
+            running_mean._set_value(momentum * running_mean._value + (1 - momentum) * mean._value)
+            running_var._set_value(momentum * running_var._value + (1 - momentum) * var._value)
+        return out
+
+    def f(v, m, va, *wb):
+        out = (v - m.reshape(shape)) * jax.lax.rsqrt(va.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x, _t(running_mean), _t(running_var)] + [_t(a) for a in (weight, bias) if a is not None]
+    return apply_op(f, *args, name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW"):
+    x = _t(x)
+    nd = x._value.ndim
+    axes = tuple(range(2, nd))
+    shape = [1, x._value.shape[1]] + [1] * (nd - 2)
+
+    def f(v, *wb):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x] + [_t(a) for a in (weight, bias) if a is not None]
+    return apply_op(f, *args, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW"):
+    x = _t(x)
+
+    def f(v, *wb):
+        n, c = v.shape[0], v.shape[1]
+        rest = v.shape[2:]
+        g = v.reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, c] + [1] * len(rest)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x] + [_t(a) for a in (weight, bias) if a is not None]
+    return apply_op(f, *args, name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW"):
+    def f(v):
+        sq = jnp.square(v)
+        half = size // 2
+        pads = ((0, 0), (half, size - half - 1), (0, 0), (0, 0))
+        s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, (1, size, 1, 1), (1, 1, 1, 1), pads)
+        return v / jnp.power(k + alpha * s / size, beta)
+
+    return apply_op(f, _t(x), name="local_response_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    def f(v):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=True), 1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply_op(f, _t(x), name="normalize")
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    from paddle_tpu.distributed.fleet.rng import current_dropout_key
+
+    key = current_dropout_key()
+
+    def f(v):
+        shape = v.shape
+        if axis is not None:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            shape = tuple(s if i in axes else 1 for i, s in enumerate(v.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0)
+        return jnp.where(keep, v, 0.0)
+
+    return apply_op(f, _t(x), name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    return dropout(x, p, axis=(0, 1), training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return _t(x)
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = default_generator.next_key()
+
+    def f(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2))) if p < 1 else 0.0
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, alpha_p) + b
+
+    return apply_op(f, _t(x), name="alpha_dropout")
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0):
+    """reference: python/paddle/nn/functional/loss.py cross_entropy."""
+
+    def f(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+        nclass = logits.shape[axis]
+        if soft_label:
+            soft = lab
+        else:
+            li = lab
+            if li.ndim == logp.ndim:  # [..., 1]
+                li = jnp.squeeze(li, axis)
+            soft = jax.nn.one_hot(li, nclass, dtype=logp.dtype, axis=axis)
+        if label_smoothing > 0.0:
+            soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+        nll = -jnp.sum(soft * logp, axis=axis)
+        if not soft_label:
+            li = lab
+            if li.ndim == logp.ndim:
+                li = jnp.squeeze(li, axis)
+            valid = li != ignore_index
+            nll = jnp.where(valid, nll, 0.0)
+            if w:
+                cw = jnp.take(w[0], jnp.clip(li, 0, nclass - 1))
+                nll = nll * cw
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(valid, cw, 0.0))
+                    return jnp.sum(nll) / jnp.maximum(denom, 1e-12)
+            if reduction == "mean":
+                return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(nll.dtype)), 1.0)
+        return _reduce(nll, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply_op(f, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    def f(p, y, *w):
+        val = -(y * jnp.log(jnp.clip(p, 1e-12, 1.0)) + (1 - y) * jnp.log(jnp.clip(1 - p, 1e-12, 1.0)))
+        if w:
+            val = val * w[0]
+        return _reduce(val, reduction)
+
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+    return apply_op(f, *args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None):
+    def f(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]; i += 1
+        log_sig = jax.nn.log_sigmoid(z)
+        log_one_minus = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            val = -(pw * y * log_sig + (1 - y) * log_one_minus)
+        else:
+            val = -(y * log_sig + (1 - y) * log_one_minus)
+        if w is not None:
+            val = val * w
+        return _reduce(val, reduction)
+
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply_op(f, *args, name="bce_with_logits")
+
+
+def mse_loss(input, label, reduction="mean"):
+    return apply_op(
+        lambda a, b: _reduce(jnp.square(a - b), reduction), _t(input), _t(label), name="mse_loss"
+    )
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), _t(input), _t(label), name="square_error_cost")
+
+
+def l1_loss(input, label, reduction="mean"):
+    return apply_op(
+        lambda a, b: _reduce(jnp.abs(a - b), reduction), _t(input), _t(label), name="l1_loss"
+    )
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    def f(logp, lab, *w):
+        nclass = logp.shape[-1]
+        oh = jax.nn.one_hot(lab, nclass, dtype=logp.dtype)
+        nll = -jnp.sum(oh * logp, axis=-1)
+        valid = lab != ignore_index
+        nll = jnp.where(valid, nll, 0.0)
+        if w:
+            cw = jnp.take(w[0], jnp.clip(lab, 0, nclass - 1))
+            nll = nll * cw
+        if reduction == "mean":
+            denom = jnp.sum(valid.astype(nll.dtype)) if not w else jnp.sum(jnp.where(valid, jnp.take(w[0], jnp.clip(lab, 0, nclass - 1)), 0.0))
+            return jnp.sum(nll) / jnp.maximum(denom, 1e-12)
+        return _reduce(nll, reduction)
+
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+    return apply_op(f, *args, name="nll_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        val = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(val, reduction)
+
+    return apply_op(f, _t(input), _t(label), name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction="mean", log_target=False):
+    def f(logp, q):
+        if log_target:
+            val = jnp.exp(q) * (q - logp)
+        else:
+            val = q * (jnp.log(jnp.clip(q, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(val) / logp.shape[0]
+        return _reduce(val, reduction)
+
+    return apply_op(f, _t(input), _t(label), name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        _t(input), _t(other), _t(label), name="margin_ranking_loss",
+    )
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply_op(f, _t(x1), _t(x2), name="cosine_similarity")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    return apply_op(
+        lambda x, y: _reduce(jnp.where(y == 1, x, jnp.maximum(0.0, margin - x)), reduction),
+        _t(input), _t(label), name="hinge_embedding_loss",
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        val = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(val, reduction)
+
+    return apply_op(f, _t(input1), _t(input2), _t(label), name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, eps=1e-6,
+                        swap=False, reduction="mean"):
+    def f(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + eps, p), axis=-1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + eps, p), axis=-1), 1 / p)
+        if swap:
+            dsn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + eps, p), axis=-1), 1 / p)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(f, _t(input), _t(positive), _t(negative), name="triplet_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum"):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        val = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            val = val / n[0]
+        return _reduce(val, reduction)
+
+    args = [_t(logit), _t(label)] + ([_t(normalizer)] if normalizer is not None else [])
+    return apply_op(f, *args, name="sigmoid_focal_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean"):
+    raise NotImplementedError(
+        "ctc_loss: planned via optax.ctc_loss integration; not yet wired"
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    def f(y, *pd):
+        n = y.shape[-1]
+        if pd:
+            return (1 - epsilon) * y + epsilon * pd[0]
+        return (1 - epsilon) * y + epsilon / n
+
+    args = [_t(label)] + ([_t(prior_dist)] if prior_dist is not None else [])
+    return apply_op(f, *args, name="label_smooth")
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    l = _t(lengths)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(l._value))
+    d = to_jax_dtype(dtype)
+    return apply_op(
+        lambda v: (jnp.arange(m)[None, :] < v[:, None]).astype(d), l, name="sequence_mask"
+    )
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """reference: nn/functional/flash_attention.py:722 scaled_dot_product_attention.
+
+    Layout: [batch, seq, heads, head_dim] (paddle flash-attention convention).
+    Uses the Pallas flash-attention kernel on TPU when enabled+applicable,
+    else an XLA fallback (fused by the compiler; memory O(S^2) only at trace).
+    """
+    if flag("use_pallas_attention") and dropout_p == 0.0 and attn_mask is None:
+        try:
+            from paddle_tpu.ops.pallas.flash_attention import flash_attention_bshd
+
+            q, k, v = _t(query), _t(key), _t(value)
+            return apply_op(
+                lambda a, b, c: flash_attention_bshd(a, b, c, causal=is_causal),
+                q, k, v, name="flash_attention",
+            )
+        except Exception:
+            pass  # fall back to XLA path below
+
+    def f(q, k, v, *m):
+        # [B,S,H,D] -> [B,H,S,D]
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        scores = jnp.einsum("bhsd,bhtd->bhst", qh, kh) / math.sqrt(q.shape[-1])
+        if is_causal:
+            s, t = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((s, t), bool))
+            scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+        if m:
+            mask = m[0]
+            if mask.dtype == jnp.bool_:
+                scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+            else:
+                scores = scores + mask
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+        return jnp.swapaxes(out, 1, 2)
+
+    args = [_t(query), _t(key), _t(value)]
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+    out = apply_op(f, *args, name="sdpa")
+    if dropout_p > 0.0 and training:
+        out = dropout(out, dropout_p, training=training)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """reference: nn/functional/flash_attention.py:147."""
+    out = scaled_dot_product_attention(
+        query, key, value, dropout_p=dropout, is_causal=causal, training=training
+    )
+    if return_softmax:
+        return out, None
+    return out, None

@@ -1,0 +1,172 @@
+"""Weight initializers (reference: python/paddle/nn/initializer) + ParamAttr
+(reference: python/paddle/base/param_attr.py). Initializers are callables
+(shape, jax_dtype) -> jax array, drawing from the global generator so
+`paddle_tpu.seed` makes init deterministic."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.random_state import default_generator
+
+__all__ = [
+    "ParamAttr", "Initializer", "Constant", "Normal", "TruncatedNormal",
+    "Uniform", "XavierNormal", "XavierUniform", "KaimingNormal",
+    "KaimingUniform", "Assign", "Orthogonal", "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0, "conv3d": 1.0,
+        "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    return gains[nonlinearity]
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle linear weights are [in, out]
+        return shape[0], shape[1]
+    # conv [out_c, in_c, *k]
+    rf = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    return shape[1] * rf, shape[0] * rf
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(shape), self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        key = default_generator.next_key()
+        return self.mean + self.std * jax.random.normal(key, tuple(shape), dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        key = default_generator.next_key()
+        return self.mean + self.std * jax.random.truncated_normal(
+            key, self.a, self.b, tuple(shape), dtype
+        )
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        key = default_generator.next_key()
+        return jax.random.uniform(key, tuple(shape), dtype, self.low, self.high)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        key = default_generator.next_key()
+        return jax.random.uniform(key, tuple(shape), dtype, -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        key = default_generator.next_key()
+        return std * jax.random.normal(key, tuple(shape), dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        limit = self.gain * math.sqrt(3.0 / fi)
+        key = default_generator.next_key()
+        return jax.random.uniform(key, tuple(shape), dtype, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        std = self.gain / math.sqrt(fi)
+        key = default_generator.next_key()
+        return std * jax.random.normal(key, tuple(shape), dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        from paddle_tpu.core.tensor import Tensor
+
+        v = self.value._value if isinstance(self.value, Tensor) else np.asarray(self.value)
+        arr = jnp.asarray(v, dtype)
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(tuple(shape))
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        key = default_generator.next_key()
+        return self.gain * jax.nn.initializers.orthogonal()(key, tuple(shape), dtype)
+
+
+class ParamAttr:
+    """reference: python/paddle/base/param_attr.py ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

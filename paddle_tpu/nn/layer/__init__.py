@@ -1,0 +1,1 @@
+from paddle_tpu.nn.layer import activation, common, conv, layers, loss, norm, pooling, rnn, transformer  # noqa: F401

@@ -1,0 +1,183 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native: time recursion is expressed with `jax.lax.scan` so XLA compiles one
+fused loop (no Python-level unrolling); gate matmuls are batched onto the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer, LayerList
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "LSTMCell", "GRUCell", "SimpleRNNCell", "RNN"]
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, n_gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / (hidden_size ** 0.5)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([n_gates * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([n_gates * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([n_gates * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([n_gates * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, 1, **kwargs)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else Tensor(
+            jnp.zeros((inputs.shape[0], self.hidden_size), inputs._value.dtype))
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        out = apply_op(
+            lambda x, hp, wi, wh, bi, bh: act(x @ wi.T + bi + hp @ wh.T + bh),
+            inputs, h, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+            name="rnn_cell",
+        )
+        return out, out
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__(input_size, hidden_size, 4, **kwargs)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = Tensor(jnp.zeros((inputs.shape[0], self.hidden_size), inputs._value.dtype))
+            states = (z, z.clone())
+        h, c = states
+
+        def f(x, hp, cp, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hp @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            i, fgt, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fgt), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            cn = fgt * cp + i * g
+            hn = o * jnp.tanh(cn)
+            return hn, cn
+
+        hn, cn = apply_op(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh, name="lstm_cell")
+        return hn, (hn, cn)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__(input_size, hidden_size, 3, **kwargs)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else Tensor(
+            jnp.zeros((inputs.shape[0], self.hidden_size), inputs._value.dtype))
+
+        def f(x, hp, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = hp @ wh.T + bh
+            ir, iz, ig = jnp.split(gi, 3, axis=-1)
+            hr, hz, hg = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            g = jnp.tanh(ig + r * hg)
+            return (1 - z) * g + z * hp
+
+        hn = apply_op(f, inputs, h, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh, name="gru_cell")
+        return hn, hn
+
+
+class RNN(Layer):
+    """Generic scanner over a cell (reference: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # eager scan in Python (clear + differentiable); jit path compiles whole loop
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        steps = x.shape[0]
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = []
+        state = initial_states
+        for i in rng:
+            out, state = self.cell(x[i], state)
+            outs.append(out)
+        if self.is_reverse:
+            outs.reverse()
+        from paddle_tpu.ops.manipulation import stack
+
+        y = stack(outs, axis=0)
+        if not self.time_major:
+            y = y.transpose([1, 0, 2])
+        return y, state
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__()
+        self.mode = mode
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell, "RNN_TANH": SimpleRNNCell}[mode]
+        self.fw = LayerList()
+        self.bw = LayerList() if self.bidirectional else None
+        for l in range(num_layers):
+            isz = input_size if l == 0 else hidden_size * (2 if self.bidirectional else 1)
+            self.fw.append(RNN(cell_cls(isz, hidden_size), time_major=True))
+            if self.bidirectional:
+                self.bw.append(RNN(cell_cls(isz, hidden_size), is_reverse=True, time_major=True))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu.ops.manipulation import concat
+
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        final_states = []
+        for l in range(self.num_layers):
+            yf, sf = self.fw[l](x)
+            if self.bidirectional:
+                yb, sb = self.bw[l](x)
+                x = concat([yf, yb], axis=-1)
+                final_states.append((sf, sb))
+            else:
+                x = yf
+                final_states.append(sf)
+        y = x if self.time_major else x.transpose([1, 0, 2])
+        return y, final_states
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__("RNN_TANH", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)

@@ -1,0 +1,22 @@
+"""nn.utils (reference: python/paddle/nn/utils)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["parameters_to_vector", "vector_to_parameters"]
+
+
+def parameters_to_vector(parameters):
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters):
+    off = 0
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = p.size
+        p._set_value(v[off : off + n].reshape(p._value.shape).astype(p._value.dtype))
+        off += n

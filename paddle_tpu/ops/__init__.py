@@ -1,0 +1,159 @@
+"""Op library + Tensor method installation.
+
+Mirrors the reference flow where ops.yaml codegen attaches per-op methods to the
+eager tensor (python_c_gen.py -> core.eager.ops -> monkey-patched tensor methods
+in python/paddle/tensor/__init__.py). Here the op library is plain Python over
+jax; `install_tensor_methods()` attaches the method surface once at import."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+from paddle_tpu.ops import (  # noqa: F401
+    comparison,
+    creation,
+    linalg,
+    manipulation,
+    math,
+    reduction,
+)
+from paddle_tpu.ops.comparison import *  # noqa: F401,F403
+from paddle_tpu.ops.creation import *  # noqa: F401,F403
+from paddle_tpu.ops.linalg import *  # noqa: F401,F403
+from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
+from paddle_tpu.ops.math import *  # noqa: F401,F403
+from paddle_tpu.ops.reduction import *  # noqa: F401,F403
+from paddle_tpu.ops.random_state import seed  # noqa: F401
+
+
+def _coerce_index(idx):
+    """Convert Tensors inside an index expression to raw arrays (constants)."""
+    if isinstance(idx, Tensor):
+        return np.asarray(idx._value) if idx._value.dtype == np.bool_ else idx._value
+    if isinstance(idx, tuple):
+        return tuple(_coerce_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+def _getitem(self, idx):
+    cidx = _coerce_index(idx)
+    if isinstance(cidx, np.ndarray) and cidx.dtype == np.bool_:
+        # boolean mask -> dynamic shape; host-side gather
+        return Tensor(jnp.asarray(np.asarray(self._value)[cidx]), stop_gradient=True)
+    return apply_op(lambda v: v[cidx], self, name="getitem")
+
+
+def _setitem(self, idx, value):
+    cidx = _coerce_index(idx)
+    val = value._value if isinstance(value, Tensor) else value
+    if not self.stop_gradient and self._grad_node is not None:
+        # differentiable in-place update: record as an op rewriting this tensor
+        out = apply_op(lambda v, u: v.at[cidx].set(jnp.asarray(u, v.dtype)),
+                       self, value if isinstance(value, Tensor) else Tensor(jnp.asarray(val)),
+                       name="setitem")
+        self._set_value(out._value)
+        self._grad_node = out._grad_node
+        self._output_index = out._output_index
+        return
+    self._set_value(self._value.at[cidx].set(jnp.asarray(val, self._value.dtype)))
+
+
+_BINARY = {
+    "__add__": math.add,
+    "__sub__": math.subtract,
+    "__mul__": math.multiply,
+    "__truediv__": math.divide,
+    "__floordiv__": math.floor_divide,
+    "__mod__": math.remainder,
+    "__matmul__": linalg.matmul,
+    "__pow__": math.pow,
+    "__lt__": comparison.less_than,
+    "__le__": comparison.less_equal,
+    "__gt__": comparison.greater_than,
+    "__ge__": comparison.greater_equal,
+    "__and__": comparison.logical_and,
+    "__or__": comparison.logical_or,
+    "__xor__": comparison.logical_xor,
+}
+
+_RBINARY = {
+    "__radd__": lambda x, y: math.add(y if isinstance(y, Tensor) else Tensor(jnp.asarray(y, x._value.dtype)), x),
+    "__rsub__": lambda x, y: math.subtract(Tensor(jnp.asarray(y, x._value.dtype)), x),
+    "__rmul__": lambda x, y: math.multiply(Tensor(jnp.asarray(y, x._value.dtype)), x),
+    "__rtruediv__": lambda x, y: math.divide(Tensor(jnp.asarray(y, x._value.dtype)), x),
+    "__rpow__": lambda x, y: math.pow(Tensor(jnp.asarray(y, x._value.dtype)), x),
+    "__rmatmul__": lambda x, y: linalg.matmul(Tensor(jnp.asarray(y)), x),
+}
+
+
+_METHODS = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "abs",
+    "sign", "square", "reciprocal", "floor", "ceil", "round", "trunc", "sin",
+    "cos", "tan", "tanh", "erf", "maximum", "minimum", "clip", "scale",
+    "isnan", "isinf", "isfinite", "lerp", "expm1", "sinh", "cosh", "asin",
+    "acos", "atan",
+    # reduction
+    "sum", "mean", "max", "min", "prod", "argmax", "argmin", "all", "any",
+    "logsumexp", "std", "var", "cumsum", "cumprod", "median",
+    # manipulation
+    "reshape", "transpose", "squeeze", "unsqueeze", "flatten", "cast",
+    "gather", "gather_nd", "scatter", "index_select", "tile", "expand",
+    "expand_as", "broadcast_to", "flip", "roll", "split", "chunk", "topk",
+    "sort", "argsort", "unbind", "numel", "take_along_axis", "put_along_axis",
+    "masked_fill", "repeat_interleave", "flatten", "pad", "where",
+    "tensor_split", "view", "view_as", "moveaxis",
+    # linalg
+    "matmul", "mm", "bmm", "dot", "norm", "dist", "inv", "cholesky", "det",
+    "outer", "kron", "mv",
+    # comparison
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not", "allclose",
+    "isclose", "equal_all", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not",
+    # creation-ish
+    "tril", "triu",
+]
+
+_installed = False
+
+
+def install_tensor_methods():
+    global _installed
+    if _installed:
+        return
+    import paddle_tpu.ops as _ops_mod
+
+    for name, fn in _BINARY.items():
+        setattr(Tensor, name, (lambda f: lambda self, other: f(self, other))(fn))
+    for name, fn in _RBINARY.items():
+        setattr(Tensor, name, (lambda f: lambda self, other: f(self, other))(fn))
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__invert__ = lambda self: comparison.logical_not(self)
+    Tensor.__eq__ = lambda self, other: comparison.equal(self, other)
+    Tensor.__ne__ = lambda self, other: comparison.not_equal(self, other)
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+
+    for name in _METHODS:
+        fn = getattr(_ops_mod, name, None)
+        if fn is None:
+            continue
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, (lambda f: lambda self, *a, **k: f(self, *a, **k))(fn))
+
+    def astype(self, dtype):
+        return manipulation.cast(self, dtype)
+
+    Tensor.astype = astype
+    Tensor.item = Tensor.item  # keep
+    _installed = True
+
+
+install_tensor_methods()

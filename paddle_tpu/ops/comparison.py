@@ -1,0 +1,75 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "is_empty",
+]
+
+
+def _t(x, like=None):
+    if isinstance(x, Tensor):
+        return x
+    if like is not None:
+        return Tensor(jnp.asarray(x, like._value.dtype))
+    return Tensor(jnp.asarray(x))
+
+
+def _cmp(fn, name):
+    def op(x, y):
+        x = _t(x)
+        y = _t(y, like=x)
+        return apply_op(fn, x, y, name=name)
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x):
+    return apply_op(jnp.logical_not, _t(x), name="logical_not")
+
+
+def bitwise_not(x):
+    return apply_op(jnp.bitwise_not, _t(x), name="bitwise_not")
+
+
+def equal_all(x, y):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), _t(x), _t(y), name="equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return apply_op(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _t(x), _t(y), name="allclose",
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return apply_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _t(x), _t(y), name="isclose",
+    )
+
+
+def is_empty(x):
+    return Tensor(jnp.asarray(_t(x).size == 0))

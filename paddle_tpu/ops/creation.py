@@ -1,0 +1,185 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py; kernels in
+paddle/phi/kernels/*full*, *arange* etc.). All lower directly to jax.numpy."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dtype import get_default_dtype, to_jax_dtype
+from paddle_tpu.core.tensor import Tensor, to_tensor
+from paddle_tpu.ops.random_state import default_generator
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "eye", "rand", "randn", "randint",
+    "uniform", "normal", "randperm", "tril", "triu", "diag", "diagflat",
+    "meshgrid", "to_tensor", "assign", "clone_detached", "tril_indices",
+    "triu_indices", "one_hot",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = to_jax_dtype(dtype)
+    if d is None:
+        d = default or get_default_dtype().np_dtype
+    return d
+
+
+def zeros(shape, dtype=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = "bool"
+    elif dtype is None and isinstance(fill_value, int):
+        dtype = "int64"
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None):
+    return Tensor(jnp.zeros(x._value.shape, _dt(dtype, x._value.dtype)))
+
+
+def ones_like(x, dtype=None):
+    return Tensor(jnp.ones(x._value.shape, _dt(dtype, x._value.dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    return Tensor(jnp.full(x._value.shape, fill_value, _dt(dtype, x._value.dtype)))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or get_default_dtype()
+    d = to_jax_dtype(dtype) if dtype is not None else np.int64
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def rand(shape, dtype=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = jax.random.key(seed) if seed else default_generator.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def randn(shape, dtype=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    key = default_generator.next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    key = default_generator.next_key()
+    d = to_jax_dtype(dtype) or np.int64
+    return Tensor(jax.random.randint(key, _shape(shape), low, high, dtype=d))
+
+
+def randperm(n, dtype=None):
+    key = default_generator.next_key()
+    d = to_jax_dtype(dtype) or np.int64
+    return Tensor(jax.random.permutation(key, n).astype(d))
+
+
+def tril(x, diagonal=0):
+    from paddle_tpu.core.tensor import apply_op
+
+    return apply_op(lambda v: jnp.tril(v, diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0):
+    from paddle_tpu.core.tensor import apply_op
+
+    return apply_op(lambda v: jnp.triu(v, diagonal), x, name="triu")
+
+
+def diag(x, offset=0):
+    from paddle_tpu.core.tensor import apply_op
+
+    return apply_op(lambda v: jnp.diag(v, offset), x, name="diag")
+
+
+def diagflat(x, offset=0):
+    from paddle_tpu.core.tensor import apply_op
+
+    return apply_op(lambda v: jnp.diagflat(v, offset), x, name="diagflat")
+
+
+def meshgrid(*args):
+    arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    val = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output._set_value(val)
+        return output
+    return Tensor(val)
+
+
+def clone_detached(x):
+    return Tensor(x._value)
+
+
+def tril_indices(row, col, offset=0):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def triu_indices(row, col, offset=0):
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def one_hot(x, num_classes):
+    from paddle_tpu.core.tensor import apply_op
+
+    return apply_op(
+        lambda v: jax.nn.one_hot(v, num_classes, dtype=get_default_dtype().np_dtype),
+        x,
+        name="one_hot",
+    )

@@ -1,0 +1,224 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py — matmul at
+linalg.py:177 dispatching to _C_ops.matmul). On TPU, matmul lowers straight to
+the MXU via XLA dot_general; precision is controlled by FLAGS_tpu_matmul_precision."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.flags import flag
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "outer", "inner", "cross", "norm",
+    "dist", "cond", "einsum", "matrix_power", "multi_dot", "cholesky", "qr",
+    "svd", "eig", "eigh", "eigvals", "eigvalsh", "inv", "pinv", "solve",
+    "triangular_solve", "lstsq", "lu", "det", "slogdet", "matrix_rank",
+    "histogram", "mv", "kron",
+]
+
+
+def _prec():
+    p = flag("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+def _t_(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b, precision=_prec())
+
+    return apply_op(f, _t_(x), _t_(y), name="matmul")
+
+
+def mm(x, y):
+    return matmul(x, y)
+
+
+def bmm(x, y):
+    return matmul(x, y)
+
+
+def mv(x, vec):
+    return apply_op(lambda a, b: jnp.matmul(a, b, precision=_prec()), _t_(x), _t_(vec), name="mv")
+
+
+def dot(x, y):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), _t_(x), _t_(y), name="dot")
+
+
+def t(x):
+    x = _t_(x)
+    if x._value.ndim < 2:
+        return x
+    return apply_op(lambda v: jnp.swapaxes(v, -1, -2), x, name="t")
+
+
+def outer(x, y):
+    return apply_op(lambda a, b: jnp.outer(a, b), _t_(x), _t_(y), name="outer")
+
+
+def inner(x, y):
+    return apply_op(lambda a, b: jnp.inner(a, b), _t_(x), _t_(y), name="inner")
+
+
+def cross(x, y, axis=9):
+    ax = axis if axis != 9 else -1
+    # paddle defaults to the first axis with dim 3
+    if axis == 9:
+        for i, s in enumerate(_t_(x)._value.shape):
+            if s == 3:
+                ax = i
+                break
+    return apply_op(lambda a, b: jnp.cross(a, b, axis=ax), _t_(x), _t_(y), name="cross")
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    def f(v):
+        if p == "fro" or (p == 2 and axis is None):
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=axis, keepdims=keepdim))
+        if p == np.inf or p == "inf":
+            return jnp.max(jnp.abs(v), axis=axis, keepdims=keepdim)
+        if p == -np.inf or p == "-inf":
+            return jnp.min(jnp.abs(v), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=axis, keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=keepdim), 1.0 / p
+        )
+
+    return apply_op(f, _t_(x), name="norm")
+
+
+def dist(x, y, p=2):
+    return norm(x - y, p=float(p) if p != np.inf else p)
+
+
+def cond(x, p=None):
+    return apply_op(lambda v: jnp.linalg.cond(v, p=p), _t_(x), name="cond")
+
+
+def einsum(equation, *operands):
+    ts = [_t_(o) for o in operands]
+    return apply_op(
+        lambda *vs: jnp.einsum(equation, *vs, precision=_prec()), *ts, name="einsum"
+    )
+
+
+def matrix_power(x, n):
+    return apply_op(lambda v: jnp.linalg.matrix_power(v, n), _t_(x), name="matrix_power")
+
+
+def multi_dot(xs):
+    ts = [_t_(x) for x in xs]
+    return apply_op(lambda *vs: jnp.linalg.multi_dot(vs, precision=_prec()), *ts, name="multi_dot")
+
+
+def cholesky(x, upper=False):
+    def f(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply_op(f, _t_(x), name="cholesky")
+
+
+def qr(x, mode="reduced"):
+    return apply_op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), _t_(x), name="qr")
+
+
+def svd(x, full_matrices=False):
+    return apply_op(
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), _t_(x), name="svd"
+    )
+
+
+def eig(x):
+    v = np.asarray(_t_(x)._value)
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigh(x, UPLO="L"):
+    return apply_op(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), _t_(x), name="eigh")
+
+
+def eigvals(x):
+    v = np.asarray(_t_(x)._value)
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def eigvalsh(x, UPLO="L"):
+    return apply_op(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), _t_(x), name="eigvalsh")
+
+
+def inv(x):
+    return apply_op(jnp.linalg.inv, _t_(x), name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return apply_op(
+        lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), _t_(x), name="pinv"
+    )
+
+
+def solve(x, y):
+    return apply_op(jnp.linalg.solve, _t_(x), _t_(y), name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    import jax.scipy.linalg as jsl
+
+    def f(a, b):
+        return jsl.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply_op(f, _t_(x), _t_(y), name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    return apply_op(f, _t_(x), _t_(y), name="lstsq")
+
+
+def lu(x, pivot=True):
+    import jax.scipy.linalg as jsl
+
+    return apply_op(lambda v: tuple(jsl.lu(v)), _t_(x), name="lu")
+
+
+def det(x):
+    return apply_op(jnp.linalg.det, _t_(x), name="det")
+
+
+def slogdet(x):
+    return apply_op(lambda v: tuple(jnp.linalg.slogdet(v)), _t_(x), name="slogdet")
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return apply_op(lambda v: jnp.linalg.matrix_rank(v, rtol=tol), _t_(x), name="matrix_rank")
+
+
+def histogram(x, bins=100, min=0, max=0, weight=None):
+    v = _t_(x)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (float(jnp.min(v._value)), float(jnp.max(v._value)))
+    hist, _ = jnp.histogram(
+        v._value, bins=bins, range=(lo, hi),
+        weights=None if weight is None else _t_(weight)._value,
+    )
+    return Tensor(hist.astype(np.int64) if weight is None else hist)
+
+
+def kron(x, y):
+    return apply_op(jnp.kron, _t_(x), _t_(y), name="kron")

@@ -1,0 +1,474 @@
+"""Shape / layout / indexing ops (reference: python/paddle/tensor/manipulation.py;
+kernels paddle/phi/kernels/{reshape,transpose,concat,gather,...}). Static shapes
+keep XLA happy: every op here has shape computable from input shapes + attrs."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+builtins_slice = builtins.slice
+
+from paddle_tpu.core.dtype import to_jax_dtype
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = [
+    "reshape", "transpose", "concat", "stack", "split", "chunk", "squeeze",
+    "unsqueeze", "flatten", "cast", "gather", "gather_nd", "scatter",
+    "scatter_nd_add", "index_select", "index_sample", "tile", "expand",
+    "expand_as", "broadcast_to", "flip", "roll", "where", "masked_fill",
+    "take_along_axis", "put_along_axis", "topk", "sort", "argsort", "unbind",
+    "numel", "slice", "strided_slice", "unstack", "repeat_interleave",
+    "moveaxis", "swapaxes", "as_real", "as_complex", "crop", "pad",
+    "masked_select", "nonzero", "unique", "bincount", "searchsorted",
+    "tensordot", "rot90", "atleast_1d", "atleast_2d", "atleast_3d",
+    "view", "view_as", "tensor_split",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape):
+    s = _shape_list(shape)
+    return apply_op(lambda v: jnp.reshape(v, s), _t(x), name="reshape")
+
+
+view = reshape
+
+
+def view_as(x, other):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm):
+    p = tuple(int(i) for i in perm)
+    return apply_op(lambda v: jnp.transpose(v, p), _t(x), name="transpose")
+
+
+def moveaxis(x, source, destination):
+    return apply_op(lambda v: jnp.moveaxis(v, source, destination), _t(x), name="moveaxis")
+
+
+def swapaxes(x, axis1, axis2):
+    return apply_op(lambda v: jnp.swapaxes(v, axis1, axis2), _t(x), name="swapaxes")
+
+
+def concat(xs, axis=0):
+    ts = [_t(x) for x in xs]
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(lambda *vs: jnp.concatenate(vs, axis=ax), *ts, name="concat")
+
+
+def stack(xs, axis=0):
+    ts = [_t(x) for x in xs]
+    return apply_op(lambda *vs: jnp.stack(vs, axis=int(axis)), *ts, name="stack")
+
+
+def split(x, num_or_sections, axis=0):
+    x = _t(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x._value.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if any(s == -1 for s in sizes):
+            known = sum(s for s in sizes if s != -1)
+            sizes = [dim - known if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1])
+
+    def f(v):
+        return tuple(
+            jax.lax.slice_in_dim(v, int(o), int(o + s), axis=ax) for o, s in zip(offsets, sizes)
+        )
+
+    return list(apply_op(f, x, name="split"))
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    x = _t(x)
+    dim = x._value.shape[axis]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, rem = divmod(dim, n)
+        sizes = [base + (1 if i < rem else 0) for i in range(n)]
+        return split(x, sizes, axis)
+    idx = [0] + list(num_or_indices) + [dim]
+    sizes = [idx[i + 1] - idx[i] for i in range(len(idx) - 1)]
+    return split(x, sizes, axis)
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None):
+    x = _t(x)
+    if axis is None:
+        ax = tuple(i for i, s in enumerate(x._value.shape) if s == 1)
+    elif isinstance(axis, (list, tuple)):
+        ax = tuple(int(a) for a in axis if x._value.shape[int(a)] == 1)
+    else:
+        ax = (int(axis),) if x._value.shape[int(axis)] == 1 else ()
+    return apply_op(lambda v: jnp.squeeze(v, ax), x, name="squeeze")
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        ax = tuple(int(a) for a in axis)
+    else:
+        ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(lambda v: jnp.expand_dims(v, ax), _t(x), name="unsqueeze")
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    x = _t(x)
+    nd = x._value.ndim
+    if nd == 0:
+        return reshape(x, [1])
+    sa = start_axis % nd
+    ea = stop_axis % nd
+    shape = list(x._value.shape)
+    new_shape = shape[:sa] + [int(np.prod(shape[sa : ea + 1]))] + shape[ea + 1 :]
+    return reshape(x, new_shape)
+
+
+def cast(x, dtype):
+    d = to_jax_dtype(dtype)
+    return apply_op(lambda v: v.astype(d), _t(x), name="cast")
+
+
+def numel(x):
+    return Tensor(jnp.asarray(_t(x).size, np.int64))
+
+
+def gather(x, index, axis=0):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(
+        lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i, axis=ax),
+        _t(x),
+        _t(index),
+        name="gather",
+    )
+
+
+def gather_nd(x, index):
+    def f(v, idx):
+        # index [..., k] indexes the first k dims of v
+        k = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return apply_op(f, _t(x), _t(index), name="gather_nd")
+
+
+def index_select(x, index, axis=0):
+    return apply_op(lambda v, i: jnp.take(v, i, axis=int(axis)), _t(x), _t(index), name="index_select")
+
+
+def index_sample(x, index):
+    # x: [N, D], index: [N, K] -> out[n, k] = x[n, index[n, k]]
+    return apply_op(
+        lambda v, i: jnp.take_along_axis(v, i, axis=1), _t(x), _t(index), name="index_sample"
+    )
+
+
+def scatter(x, index, updates, overwrite=True):
+    def f(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        return v.at[i].add(u)
+
+    return apply_op(f, _t(x), _t(index), _t(updates), name="scatter")
+
+
+def scatter_nd_add(x, index, updates):
+    def f(v, i, u):
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply_op(f, _t(x), _t(index), _t(updates), name="scatter_nd_add")
+
+
+def take_along_axis(x, indices, axis, broadcast=True):
+    return apply_op(
+        lambda v, i: jnp.take_along_axis(v, i, axis=int(axis)),
+        _t(x),
+        _t(indices),
+        name="take_along_axis",
+    )
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    def f(v, i, u):
+        u = jnp.broadcast_to(u, i.shape) if not hasattr(u, "shape") or u.shape != i.shape else u
+        if reduce == "add":
+            return _put_add(v, i, u, int(axis))
+        return _put_set(v, i, u, int(axis))
+
+    return apply_op(f, _t(x), _t(indices), _t(values), name="put_along_axis")
+
+
+def _indices_grid(i, axis):
+    idx = []
+    for d in range(i.ndim):
+        if d == axis:
+            idx.append(i)
+        else:
+            shape = [1] * i.ndim
+            shape[d] = i.shape[d]
+            idx.append(jnp.broadcast_to(jnp.arange(i.shape[d]).reshape(shape), i.shape))
+    return tuple(idx)
+
+
+def _put_set(v, i, u, axis):
+    return v.at[_indices_grid(i, axis)].set(u)
+
+
+def _put_add(v, i, u, axis):
+    return v.at[_indices_grid(i, axis)].add(u)
+
+
+def tile(x, repeat_times):
+    r = _shape_list(repeat_times)
+    return apply_op(lambda v: jnp.tile(v, r), _t(x), name="tile")
+
+
+def expand(x, shape):
+    s = _shape_list(shape)
+    x = _t(x)
+    xs = list(x._value.shape)
+    out = [xs[i - (len(s) - len(xs))] if v == -1 else v for i, v in enumerate(s)]
+    return apply_op(lambda v: jnp.broadcast_to(v, tuple(out)), x, name="expand")
+
+
+def expand_as(x, y):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape):
+    return expand(x, shape)
+
+
+def flip(x, axis):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    return apply_op(lambda v: jnp.flip(v, ax), _t(x), name="flip")
+
+
+def roll(x, shifts, axis=None):
+    return apply_op(lambda v: jnp.roll(v, shifts, axis=axis), _t(x), name="roll")
+
+
+def where(condition, x=None, y=None):
+    cond = _t(condition)
+    if x is None and y is None:
+        return nonzero(cond, as_tuple=False)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), cond, _t(x), _t(y), name="where")
+
+
+def masked_fill(x, mask, value):
+    val = value.item() if isinstance(value, Tensor) else value
+    return apply_op(lambda v, m: jnp.where(m, val, v), _t(x), _t(mask), name="masked_fill")
+
+
+def masked_select(x, mask):
+    # dynamic output shape -> host sync (documented; XLA needs static shapes)
+    xv = np.asarray(x._value)
+    mv = np.asarray(mask._value)
+    return Tensor(jnp.asarray(xv[mv]))
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(_t(x)._value)
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(a.astype(np.int64))) for a in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    v = np.asarray(_t(x)._value)
+    res = np.unique(
+        v, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def bincount(x, weights=None, minlength=0):
+    if weights is None:
+        return apply_op(
+            lambda v: jnp.bincount(v, minlength=minlength, length=max(minlength, int(np.asarray(x._value).max(initial=0)) + 1)),
+            _t(x),
+            name="bincount",
+        )
+    return apply_op(
+        lambda v, w: jnp.bincount(v, weights=w, minlength=minlength, length=max(minlength, int(np.asarray(x._value).max(initial=0)) + 1)),
+        _t(x),
+        _t(weights),
+        name="bincount",
+    )
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    d = np.int32 if out_int32 else np.int64
+    return apply_op(
+        lambda s, v: jnp.searchsorted(s, v, side=side).astype(d),
+        _t(sorted_sequence),
+        _t(values),
+        name="searchsorted",
+    )
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def f(v):
+        if largest:
+            if axis in (-1, v.ndim - 1):
+                vals, idx = jax.lax.top_k(v, kk)
+            else:
+                vm = jnp.moveaxis(v, axis, -1)
+                vals, idx = jax.lax.top_k(vm, kk)
+                vals = jnp.moveaxis(vals, -1, axis)
+                idx = jnp.moveaxis(idx, -1, axis)
+        else:
+            idx = jnp.argsort(v, axis=axis)
+            idx = jnp.take(idx, jnp.arange(kk), axis=axis)
+            vals = jnp.take_along_axis(v, idx, axis=axis)
+        return vals, idx.astype(np.int64)
+
+    return apply_op(f, _t(x), name="topk")
+
+
+def sort(x, axis=-1, descending=False):
+    def f(v):
+        out = jnp.sort(v, axis=axis)
+        return jnp.flip(out, axis) if descending else out
+
+    return apply_op(f, _t(x), name="sort")
+
+
+def argsort(x, axis=-1, descending=False):
+    def f(v):
+        idx = jnp.argsort(v, axis=axis)
+        return (jnp.flip(idx, axis) if descending else idx).astype(np.int64)
+
+    return apply_op(f, _t(x), name="argsort")
+
+
+def unbind(x, axis=0):
+    x = _t(x)
+    n = x._value.shape[axis]
+
+    def f(v):
+        return tuple(jnp.squeeze(s, axis) for s in jnp.split(v, n, axis=axis))
+
+    return list(apply_op(f, x, name="unbind"))
+
+
+unstack = unbind
+
+
+def slice(x, axes, starts, ends):
+    x = _t(x)
+    shape = x._value.shape
+    idx = [builtins_slice(None)] * len(shape)
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(st.item()) if isinstance(st, Tensor) else int(st)
+        en = int(en.item()) if isinstance(en, Tensor) else int(en)
+        idx[ax] = builtins_slice(st, en)
+    tid = tuple(idx)
+    return apply_op(lambda v: v[tid], x, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    x = _t(x)
+    idx = [builtins_slice(None)] * x._value.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        idx[ax] = builtins_slice(int(st), int(en), int(sr))
+    tid = tuple(idx)
+    return apply_op(lambda v: v[tid], x, name="strided_slice")
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return apply_op(lambda v: jnp.repeat(v, repeats, axis=axis), _t(x), name="repeat_interleave")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    x = _t(x)
+    nd = x._value.ndim
+    pad = _shape_list(pad)
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle semantics: pad applies to the last len(pad)//2 spatial dims,
+        # ordered innermost-first for NCHW
+        cfg = [(0, 0)] * nd
+        np_ = len(pad) // 2
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            dims = list(range(nd - np_, nd))
+        else:
+            dims = list(range(1, 1 + np_))
+        for i, d in enumerate(reversed(dims)):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(v):
+        if jmode == "constant":
+            return jnp.pad(v, cfg, mode="constant", constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+
+    return apply_op(f, x, name="pad")
+
+
+def crop(x, shape=None, offsets=None):
+    x = _t(x)
+    shape = _shape_list(shape)
+    offsets = _shape_list(offsets) if offsets is not None else (0,) * len(shape)
+    idx = tuple(builtins_slice(o, o + s) for o, s in zip(offsets, shape))
+    return apply_op(lambda v: v[idx], x, name="crop")
+
+
+def tensordot(x, y, axes=2):
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), _t(x), _t(y), name="tensordot")
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return apply_op(lambda v: jnp.rot90(v, k, axes), _t(x), name="rot90")
+
+
+def atleast_1d(*xs):
+    outs = [apply_op(jnp.atleast_1d, _t(x), name="atleast_1d") for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*xs):
+    outs = [apply_op(jnp.atleast_2d, _t(x), name="atleast_2d") for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*xs):
+    outs = [apply_op(jnp.atleast_3d, _t(x), name="atleast_3d") for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def as_real(x):
+    return apply_op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), _t(x), name="as_real")
+
+
+def as_complex(x):
+    return apply_op(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), _t(x), name="as_complex")

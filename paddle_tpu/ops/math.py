@@ -1,0 +1,176 @@
+"""Elementwise / unary / binary math ops (reference: python/paddle/tensor/math.py,
+kernels under paddle/phi/kernels/elementwise_*, activation kernels). Each op is a
+pure jax fn dispatched through apply_op so autograd comes from jax.vjp."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "abs", "neg", "sign", "square", "reciprocal", "floor", "ceil", "round",
+    "trunc", "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "sinh",
+    "cosh", "tanh", "asinh", "acosh", "atanh", "erf", "erfinv", "maximum",
+    "minimum", "fmax", "fmin", "clip", "scale", "lerp", "isnan", "isinf",
+    "isfinite", "nan_to_num", "logaddexp", "logit", "hypot", "deg2rad",
+    "rad2deg", "frac", "multiply_", "add_", "scale_", "clip_", "increment",
+    "stanh", "rsqrt_", "angle", "conj", "real", "imag",
+]
+
+
+def _binop(fn, name):
+    def op(x, y, out_name=None):
+        if not isinstance(x, Tensor):
+            x = Tensor(jnp.asarray(x))
+        if not isinstance(y, Tensor):
+            y = Tensor(jnp.asarray(y, x._value.dtype) if np.isscalar(y) else jnp.asarray(y))
+        return apply_op(fn, x, y, name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _unop(fn, name):
+    def op(x):
+        if not isinstance(x, Tensor):
+            x = Tensor(jnp.asarray(x))
+        return apply_op(fn, x, name=name)
+
+    op.__name__ = name
+    return op
+
+
+add = _binop(jnp.add, "add")
+subtract = _binop(jnp.subtract, "subtract")
+multiply = _binop(jnp.multiply, "multiply")
+divide = _binop(jnp.divide, "divide")
+floor_divide = _binop(jnp.floor_divide, "floor_divide")
+remainder = _binop(jnp.remainder, "remainder")
+mod = remainder
+maximum = _binop(jnp.maximum, "maximum")
+minimum = _binop(jnp.minimum, "minimum")
+fmax = _binop(jnp.fmax, "fmax")
+fmin = _binop(jnp.fmin, "fmin")
+atan2 = _binop(jnp.arctan2, "atan2")
+logaddexp = _binop(jnp.logaddexp, "logaddexp")
+hypot = _binop(jnp.hypot, "hypot")
+
+exp = _unop(jnp.exp, "exp")
+expm1 = _unop(jnp.expm1, "expm1")
+log = _unop(jnp.log, "log")
+log2 = _unop(jnp.log2, "log2")
+log10 = _unop(jnp.log10, "log10")
+log1p = _unop(jnp.log1p, "log1p")
+sqrt = _unop(jnp.sqrt, "sqrt")
+rsqrt = _unop(jax.lax.rsqrt, "rsqrt")
+abs = _unop(jnp.abs, "abs")
+neg = _unop(jnp.negative, "neg")
+sign = _unop(jnp.sign, "sign")
+square = _unop(jnp.square, "square")
+reciprocal = _unop(jnp.reciprocal, "reciprocal")
+floor = _unop(jnp.floor, "floor")
+ceil = _unop(jnp.ceil, "ceil")
+round = _unop(jnp.round, "round")
+trunc = _unop(jnp.trunc, "trunc")
+sin = _unop(jnp.sin, "sin")
+cos = _unop(jnp.cos, "cos")
+tan = _unop(jnp.tan, "tan")
+asin = _unop(jnp.arcsin, "asin")
+acos = _unop(jnp.arccos, "acos")
+atan = _unop(jnp.arctan, "atan")
+sinh = _unop(jnp.sinh, "sinh")
+cosh = _unop(jnp.cosh, "cosh")
+tanh = _unop(jnp.tanh, "tanh")
+asinh = _unop(jnp.arcsinh, "asinh")
+acosh = _unop(jnp.arccosh, "acosh")
+atanh = _unop(jnp.arctanh, "atanh")
+erf = _unop(jax.scipy.special.erf, "erf")
+erfinv = _unop(jax.scipy.special.erfinv, "erfinv")
+isnan = _unop(jnp.isnan, "isnan")
+isinf = _unop(jnp.isinf, "isinf")
+isfinite = _unop(jnp.isfinite, "isfinite")
+deg2rad = _unop(jnp.deg2rad, "deg2rad")
+rad2deg = _unop(jnp.rad2deg, "rad2deg")
+angle = _unop(jnp.angle, "angle")
+conj = _unop(jnp.conj, "conj")
+real = _unop(jnp.real, "real")
+imag = _unop(jnp.imag, "imag")
+
+
+def frac(x):
+    return apply_op(lambda v: v - jnp.trunc(v), x, name="frac")
+
+
+def pow(x, y):
+    if isinstance(y, Tensor):
+        return apply_op(jnp.power, x, y, name="pow")
+    return apply_op(lambda v: jnp.power(v, y), x, name="pow")
+
+
+def clip(x, min=None, max=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return apply_op(lambda v: jnp.clip(v, mn, mx), x, name="clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    s, b = float(scale), float(bias)
+
+    def f(v):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+
+    return apply_op(f, x, name="scale")
+
+
+def lerp(x, y, weight):
+    if isinstance(weight, Tensor):
+        return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+    return apply_op(lambda a, b: a + weight * (b - a), x, y, name="lerp")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return apply_op(
+        lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x, name="nan_to_num"
+    )
+
+
+def logit(x, eps=None):
+    def f(v):
+        u = jnp.clip(v, eps, 1 - eps) if eps else v
+        return jnp.log(u / (1 - u))
+
+    return apply_op(f, x, name="logit")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return apply_op(lambda v: scale_b * jnp.tanh(scale_a * v), x, name="stanh")
+
+
+# ---- in-place variants (paddle `op_` convention): swap the buffer -------
+def _inplace(op):
+    def f(x, *args, **kwargs):
+        out = op(x, *args, **kwargs)
+        x._set_value(out._value)
+        x._grad_node = out._grad_node
+        x._output_index = out._output_index
+        x.stop_gradient = out.stop_gradient
+        return x
+
+    return f
+
+
+add_ = _inplace(add)
+multiply_ = _inplace(multiply)
+scale_ = _inplace(scale)
+clip_ = _inplace(clip)
+rsqrt_ = _inplace(rsqrt)
+
+
+def increment(x, value=1.0):
+    x._set_value(x._value + value)
+    return x

@@ -1,0 +1,49 @@
+"""Global RNG state for eager ops.
+
+Reference parity: phi `Generator` (paddle/phi/core/generator.h) + `paddle.seed`.
+TPU-native: a counter-based splitting scheme over `jax.random` keys. Each draw
+splits the global key, so eager randomness is reproducible from `seed()`. The
+hybrid-parallel RNG tracker (reference fleet/layers/mpu/random.py:34) builds on
+this in paddle_tpu.distributed.fleet.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "default_generator", "Generator"]
+
+
+class Generator:
+    def __init__(self, seed_: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed_)
+
+    def manual_seed(self, seed_: int):
+        self._seed = int(seed_)
+        self._key = jax.random.key(int(seed_))
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int):
+    """`paddle.seed` analog: reseed the global generator."""
+    default_generator.manual_seed(s)
+    return default_generator

@@ -1,0 +1,199 @@
+"""Reduction ops (reference: python/paddle/tensor/math.py sum/mean/..., stat.py;
+kernels paddle/phi/kernels/reduce_*). Reductions map 1:1 onto XLA reduce ops."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dtype import to_jax_dtype
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = [
+    "sum", "mean", "max", "min", "prod", "argmax", "argmin", "all", "any",
+    "logsumexp", "std", "var", "median", "amax", "amin", "count_nonzero",
+    "nanmean", "nansum", "cumsum", "cumprod", "cummax", "cummin", "kthvalue",
+    "mode",
+]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    d = to_jax_dtype(dtype)
+    return apply_op(
+        lambda v: jnp.sum(v, axis=_axis(axis), dtype=d, keepdims=keepdim), x, name="sum"
+    )
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    d = to_jax_dtype(dtype)
+    return apply_op(
+        lambda v: jnp.nansum(v, axis=_axis(axis), dtype=d, keepdims=keepdim), x, name="nansum"
+    )
+
+
+def mean(x, axis=None, keepdim=False):
+    return apply_op(lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), x, name="mean")
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return apply_op(lambda v: jnp.nanmean(v, axis=_axis(axis), keepdims=keepdim), x, name="nanmean")
+
+
+def max(x, axis=None, keepdim=False):
+    return apply_op(lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim), x, name="max")
+
+
+def min(x, axis=None, keepdim=False):
+    return apply_op(lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim), x, name="min")
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    d = to_jax_dtype(dtype)
+    return apply_op(
+        lambda v: jnp.prod(v, axis=_axis(axis), dtype=d, keepdims=keepdim), x, name="prod"
+    )
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    d = to_jax_dtype(dtype) or np.int64
+    return apply_op(
+        lambda v: jnp.argmax(v, axis=_axis(axis), keepdims=keepdim).astype(d), x, name="argmax"
+    )
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    d = to_jax_dtype(dtype) or np.int64
+    return apply_op(
+        lambda v: jnp.argmin(v, axis=_axis(axis), keepdims=keepdim).astype(d), x, name="argmin"
+    )
+
+
+def all(x, axis=None, keepdim=False):
+    return apply_op(lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), x, name="all")
+
+
+def any(x, axis=None, keepdim=False):
+    return apply_op(lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), x, name="any")
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    import jax.scipy.special as jss
+
+    return apply_op(
+        lambda v: jss.logsumexp(v, axis=_axis(axis), keepdims=keepdim), x, name="logsumexp"
+    )
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return apply_op(
+        lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        name="std",
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return apply_op(
+        lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        name="var",
+    )
+
+
+def median(x, axis=None, keepdim=False):
+    return apply_op(lambda v: jnp.median(v, axis=_axis(axis), keepdims=keepdim), x, name="median")
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return apply_op(
+        lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim).astype(np.int64),
+        x,
+        name="count_nonzero",
+    )
+
+
+def cumsum(x, axis=None, dtype=None):
+    d = to_jax_dtype(dtype)
+
+    def f(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=d)
+        return jnp.cumsum(v, axis=int(axis), dtype=d)
+
+    return apply_op(f, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None):
+    d = to_jax_dtype(dtype)
+
+    def f(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=d)
+        return jnp.cumprod(v, axis=int(dim), dtype=d)
+
+    return apply_op(f, x, name="cumprod")
+
+
+def cummax(x, axis=None):
+    import jax.lax as lax
+
+    def f(v):
+        a = axis if axis is not None else 0
+        vals = lax.associative_scan(jnp.maximum, v, axis=a)
+        return vals
+
+    vals = apply_op(f, x, name="cummax")
+    return vals
+
+
+def cummin(x, axis=None):
+    import jax.lax as lax
+
+    return apply_op(
+        lambda v: lax.associative_scan(jnp.minimum, v, axis=axis if axis is not None else 0),
+        x,
+        name="cummin",
+    )
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    def f(v):
+        sorted_v = jnp.sort(v, axis=axis)
+        idx = jnp.argsort(v, axis=axis)
+        val = jnp.take(sorted_v, k - 1, axis=axis)
+        ind = jnp.take(idx, k - 1, axis=axis)
+        if keepdim:
+            val = jnp.expand_dims(val, axis)
+            ind = jnp.expand_dims(ind, axis)
+        return val, ind.astype(np.int64)
+
+    return apply_op(f, x, name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False):
+    # Host-side (mode is a data-inspection op, not a training op).
+    vals = np.asarray(x._value)
+
+    def _mode1d(a):
+        u, c = np.unique(a, return_counts=True)
+        return u[np.argmax(c)]
+
+    out = np.apply_along_axis(_mode1d, axis, vals)
+    idx = np.argmax(vals == np.expand_dims(out, axis), axis=axis)
+    if keepdim:
+        out = np.expand_dims(out, axis)
+        idx = np.expand_dims(idx, axis)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(idx.astype(np.int64)))

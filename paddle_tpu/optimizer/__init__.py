@@ -1,0 +1,369 @@
+"""Optimizers (reference: python/paddle/optimizer — 16 optimizers + lr.py).
+
+Eager path: `step()` applies a jitted functional update per parameter (XLA
+fuses the elementwise chain; buffers are donated so updates are in-place in
+HBM). The same `_update(p, g, state) -> (p, state)` rules are reused by the
+compiled train-step path and by the ZeRO sharding optimizers in
+paddle_tpu.distributed.fleet (which shard `state` over the dp axis).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd import tape as _tape
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.clip import ClipGradBase
+from paddle_tpu.optimizer import lr as lr_mod
+from paddle_tpu.optimizer.lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb", "lr"]
+
+lr = lr_mod
+
+
+class Optimizer:
+    """Base optimizer (reference: python/paddle/optimizer/optimizer.py)."""
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            raise ValueError("parameters must be provided (eager mode)")
+        self._params = list(parameters)
+        self._param_groups = None
+        if len(self._params) and isinstance(self._params[0], dict):
+            self._param_groups = self._params
+            self._params = [p for g in self._param_groups for p in g["params"]]
+        self._lr = learning_rate
+        self._weight_decay = self._parse_wd(weight_decay)
+        self._grad_clip = grad_clip
+        self._state: dict[int, dict] = {}
+        self._step_count = 0
+        self._use_master_weights = multi_precision
+        self._jit_update = jax.jit(self._update, donate_argnums=(0, 2))
+
+    @staticmethod
+    def _parse_wd(weight_decay):
+        if weight_decay is None:
+            return 0.0
+        if isinstance(weight_decay, (int, float)):
+            return float(weight_decay)
+        # L2Decay-style object with a coefficient
+        return float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+
+    # -- subclass interface -------------------------------------------------
+    def _init_state(self, p: Tensor) -> dict:
+        return {}
+
+    def _update(self, pv, gv, state, lr, step):
+        """Pure functional update: (param, grad, state, lr, step) -> (param', state')."""
+        raise NotImplementedError
+
+    # -- public API ---------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def _parameter_list(self):
+        return self._params
+
+    def step(self):
+        self._step_count += 1
+        params_grads = [(p, p.grad) for p in self._params if (not p.stop_gradient and p.grad is not None)]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        cur_lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            sid = id(p)
+            if sid not in self._state:
+                self._state[sid] = self._init_state(p)
+            gv = g._value
+            if gv.dtype != p._value.dtype:
+                gv = gv.astype(p._value.dtype)
+            new_p, new_state = self._jit_update(
+                p._value, gv, self._state[sid],
+                jnp.asarray(cur_lr, jnp.float32), jnp.asarray(self._step_count, jnp.int32),
+            )
+            p._set_value(new_p)
+            self._state[sid] = new_state
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._params]
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._params:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        out = {"step": self._step_count}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        for i, p in enumerate(self._params):
+            st = self._state.get(id(p))
+            if st:
+                out[f"param_{i}"] = {k: np.asarray(v) for k, v in st.items()}
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("step", 0)
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        for i, p in enumerate(self._params):
+            key = f"param_{i}"
+            if key in state:
+                self._state[id(p)] = {k: jnp.asarray(v) for k, v in state[key].items()}
+
+
+class SGD(Optimizer):
+    def _update(self, pv, gv, state, lr, step):
+        if self._weight_decay:
+            gv = gv + self._weight_decay * pv
+        return pv - lr.astype(pv.dtype) * gv, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _update(self, pv, gv, state, lr, step):
+        if self._weight_decay:
+            gv = gv + self._weight_decay * pv
+        v = self._momentum * state["velocity"] + gv
+        if self._nesterov:
+            upd = gv + self._momentum * v
+        else:
+            upd = v
+        return pv - lr.astype(pv.dtype) * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._use_master_weights else p._value.dtype
+        st = {"m": jnp.zeros(p._value.shape, dt), "v": jnp.zeros(p._value.shape, dt)}
+        if self._use_master_weights and p._value.dtype != jnp.float32:
+            st["master"] = p._value.astype(jnp.float32)
+        return st
+
+    def _adam_core(self, pv32, gv32, state, lr, step):
+        m = self._b1 * state["m"] + (1 - self._b1) * gv32
+        v = self._b2 * state["v"] + (1 - self._b2) * jnp.square(gv32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self._b1 ** t)
+        vhat = v / (1 - self._b2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return upd, m, v
+
+    def _update(self, pv, gv, state, lr, step):
+        master = state.get("master")
+        p32 = master if master is not None else pv.astype(jnp.float32)
+        g32 = gv.astype(jnp.float32)
+        if self._weight_decay:  # Adam: L2 into grad (paddle semantics)
+            g32 = g32 + self._weight_decay * p32
+        upd, m, v = self._adam_core(p32, g32, state, lr, step)
+        new32 = p32 - upd
+        new_state = {"m": m, "v": v}
+        if master is not None:
+            new_state["master"] = new32
+        return new32.astype(pv.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        self._apply_decay_fn = apply_decay_param_fun
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        self._decay_flags = {
+            id(p): (apply_decay_param_fun is None or apply_decay_param_fun(p.name or f"p{i}"))
+            for i, p in enumerate(self._params)
+        }
+        self._jit_update_nodecay = jax.jit(functools.partial(self._update, decay=False),
+                                           donate_argnums=(0, 2))
+
+    def step(self):
+        # route per-param decay flag through two jitted variants
+        self._step_count += 1
+        params_grads = [(p, p.grad) for p in self._params if (not p.stop_gradient and p.grad is not None)]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        cur_lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step_count, jnp.int32)
+        for p, g in params_grads:
+            sid = id(p)
+            if sid not in self._state:
+                self._state[sid] = self._init_state(p)
+            gv = g._value
+            fn = self._jit_update if self._decay_flags.get(sid, True) else self._jit_update_nodecay
+            new_p, new_state = fn(p._value, gv, self._state[sid], cur_lr, step)
+            p._set_value(new_p)
+            self._state[sid] = new_state
+
+    def _update(self, pv, gv, state, lr, step, decay=True):
+        master = state.get("master")
+        p32 = master if master is not None else pv.astype(jnp.float32)
+        g32 = gv.astype(jnp.float32)
+        upd, m, v = self._adam_core(p32, g32, state, lr, step)
+        new32 = p32 - upd
+        if decay and self._weight_decay:
+            new32 = new32 - lr * self._weight_decay * p32
+        new_state = {"m": m, "v": v}
+        if master is not None:
+            new_state["master"] = new32
+        return new32.astype(pv.dtype), new_state
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._value, jnp.float32),
+                "u": jnp.zeros_like(p._value, jnp.float32)}
+
+    def _update(self, pv, gv, state, lr, step):
+        g32 = gv.astype(jnp.float32)
+        p32 = pv.astype(jnp.float32)
+        if self._weight_decay:
+            g32 = g32 + self._weight_decay * p32
+        m = self._b1 * state["m"] + (1 - self._b1) * g32
+        u = jnp.maximum(self._b2 * state["u"], jnp.abs(g32))
+        t = step.astype(jnp.float32)
+        new = p32 - lr / (1 - self._b1 ** t) * m / (u + self._eps)
+        return new.astype(pv.dtype), {"m": m, "u": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _init_state(self, p):
+        return {"acc": jnp.full(p._value.shape, self._init_acc, jnp.float32)}
+
+    def _update(self, pv, gv, state, lr, step):
+        g32 = gv.astype(jnp.float32)
+        p32 = pv.astype(jnp.float32)
+        if self._weight_decay:
+            g32 = g32 + self._weight_decay * p32
+        acc = state["acc"] + jnp.square(g32)
+        new = p32 - lr * g32 / (jnp.sqrt(acc) + self._eps)
+        return new.astype(pv.dtype), {"acc": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._eps, self._rho = epsilon, rho
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _init_state(self, p):
+        return {"avg_sq": jnp.zeros_like(p._value, jnp.float32),
+                "avg_upd": jnp.zeros_like(p._value, jnp.float32)}
+
+    def _update(self, pv, gv, state, lr, step):
+        g32 = gv.astype(jnp.float32)
+        p32 = pv.astype(jnp.float32)
+        if self._weight_decay:
+            g32 = g32 + self._weight_decay * p32
+        avg_sq = self._rho * state["avg_sq"] + (1 - self._rho) * jnp.square(g32)
+        upd = jnp.sqrt(state["avg_upd"] + self._eps) / jnp.sqrt(avg_sq + self._eps) * g32
+        avg_upd = self._rho * state["avg_upd"] + (1 - self._rho) * jnp.square(upd)
+        return (p32 - lr * upd).astype(pv.dtype), {"avg_sq": avg_sq, "avg_upd": avg_upd}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        self._rho, self._eps, self._mom, self._centered = rho, epsilon, momentum, centered
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _init_state(self, p):
+        st = {"ms": jnp.zeros_like(p._value, jnp.float32),
+              "mom": jnp.zeros_like(p._value, jnp.float32)}
+        if self._centered:
+            st["mg"] = jnp.zeros_like(p._value, jnp.float32)
+        return st
+
+    def _update(self, pv, gv, state, lr, step):
+        g32 = gv.astype(jnp.float32)
+        p32 = pv.astype(jnp.float32)
+        if self._weight_decay:
+            g32 = g32 + self._weight_decay * p32
+        ms = self._rho * state["ms"] + (1 - self._rho) * jnp.square(g32)
+        if self._centered:
+            mg = self._rho * state["mg"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._mom * state["mom"] + lr * g32 / denom
+        out_state = {"ms": ms, "mom": mom}
+        if self._centered:
+            out_state["mg"] = mg
+        return (p32 - mom).astype(pv.dtype), out_state
+
+
+class Lamb(Optimizer):
+    """reference: python/paddle/optimizer/lamb.py."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._value, jnp.float32),
+                "v": jnp.zeros_like(p._value, jnp.float32)}
+
+    def _update(self, pv, gv, state, lr, step):
+        g32 = gv.astype(jnp.float32)
+        p32 = pv.astype(jnp.float32)
+        m = self._b1 * state["m"] + (1 - self._b1) * g32
+        v = self._b2 * state["v"] + (1 - self._b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self._b1 ** t)
+        vhat = v / (1 - self._b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._lamb_wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(pv.dtype), {"m": m, "v": v}

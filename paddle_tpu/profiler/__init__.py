@@ -1,0 +1,177 @@
+"""Profiler (reference: python/paddle/profiler/profiler.py:346 + C++ profiler
+paddle/fluid/platform/profiler/profiler.h:47).
+
+TPU-native: host-side RecordEvent spans (the HostTracer analog) + optional
+jax.profiler device traces (XLA/xplane, viewable in TensorBoard/xprof — the
+CudaTracer/CUPTI analog). Chrome-trace export for the host timeline.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable
+
+__all__ = [
+    "Profiler", "ProfilerTarget", "RecordEvent", "make_scheduler",
+    "export_chrome_tracing", "SummaryView",
+]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+class _Collector(threading.local):
+    def __init__(self):
+        self.events = []
+        self.active = False
+
+
+_collector = _Collector()
+
+
+class RecordEvent:
+    """Host event annotation (reference: platform/profiler/event_tracing.h)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._begin = None
+
+    def begin(self):
+        self._begin = time.perf_counter_ns()
+
+    def end(self):
+        if self._begin is None:
+            return
+        if _collector.active:
+            _collector.events.append(
+                {"name": self.name, "ts": self._begin / 1000.0,
+                 "dur": (time.perf_counter_ns() - self._begin) / 1000.0,
+                 "ph": "X", "pid": os.getpid(), "tid": threading.get_ident()}
+            )
+        self._begin = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *a):
+        self.end()
+        return False
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0, skip_first: int = 0):
+    total = closed + ready + record
+
+    def sched(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return sched
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None) -> Callable:
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(time.time())}.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": prof._events}, f)
+        prof._export_path = path
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._step = 0
+        self._events = []
+        self._export_path = None
+        self._jax_trace_dir = None
+
+    def start(self):
+        _collector.active = True
+        _collector.events = []
+
+    def stop(self):
+        _collector.active = False
+        self._events = list(_collector.events)
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self._step += 1
+
+    def step_info(self, unit=None):
+        return f"step {self._step}"
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms", views=None):
+        by_name: dict[str, float] = {}
+        for e in self._events:
+            by_name[e["name"]] = by_name.get(e["name"], 0.0) + e["dur"]
+        lines = ["name\ttotal_us"] + [f"{k}\t{v:.1f}" for k, v in sorted(by_name.items(), key=lambda kv: -kv[1])]
+        return "\n".join(lines)
+
+    def export(self, path: str, format: str = "json"):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._events}, f)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """XLA device tracing via jax.profiler (xplane; the CUPTI-tracer analog)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

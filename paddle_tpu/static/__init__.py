@@ -1,0 +1,11 @@
+"""Static-graph API shim (reference: python/paddle/static).
+
+The reference's ProgramDesc/Executor static mode is superseded on TPU by
+whole-program XLA compilation: `paddle_tpu.jit.to_static` captures the graph
+and compiles it once (the analog of StandaloneExecutor+PirInterpreter,
+reference new_executor/pir_interpreter.cc). `InputSpec` is kept as the shape
+declaration type.
+"""
+from paddle_tpu.jit.api import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec"]

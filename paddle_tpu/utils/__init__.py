@@ -1,0 +1,44 @@
+"""Utility layer (reference: python/paddle/utils)."""
+from __future__ import annotations
+
+from typing import Iterable
+
+__all__ = ["try_import", "flatten", "pack_sequence_as", "unique_name"]
+
+
+def try_import(module_name: str, err_msg: str | None = None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"required module '{module_name}' is not installed") from e
+
+
+def flatten(nest):
+    import jax
+
+    return jax.tree_util.tree_leaves(nest)
+
+
+def pack_sequence_as(structure, flat):
+    import jax
+
+    treedef = jax.tree_util.tree_structure(structure)
+    return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._counters = {}
+
+    def __call__(self, prefix: str = "tmp") -> str:
+        n = self._counters.get(prefix, 0)
+        self._counters[prefix] = n + 1
+        return f"{prefix}_{n}"
+
+    def generate(self, prefix: str = "tmp") -> str:
+        return self(prefix)
+
+
+unique_name = _UniqueNameGenerator()

@@ -1,0 +1,4 @@
+"""paddle.vision parity (reference: python/paddle/vision)."""
+from paddle_tpu.vision import models  # noqa: F401
+from paddle_tpu.vision import datasets  # noqa: F401
+from paddle_tpu.vision import transforms  # noqa: F401

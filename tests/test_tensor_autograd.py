@@ -1,0 +1,184 @@
+"""Eager tensor + autograd engine tests (reference analog: test/legacy_test
+tensor/backward units, OpTest.check_grad numeric-vs-analytic)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def t(arr, sg=True):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=sg)
+
+
+class TestTensorBasics:
+    def test_to_tensor_numpy_roundtrip(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.shape == [2, 2]
+        assert x.dtype == paddle.float32
+        np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+    def test_dtypes(self):
+        assert paddle.to_tensor([1, 2]).dtype == paddle.int64
+        assert paddle.to_tensor([1.0]).dtype == paddle.float32
+        assert paddle.to_tensor([True]).dtype.name == "bool"
+        x = paddle.to_tensor([1.0], dtype="bfloat16")
+        assert x.dtype == paddle.bfloat16
+
+    def test_arith_dunders(self):
+        a, b = t([1.0, 2.0]), t([3.0, 4.0])
+        np.testing.assert_allclose((a + b).numpy(), [4, 6])
+        np.testing.assert_allclose((a * b).numpy(), [3, 8])
+        np.testing.assert_allclose((b / a).numpy(), [3, 2])
+        np.testing.assert_allclose((a - 1).numpy(), [0, 1])
+        np.testing.assert_allclose((2 - a).numpy(), [1, 0])
+        np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+        np.testing.assert_allclose((-a).numpy(), [-1, -2])
+
+    def test_getitem_setitem(self):
+        x = t(np.arange(12).reshape(3, 4))
+        np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+        np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+        x[0, 0] = 99.0
+        assert float(x[0, 0]) == 99.0
+
+    def test_item_and_shape(self):
+        x = t(3.5)
+        assert x.item() == 3.5
+        assert x.ndim == 0
+        assert t([[1, 2]]).size == 2
+
+
+class TestAutograd:
+    def test_simple_backward(self):
+        x = t([2.0, 3.0], sg=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_chain(self):
+        x = t([1.0], sg=False)
+        y = paddle.exp(paddle.sin(x))
+        y.backward()
+        expect = np.exp(np.sin(1.0)) * np.cos(1.0)
+        np.testing.assert_allclose(x.grad.numpy(), [expect], rtol=1e-6)
+
+    def test_branching_accumulation(self):
+        x = t([1.0, 2.0], sg=False)
+        a = x * 2
+        b = x * 3
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_grad_accumulates_across_backwards(self):
+        x = t([1.0], sg=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_stop_gradient_blocks(self):
+        x = t([1.0], sg=False)
+        y = t([2.0], sg=True)
+        (x * y).sum().backward()
+        assert y.grad is None
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_detach(self):
+        x = t([1.0], sg=False)
+        d = (x * 2).detach()
+        assert d.stop_gradient
+        z = x * 2 + d
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_no_grad_context(self):
+        x = t([1.0], sg=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y._grad_node is None
+
+    def test_retain_graph(self):
+        x = t([1.0], sg=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_double_backward_without_retain_raises(self):
+        x = t([1.0], sg=False)
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_non_scalar_backward_with_grad(self):
+        x = t([1.0, 2.0], sg=False)
+        y = x * 3
+        y.backward(grad_tensor=paddle.to_tensor(np.array([1.0, 10.0], np.float32)))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+    def test_multi_output_op(self):
+        x = t(np.arange(6).reshape(2, 3), sg=False)
+        a, b = paddle.split(x, 2, axis=0)
+        (a.sum() * 2 + b.sum() * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[2, 2, 2], [3, 3, 3]])
+
+    def test_matmul_grad_matches_numeric(self):
+        rng = np.random.RandomState(0)
+        a_np = rng.randn(3, 4).astype(np.float32)
+        b_np = rng.randn(4, 5).astype(np.float32)
+        a, b = t(a_np, sg=False), t(b_np, sg=False)
+        paddle.matmul(a, b).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), b_np.sum(1, keepdims=True).T.repeat(3, 0), rtol=1e-5)
+
+    def test_register_hook(self):
+        x = t([1.0], sg=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(np.asarray(g)) or None)
+        (x * 2).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [2.0])
+
+    def test_paddle_grad_api(self):
+        x = t([2.0], sg=False)
+        y = (x ** 3).sum()
+        (g,) = paddle.grad(y, [x])
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-6)
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = t([3.0], sg=False)
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [6.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestHigherOrder:
+    def test_jacobian(self):
+        from paddle_tpu.autograd import jacobian
+
+        x = t([1.0, 2.0], sg=False)
+        J = jacobian(lambda v: v * v, x)
+        np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0]))
+
+    def test_hessian(self):
+        from paddle_tpu.autograd import hessian
+
+        x = t([1.0, 2.0], sg=False)
+        H = hessian(lambda v: (v ** 3).sum(), x)
+        np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]))
