@@ -1,0 +1,96 @@
+"""Benchmark: LLaMA causal-LM training throughput on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline framing (BASELINE.md): the north star is LLaMA-2-7B at >=50% of
+H100+NCCL tokens/sec/device. A single v5e chip can't hold 7B, so the bench
+trains a scaled LLaMA (~110M) and reports tokens/sec/chip; `vs_baseline` is
+model-FLOPs-utilization (MFU) divided by 0.20 — i.e. 1.0 == the efficiency a
+7B H100 run at 40% MFU delivers when halved per the >=50% target. MFU is the
+hardware-portable proxy for "would match the reference's per-device rate at
+equal scale".
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import CompiledTrainStep
+
+    ndev = len(jax.devices())
+    on_tpu = jax.devices()[0].platform != "cpu"
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768, intermediate_size=2048,
+                          num_hidden_layers=12, num_attention_heads=12,
+                          num_key_value_heads=12, max_position_embeddings=2048,
+                          use_parallel_cross_entropy=False)
+        batch, seq, iters = 8, 1024, 20
+    else:  # CPU smoke (CI)
+        cfg = LlamaConfig(vocab_size=1024, hidden_size=128, intermediate_size=256,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=4, max_position_embeddings=256,
+                          use_parallel_cross_entropy=False)
+        batch, seq, iters = 4, 128, 5
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.train()
+
+    class _Wrap:
+        def parameters(self):
+            return model.parameters()
+
+        def __call__(self, ids, labels):
+            return model(ids, labels)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                                 multi_precision=True)
+    step = CompiledTrainStep(_Wrap(), lambda out, lab: out, optimizer=opt, mesh=None)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    # warmup/compile
+    step(ids, labels, labels).block_until_ready()
+    step(ids, labels, labels).block_until_ready()
+
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(iters):
+        loss = step(ids, labels, labels)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+
+    # MFU: 6 * n_params * tokens/sec / peak_flops (bf16)
+    n_params = sum(p.size for p in model.parameters())
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    peak = 394e12 if on_tpu else 1e12  # v5e bf16 peak ~394 TFLOP/s; CPU nominal
+    mfu = tokens_per_sec * flops_per_token / (peak * max(ndev, 1))
+    vs_baseline = mfu / 0.20  # 1.0 == 50%-of-H100@40%MFU efficiency bar
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec / max(ndev, 1), 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 4),
+        "detail": {"params": int(n_params), "mfu": round(mfu, 4), "batch": batch,
+                   "seq": seq, "loss": float(loss), "devices": ndev,
+                   "platform": jax.devices()[0].platform},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,9 +37,14 @@ def _annotate(p: Tensor, *spec):
 
 
 def _constraint(x: Tensor, *spec):
-    """with_sharding_constraint when compiled under a mesh; no-op eagerly."""
+    """with_sharding_constraint when compiled under a mesh; no-op eagerly and
+    inside shard_map (manual axes use the explicit collectives instead)."""
     mesh = get_mesh()
     if mesh is None or MP_AXIS not in mesh.shape:
+        return x
+    from paddle_tpu.distributed.collective import _bound_axes
+
+    if _bound_axes(tuple(mesh.axis_names)):
         return x
 
     from jax.sharding import NamedSharding, PartitionSpec
@@ -151,10 +156,12 @@ class ParallelCrossEntropy(Layer):
     def forward(self, input, label):
         def f(logits, lab):
             bound = mp_axis_bound()
-            lmax = jnp.max(logits, axis=-1, keepdims=True)
+            # stop_gradient BEFORE pmax: zero tangent lets the (non-differentiable)
+            # pmax primitive be skipped by AD
+            lmax = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
             if bound:
                 lmax = jax.lax.pmax(lmax, MP_AXIS)
-            shifted = logits - jax.lax.stop_gradient(lmax)
+            shifted = logits - lmax
             sumexp = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
             if bound:
                 sumexp = jax.lax.psum(sumexp, MP_AXIS)

@@ -1,0 +1,5 @@
+"""Model zoo for the BASELINE workloads (configs 2-5)."""
+from paddle_tpu.models.llama import (  # noqa: F401
+    LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM, LlamaModel,
+    LlamaPretrainingCriterion, llama_7b_config, llama_tiny_config,
+)

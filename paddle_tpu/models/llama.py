@@ -1,0 +1,226 @@
+"""LLaMA-2 family (flagship model; BASELINE config[3] LLaMA-2-7B TP+PP).
+
+Reference analog: the PaddleNLP LLaMA built from the reference's Fleet mpu
+layers (ColumnParallelLinear mp_layers.py:334, RowParallelLinear :541,
+VocabParallelEmbedding :47, ParallelCrossEntropy :742) + flash attention
+(nn/functional/flash_attention.py:147) + RMSNorm + rotary embeddings.
+
+TPU-native: attention runs the Pallas flash kernel (XLA fallback elsewhere);
+TP shardings ride the "mp" mesh axis via the mpu layers' annotations; the
+decoder-layer list is PipelineLayer-compatible for the "pp" axis; everything
+trains in bfloat16 on the MXU.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "LlamaDecoderLayer",
+           "LlamaPretrainingCriterion", "llama_tiny_config", "llama_7b_config"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_parallel_cross_entropy: bool = True
+    dtype: str = "float32"
+
+
+def llama_7b_config(**overrides) -> LlamaConfig:
+    return LlamaConfig(**overrides)
+
+
+def llama_tiny_config(**overrides) -> LlamaConfig:
+    cfg = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+               num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+               max_position_embeddings=128)
+    cfg.update(overrides)
+    return LlamaConfig(**cfg)
+
+
+def _rope_tables(head_dim: int, max_pos: int, theta: float):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_pos, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [max_pos, head_dim/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rotary(q, k, cos, sin):
+    """q,k: [B,S,H,D] arrays; cos/sin: [S, D/2]. Interleaved-pair rotation."""
+
+    def rot(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+    return rot(q), rot(k)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        h = config.hidden_size
+        kv = self.num_kv_heads * self.head_dim
+        self.q_proj = ColumnParallelLinear(h, h, has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, kv, has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, kv, has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(h, h, has_bias=False, input_is_parallel=True)
+        cos, sin = _rope_tables(self.head_dim, config.max_position_embeddings, config.rope_theta)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    def forward(self, x, attn_mask=None):
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape([b, s, -1, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, -1, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, -1, self.head_dim])
+
+        cos, sin = self.rope_cos, self.rope_sin
+
+        def rope_fn(qv, kv_, c, sn):
+            return apply_rotary(qv, kv_, c[:s], sn[:s])
+
+        q, k = apply_op(rope_fn, q, k, cos, sin, name="rope", n_outputs=2)
+
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = apply_op(lambda kv_: jnp.repeat(kv_, rep, axis=2), k, name="repeat_kv")
+            v = apply_op(lambda vv: jnp.repeat(vv, rep, axis=2), v, name="repeat_kv")
+
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True,
+                                             training=self.training)
+        out = out.reshape([b, s, -1])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, m, has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, m, has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(m, h, has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), attn_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(config)
+                                    for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, attn_mask)
+        return self.norm(x)
+
+
+class LlamaPretrainingCriterion(nn.Layer):
+    """Causal-LM loss; TP-aware CE over the sharded vocab (reference
+    ParallelCrossEntropy mp_layers.py:742)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.parallel_ce = ParallelCrossEntropy() if config.use_parallel_cross_entropy else None
+
+    def forward(self, logits, labels):
+        if self.parallel_ce is not None:
+            loss = self.parallel_ce(logits, labels)
+            return loss.mean()
+        return F.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                            has_bias=False, gather_output=False)
+        self.criterion = LlamaPretrainingCriterion(config)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        hidden = self.llama(input_ids, attn_mask)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            return self.criterion(logits, labels)
+        return logits
+
+    # ---- pipeline-parallel factory ----------------------------------------
+    @staticmethod
+    def pipeline_layers(config: LlamaConfig, loss_fn=None):
+        """LayerDesc list for PipelineLayer (reference pp_layers.py usage)."""
+        from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc
+
+        descs = [LayerDesc(_EmbeddingStage, config)]
+        for _ in range(config.num_hidden_layers):
+            descs.append(LayerDesc(LlamaDecoderLayer, config))
+        descs.append(LayerDesc(_HeadStage, config))
+        return descs
+
+
+class _EmbeddingStage(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+
+    def forward(self, input_ids):
+        return self.embed_tokens(input_ids)
+
+
+class _HeadStage(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                            has_bias=False, gather_output=False)
+
+    def forward(self, x):
+        return self.lm_head(self.norm(x))

@@ -1,0 +1,7 @@
+"""SPMD compilation layer: mesh-sharded whole-program train steps.
+
+This is the TPU-native replacement for the reference's static-graph executor +
+distributed passes stack (SURVEY §3.5, §2.3): parallelism is expressed as
+shardings on ONE compiled XLA program instead of per-rank programs + NCCL.
+"""
+from paddle_tpu.parallel.train_step import CompiledTrainStep, functional_call  # noqa: F401

@@ -1,0 +1,274 @@
+"""Compiled pipeline parallelism: the whole schedule is ONE XLA program.
+
+Reference analog: Fleet's 1F1B runtime (pipeline_parallel.py:459
+forward_backward_pipeline) + batched p2p (p2p_communication.py:322) + the
+static pipeline passes / FleetExecutor (SURVEY §2.1).
+
+TPU-native design (the "pipelining via collective_permute" recipe of the
+scaling book): inside `shard_map` over the "pp" mesh axis each rank holds ONE
+stage's parameters (stacked pytree, leading dim = pp). A `lax.scan` streams
+M microbatches through T = M + S - 1 ticks; activations hop to the next stage
+with `lax.ppermute` over ICI. Differentiating through the scan gives the
+reverse (backward) pipeline automatically — XLA schedules fwd/bwd ticks and
+overlaps the permutes with compute, which is exactly the 1F1B overlap the
+reference hand-codes with comm streams. Tensor parallelism composes: inside
+shard_map the "mp" axis is bound, so the mpu layers' explicit collectives
+(identity/psum pairs, mp_ops.py) activate with local shards.
+
+Microbatch loss masking: each rank computes every tick, but only
+(rank == S-1, valid mb) ticks contribute loss; invalid ticks are masked out.
+The embedding/head run in-pipeline on the first/last stage's rank.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet import rng as fleet_rng
+from paddle_tpu.distributed.mesh import get_mesh
+from paddle_tpu.parallel.train_step import _param_pspec, functional_call
+
+__all__ = ["PipelinedTrainStep"]
+
+
+def _stack_params(stages):
+    """Stack homogeneous per-stage param lists: list[stage][param] -> list[param stacked on dim0]."""
+    n_params = len(stages[0])
+    out = []
+    for i in range(n_params):
+        out.append(jnp.stack([s[i] for s in stages]))
+    return out
+
+
+class PipelinedTrainStep:
+    """Train step for (embed, blocks, head) models with pp (+dp/mp) sharding.
+
+    blocks are partitioned uniformly into pp_degree stages; each stage applies
+    blocks_per_stage blocks sequentially (weights stacked on a leading
+    per-stage block dim, scanned inside the stage).
+    """
+
+    def __init__(self, embed_layer, blocks: Sequence, head_layer, loss_fn: Callable,
+                 optimizer=None, mesh: Mesh | None = None, num_micro: int = 1,
+                 remat: bool = True, seed: int = 0):
+        self.mesh = mesh if mesh is not None else get_mesh()
+        if self.mesh is None or "pp" not in self.mesh.shape:
+            raise ValueError("PipelinedTrainStep requires a mesh with a 'pp' axis")
+        self.S = int(self.mesh.shape["pp"])
+        if len(blocks) % self.S != 0:
+            raise ValueError(f"{len(blocks)} blocks not divisible by pp={self.S}")
+        self.blocks_per_stage = len(blocks) // self.S
+        self.M = num_micro
+        self.embed = embed_layer
+        self.blocks = list(blocks)
+        self.head = head_layer
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.remat = remat
+        self._key = jax.random.key(seed)
+        self._step_i = 0
+
+        mesh = self.mesh
+        self._dp_axes = tuple(a for a in ("dp", "sharding") if a in mesh.shape and mesh.shape[a] > 1)
+
+        # ---- parameter pytrees ------------------------------------------------
+        self._embed_params = embed_layer.parameters()
+        self._head_params = head_layer.parameters()
+        self._block_params = [b.parameters() for b in blocks]
+        nb = len(self._block_params[0])
+        for bp in self._block_params:
+            assert len(bp) == nb, "pipeline blocks must be homogeneous"
+
+        # stacked block params: [n_layers, ...] -> reshaped [S, bps, ...]
+        stacked = []
+        for i in range(nb):
+            vals = [bp[i]._value for bp in self._block_params]
+            arr = jnp.stack(vals).reshape((self.S, self.blocks_per_stage) + vals[0].shape)
+            stacked.append(arr)
+
+        # shardings: leading dim over 'pp', inner dims by the param's mp spec
+        def block_spec(p):
+            inner = _param_pspec(p, mesh)
+            return PartitionSpec("pp", None, *inner)
+
+        self._block_specs = [block_spec(p) for p in self._block_params[0]]
+        self._stacked_blocks = [
+            jax.device_put(a, NamedSharding(mesh, s))
+            for a, s in zip(stacked, self._block_specs)
+        ]
+        self._embed_specs = [_param_pspec(p, mesh) for p in self._embed_params]
+        self._head_specs = [_param_pspec(p, mesh) for p in self._head_params]
+        self._embed_vals = [jax.device_put(p._value, NamedSharding(mesh, s))
+                            for p, s in zip(self._embed_params, self._embed_specs)]
+        self._head_vals = [jax.device_put(p._value, NamedSharding(mesh, s))
+                           for p, s in zip(self._head_params, self._head_specs)]
+
+        # optimizer state over the flat param list (embed + blocks-stacked + head)
+        self._opt_states = None
+        if optimizer is not None:
+            self._opt_states = []
+            for v in self._embed_vals + self._stacked_blocks + self._head_vals:
+                holder = Tensor(v)
+                st = optimizer._init_state(holder)
+                # co-locate state with its (sharded) parameter
+                st = {k: jax.device_put(s, v.sharding) for k, s in st.items()}
+                self._opt_states.append(st)
+
+        self._jitted = None
+
+    # -- stage function (runs under shard_map: local shards, axes bound) -----
+    def _stage_fn(self, stage_params_local, x, key):
+        """Apply this rank's blocks_per_stage blocks to x."""
+        counter = [0]
+
+        def next_key():
+            counter[0] += 1
+            return jax.random.fold_in(key, counter[0])
+
+        def one_block(h, layer_params):
+            prev = fleet_rng._tls.active_key_fn
+            fleet_rng._tls.active_key_fn = next_key
+            try:
+                out = functional_call(self.blocks[0], layer_params, (h,))
+            finally:
+                fleet_rng._tls.active_key_fn = prev
+            return out._value if isinstance(out, Tensor) else out, None
+
+        block_fn = one_block
+        if self.remat:
+            block_fn = jax.checkpoint(one_block)
+        h, _ = jax.lax.scan(block_fn, x, stage_params_local)
+        return h
+
+    def _pipeline_loss(self, stacked_blocks_local, embed_out_mb, labels_mb, head_vals, key):
+        """Runs per-rank inside shard_map. embed_out_mb: [M, mb, S_seq, H] local;
+        labels_mb: [M, mb, S_seq]."""
+        S = self.S
+        M = self.M
+        idx = jax.lax.axis_index("pp")
+        # strip the leading local pp dim (size 1 per rank)
+        stage_params = [a[0] for a in stacked_blocks_local]
+
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, acc_loss, acc_cnt = carry
+            mb_idx = t - idx
+            inp = jnp.where(idx == 0,
+                            embed_out_mb[jnp.clip(t, 0, M - 1)],
+                            state)
+            out = self._stage_fn(stage_params, inp, jax.random.fold_in(key, t))
+            valid = (mb_idx >= 0) & (mb_idx < M) & (idx == S - 1)
+            # head + loss (masked off except on last stage's valid ticks)
+            head_out = functional_call(self.head, head_vals, (out,))
+            hv = head_out._value if isinstance(head_out, Tensor) else head_out
+            lab = labels_mb[jnp.clip(mb_idx, 0, M - 1)]
+            loss_t = self.loss_fn(Tensor(hv), Tensor(lab))
+            lval = loss_t._value if isinstance(loss_t, Tensor) else loss_t
+            acc_loss = acc_loss + jnp.where(valid, lval, 0.0)
+            acc_cnt = acc_cnt + jnp.where(valid, 1.0, 0.0)
+            nxt = jax.lax.ppermute(out, "pp", perm)
+            return (nxt, acc_loss, acc_cnt), None
+
+        zero = jnp.zeros_like(embed_out_mb[0])
+        (state, loss_sum, cnt), _ = jax.lax.scan(
+            tick, (zero, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            jnp.arange(M + S - 1),
+        )
+        # sum over pp (only last rank nonzero) and average over dp shards
+        loss = jax.lax.psum(loss_sum, "pp") / jnp.maximum(jax.lax.psum(cnt, "pp"), 1.0)
+        if self._dp_axes:
+            loss = jax.lax.pmean(loss, self._dp_axes)
+        return loss
+
+    # -- whole step -----------------------------------------------------------
+    def _loss_of(self, embed_vals, stacked_blocks, head_vals, ids, labels, key):
+        mesh = self.mesh
+        # embedding outside the pipeline region (GSPMD-sharded over dp/mp)
+        emb_out = functional_call(self.embed, embed_vals, (ids,))
+        x = emb_out._value if isinstance(emb_out, Tensor) else emb_out
+        B = x.shape[0]
+        mb = B // self.M
+        x_mb = x.reshape((self.M, mb) + x.shape[1:])
+        lab_mb = labels.reshape((self.M, mb) + labels.shape[1:])
+
+        dp = self._dp_axes
+        data_spec = PartitionSpec(None, dp if dp else None)
+        in_specs = (
+            tuple(self._block_specs),
+            PartitionSpec(None, dp if dp else None, *([None] * (x.ndim - 1))),
+            PartitionSpec(None, dp if dp else None, *([None] * (labels.ndim - 1))),
+            # head enters mp-sharded (vocab shard per mp rank) so the in-pipeline
+            # ParallelCrossEntropy sees true local shards
+            tuple(self._head_specs),
+            PartitionSpec(),
+        )
+        try:
+            from jax import shard_map
+
+            fn = shard_map(self._pipeline_loss, mesh=mesh, in_specs=in_specs,
+                           out_specs=PartitionSpec(), check_vma=False)
+        except (ImportError, TypeError):  # older jax API
+            from jax.experimental.shard_map import shard_map
+
+            fn = shard_map(self._pipeline_loss, mesh=mesh, in_specs=in_specs,
+                           out_specs=PartitionSpec(), check_rep=False)
+        return fn(tuple(stacked_blocks), x_mb, lab_mb, tuple(head_vals), key)
+
+    def _step_fn(self, embed_vals, stacked_blocks, head_vals, opt_states, ids, labels,
+                 key, lr, step_i):
+        def loss_fn(ev, sb, hv):
+            return self._loss_of(ev, sb, hv, ids, labels, key)
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            embed_vals, stacked_blocks, head_vals
+        )
+        g_embed, g_blocks, g_head = grads
+        flat_p = list(embed_vals) + list(stacked_blocks) + list(head_vals)
+        flat_g = list(g_embed) + list(g_blocks) + list(g_head)
+        if self.optimizer is None:
+            return loss, embed_vals, stacked_blocks, head_vals, opt_states
+        new_p, new_s = [], []
+        for pv, gv, st in zip(flat_p, flat_g, opt_states):
+            if gv.dtype != pv.dtype:
+                gv = gv.astype(pv.dtype)
+            np_, ns_ = self.optimizer._update(pv, gv, st, lr, step_i)
+            new_p.append(np_)
+            new_s.append(ns_)
+        ne = len(embed_vals)
+        nb = len(stacked_blocks)
+        return (loss, new_p[:ne], new_p[ne:ne + nb], new_p[ne + nb:], new_s)
+
+    def __call__(self, ids, labels):
+        if self._jitted is None:
+            self._jitted = jax.jit(self._step_fn, donate_argnums=(0, 1, 2, 3))
+        iv = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
+        lv = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+        dp = self._dp_axes
+        bspec = PartitionSpec(dp if dp else None)
+        iv = jax.device_put(iv, NamedSharding(self.mesh, bspec))
+        lv = jax.device_put(lv, NamedSharding(self.mesh, bspec))
+        self._step_i += 1
+        self._key, sub = jax.random.split(self._key)
+        lr = jnp.asarray(self.optimizer.get_lr() if self.optimizer else 0.0, jnp.float32)
+        out = self._jitted(self._embed_vals, self._stacked_blocks, self._head_vals,
+                           self._opt_states, iv, lv, sub, lr,
+                           jnp.asarray(self._step_i, jnp.int32))
+        loss, self._embed_vals, self._stacked_blocks, self._head_vals, self._opt_states = out
+        return Tensor(loss)
+
+    def sync_params_to_model(self):
+        for p, v in zip(self._embed_params, self._embed_vals):
+            p._set_value(v)
+        for p, v in zip(self._head_params, self._head_vals):
+            p._set_value(v)
+        for i, stacked in enumerate(self._stacked_blocks):
+            flat = stacked.reshape((self.S * self.blocks_per_stage,) + stacked.shape[2:])
+            for l, bp in enumerate(self._block_params):
+                bp[i]._set_value(flat[l])
