@@ -33,7 +33,7 @@ def main():
                           num_hidden_layers=12, num_attention_heads=12,
                           num_key_value_heads=12, max_position_embeddings=2048,
                           use_parallel_cross_entropy=False)
-        batch, seq, iters = 8, 1024, 20
+        batch, seq, iters = 16, 1024, 20
     else:  # CPU smoke (CI)
         cfg = LlamaConfig(vocab_size=1024, hidden_size=128, intermediate_size=256,
                           num_hidden_layers=2, num_attention_heads=4,
@@ -61,18 +61,48 @@ def main():
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
 
-    # warmup/compile
-    step(ids, labels, labels).block_until_ready()
-    step(ids, labels, labels).block_until_ready()
+    # Build a multi-step runner: N optimizer steps inside ONE jitted fori_loop.
+    # On tunneled platforms block_until_ready doesn't block, so timing must
+    # force a host readback; two run lengths difference out the RPC constant.
+    import jax.numpy as jnp
 
-    t0 = time.perf_counter()
-    loss = None
-    for _ in range(iters):
-        loss = step(ids, labels, labels)
-    loss.block_until_ready()
-    dt = time.perf_counter() - t0
+    step._build()
+    iv, lv = ids._value, labels._value
 
-    tokens_per_sec = batch * seq * iters / dt
+    def run_n(n):
+        def body(i, carry):
+            params, states, _ = carry
+            key = jax.random.fold_in(jax.random.key(0), i)
+            loss, params, states = step._step_fn(
+                params, states, (iv, lv, lv), key,
+                jnp.asarray(1e-4, jnp.float32), i.astype(jnp.int32) + 1)
+            return params, states, loss.astype(jnp.float32)
+        return body
+
+    @jax.jit
+    def train_n(params, states, n):
+        params, states, loss = jax.lax.fori_loop(
+            0, n, run_n(n), (params, states, jnp.zeros((), jnp.float32)))
+        return params, states, loss
+
+    n_arr = jnp.asarray(2, jnp.int32)
+    p, s, loss0 = train_n(step._param_vals, step._opt_states, n_arr)
+    float(loss0)  # compile + settle
+
+    def timed(n):
+        t0 = time.perf_counter()
+        _, _, loss = train_n(p, s, jnp.asarray(n, jnp.int32))
+        lval = float(loss)
+        return time.perf_counter() - t0, lval
+
+    small_n, big_n = max(2, iters // 4), iters
+    t_small, _ = timed(small_n)
+    t_big, loss_val = timed(big_n)
+    dt = max(t_big - t_small, 1e-6)
+    eff_iters = big_n - small_n
+    tokens_per_sec = batch * seq * eff_iters / dt
+    loss = paddle.to_tensor(loss_val)
+    iters = eff_iters
 
     # MFU: 6 * n_params * tokens/sec / peak_flops (bf16)
     n_params = sum(p.size for p in model.parameters())

@@ -929,13 +929,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """
     if flag("use_pallas_attention") and dropout_p == 0.0 and attn_mask is None:
         try:
-            from paddle_tpu.ops.pallas.flash_attention import flash_attention_bshd
+            from paddle_tpu.ops.pallas.flash_attention import _on_tpu, flash_attention_bshd
 
-            q, k, v = _t(query), _t(key), _t(value)
-            return apply_op(
-                lambda a, b, c: flash_attention_bshd(a, b, c, causal=is_causal),
-                q, k, v, name="flash_attention",
-            )
+            if _on_tpu():
+                q, k, v = _t(query), _t(key), _t(value)
+                return apply_op(
+                    lambda a, b, c: flash_attention_bshd(a, b, c, causal=is_causal),
+                    q, k, v, name="flash_attention",
+                )
         except Exception:
             pass  # fall back to XLA path below
 
