@@ -1,0 +1,3 @@
+from paddle_tpu.incubate.distributed.models.moe.moe_layer import (  # noqa: F401
+    ExpertFFN, GShardGate, MoELayer, NaiveGate, SwitchGate,
+)

@@ -1,0 +1,186 @@
+"""Mixture-of-Experts layer (expert parallelism).
+
+Reference parity: `MoELayer` (incubate/distributed/models/moe/moe_layer.py:263)
+with `MoEScatter`/`MoEGather` PyLayers (:99/:149) and gates
+(gate/{naive,gshard,switch}_gate.py); dispatch collectives
+`global_scatter`/`global_gather` (distributed/utils/moe_utils.py:20).
+
+TPU-native design: FIXED-CAPACITY dense dispatch (GShard style) — the
+token→expert routing is an einsum with a [tokens, E, C] one-hot dispatch mask,
+so shapes stay static for XLA. Expert weights are BATCHED over a leading
+expert dim annotated to shard over the "ep"/"mp" mesh axis; under GSPMD the
+dispatch/combine einsums lower to the all-to-all over ICI that the reference
+implements with global_scatter/global_gather CUDA ops. Aux (load-balance) loss
+follows GShard.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["MoELayer", "ExpertFFN", "NaiveGate", "GShardGate", "SwitchGate"]
+
+EP_AXIS = "ep"
+
+
+class NaiveGate(Layer):
+    """Top-k softmax gate (reference gate/naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__()
+        self.num_expert = num_expert
+        self.topk = topk
+        self.gate_weight = self.create_parameter(
+            [d_model, num_expert], None, default_initializer=I.XavierNormal())
+
+    def forward(self, x):
+        logits = F.linear(x, self.gate_weight)
+        return logits
+
+
+class GShardGate(NaiveGate):
+    """GShard gate: top-2 + load-balance aux loss (reference gate/gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2, capacity=(1.2, 2.4),
+                 random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity = capacity
+
+
+class SwitchGate(NaiveGate):
+    """Switch transformer top-1 gate (reference gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1, switch_eps=0.1,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+
+
+class ExpertFFN(Layer):
+    """Batched expert MLPs: weights [E, d, dff] / [E, dff, d], expert dim
+    sharded over the ep axis (the per-rank expert list of the reference)."""
+
+    def __init__(self, num_expert, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.num_expert = num_expert
+        self.w1 = self.create_parameter([num_expert, d_model, d_hidden], None,
+                                        default_initializer=I.XavierNormal())
+        self.w2 = self.create_parameter([num_expert, d_hidden, d_model], None,
+                                        default_initializer=I.XavierNormal())
+        self.b1 = self.create_parameter([num_expert, 1, d_hidden], None, is_bias=True)
+        self.b2 = self.create_parameter([num_expert, 1, d_model], None, is_bias=True)
+        # shard the expert dim over ep (falls back to mp if no ep axis)
+        for p in (self.w1, self.w2, self.b1, self.b2):
+            p._mp_pspec = (EP_AXIS,) + (None,) * (len(p.shape) - 1)
+        self.act = activation
+
+    def forward(self, x):
+        """x: [E, C, d] -> [E, C, d]."""
+
+        def f(xv, w1, b1, w2, b2):
+            h = jnp.einsum("ecd,edh->ech", xv, w1) + b1
+            h = jax.nn.gelu(h) if self.act == "gelu" else jax.nn.relu(h)
+            return jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+        return apply_op(f, x, self.w1, self.b1, self.w2, self.b2, name="expert_ffn")
+
+
+class MoELayer(Layer):
+    """reference: moe_layer.py:263.
+
+    recompute_interval/moe_group kept for API parity; `gate` may be a string
+    ('naive'|'gshard'|'switch') or a gate Layer.
+    """
+
+    def __init__(self, d_model, experts=None, gate=None, moe_group=None, mp_group=None,
+                 recompute_interval=0, num_expert=None, d_hidden=None, top_k=2,
+                 capacity_factor=1.25, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, ExpertFFN):
+            self.experts = experts
+            num_expert = experts.num_expert
+        elif experts is not None and not isinstance(experts, (str, type(None))):
+            # a LayerList of per-expert MLPs (reference style): batch their weights
+            num_expert = len(experts)
+            d_hidden = d_hidden or experts[0].parameters()[0].shape[-1]
+            self.experts = ExpertFFN(num_expert, d_model, d_hidden)
+        else:
+            assert num_expert is not None and d_hidden is not None
+            self.experts = ExpertFFN(num_expert, d_model, d_hidden)
+        self.num_expert = num_expert
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        if gate is None or gate == "gshard":
+            self.gate = GShardGate(d_model, num_expert, topk=top_k)
+        elif gate == "naive":
+            self.gate = NaiveGate(d_model, num_expert, topk=top_k)
+        elif gate == "switch":
+            self.gate = SwitchGate(d_model, num_expert)
+            self.top_k = 1
+        else:
+            self.gate = gate
+        self.l_aux = None
+
+    def forward(self, x):
+        """x: [B, S, d] (or [N, d])."""
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        x2 = x.reshape([-1, d])
+        n_tokens = x2.shape[0]
+        E = self.num_expert
+        k = self.top_k
+        C = max(1, int(self.capacity_factor * n_tokens * k / E))
+
+        logits = self.gate(x2)  # [N, E]
+
+        def dispatch_combine(xv, gv, ew1, eb1, ew2, eb2):
+            probs = jax.nn.softmax(gv.astype(jnp.float32), axis=-1)  # [N, E]
+            # top-k choice per token
+            topv, topi = jax.lax.top_k(probs, k)  # [N, k]
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+            # position of each (token, choice) in its expert's buffer
+            onehot = jax.nn.one_hot(topi, E, dtype=jnp.int32)  # [N, k, E]
+            flat = onehot.reshape(-1, E)  # [N*k, E]
+            pos = jnp.cumsum(flat, axis=0) * flat - 1  # [N*k, E] position or -1
+            pos = pos.reshape(n_tokens, k, E)
+            within = (pos >= 0) & (pos < C)
+
+            # dispatch mask [N, E, C]
+            posc = jnp.clip(pos, 0, C - 1)
+            disp = (jax.nn.one_hot(posc, C, dtype=xv.dtype)
+                    * within[..., None].astype(xv.dtype)
+                    * onehot[..., None].astype(xv.dtype))  # [N, k, E, C]
+            disp_mask = jnp.sum(disp, axis=1)  # [N, E, C]
+
+            expert_in = jnp.einsum("nd,nec->ecd", xv, disp_mask)
+            h = jnp.einsum("ecd,edh->ech", expert_in, ew1) + eb1
+            h = jax.nn.gelu(h)
+            expert_out = jnp.einsum("ech,ehd->ecd", h, ew2) + eb2
+
+            combine = jnp.einsum("nkec,nk->nec", disp,
+                                 topv.astype(xv.dtype))  # weighted combine
+            out = jnp.einsum("ecd,nec->nd", expert_out, combine)
+
+            # GShard load-balance aux loss
+            me = jnp.mean(probs, axis=0)  # mean prob per expert
+            ce = jnp.mean(jnp.sum(onehot, axis=1).astype(jnp.float32), axis=0)
+            l_aux = jnp.sum(me * ce) * E
+            return out, l_aux.astype(xv.dtype)
+
+        out, l_aux = apply_op(
+            dispatch_combine, x2, logits,
+            self.experts.w1, self.experts.b1, self.experts.w2, self.experts.b2,
+            name="moe_dispatch",
+        )
+        self.l_aux = l_aux
+        return out.reshape(orig_shape)

@@ -1,0 +1,20 @@
+"""incubate.nn (reference: python/paddle/incubate/nn — memory-efficient
+attention, fused layers)."""
+from __future__ import annotations
+
+from paddle_tpu.nn import functional as _F
+
+__all__ = ["memory_efficient_attention", "FusedLinear", "FusedMultiHeadAttention"]
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0, scale=None,
+                               training=True):
+    """reference: incubate/nn/memory_efficient_attention.py — on TPU the Pallas
+    flash kernel IS the memory-efficient path."""
+    return _F.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_bias, dropout_p=p, training=training
+    )
+
+
+from paddle_tpu.nn.layer.common import Linear as FusedLinear  # noqa: E402
+from paddle_tpu.nn.layer.transformer import MultiHeadAttention as FusedMultiHeadAttention  # noqa: E402

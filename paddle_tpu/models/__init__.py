@@ -3,3 +3,9 @@ from paddle_tpu.models.llama import (  # noqa: F401
     LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM, LlamaModel,
     LlamaPretrainingCriterion, llama_7b_config, llama_tiny_config,
 )
+from paddle_tpu.models.bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertModel, bert_base_config, bert_tiny_config,
+)
+from paddle_tpu.models.gpt_moe import (  # noqa: F401
+    GptMoeConfig, GptMoeForCausalLM, gpt_moe_tiny_config,
+)

@@ -1,0 +1,97 @@
+"""GPT-MoE (BASELINE config[5]: expert-parallel GPT via Fleet meta-parallel).
+
+Reference analog: GPT decoder with the incubate MoE layer replacing the FFN
+(incubate/distributed/models/moe/moe_layer.py:263; EP dispatch
+global_scatter/global_gather). TPU-native: batched-expert FFN sharded over the
+"ep" mesh axis; dispatch/combine einsums lower to ICI all-to-all under GSPMD.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate.distributed.models.moe import MoELayer
+from paddle_tpu.models.llama import LlamaAttention, LlamaConfig
+
+__all__ = ["GptMoeConfig", "GptMoeForCausalLM", "gpt_moe_tiny_config"]
+
+
+@dataclass
+class GptMoeConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 16
+    num_experts: int = 8
+    expert_hidden_size: int = 4096
+    top_k: int = 2
+    max_position_embeddings: int = 2048
+    moe_aux_loss_weight: float = 0.01
+    dropout: float = 0.0
+
+
+def gpt_moe_tiny_config(**kw) -> GptMoeConfig:
+    cfg = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+               num_attention_heads=4, num_experts=4, expert_hidden_size=128,
+               max_position_embeddings=64)
+    cfg.update(kw)
+    return GptMoeConfig(**cfg)
+
+
+class GptMoeBlock(nn.Layer):
+    def __init__(self, config: GptMoeConfig):
+        super().__init__()
+        # reuse the rope attention from llama (standard decoder attention)
+        attn_cfg = LlamaConfig(
+            vocab_size=config.vocab_size, hidden_size=config.hidden_size,
+            intermediate_size=config.expert_hidden_size,
+            num_hidden_layers=config.num_hidden_layers,
+            num_attention_heads=config.num_attention_heads,
+            num_key_value_heads=config.num_attention_heads,
+            max_position_embeddings=config.max_position_embeddings,
+        )
+        self.ln1 = nn.LayerNorm(config.hidden_size)
+        self.attn = LlamaAttention(attn_cfg)
+        self.ln2 = nn.LayerNorm(config.hidden_size)
+        self.moe = MoELayer(config.hidden_size, num_expert=config.num_experts,
+                            d_hidden=config.expert_hidden_size, top_k=config.top_k)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.moe(self.ln2(x))
+        return x
+
+    @property
+    def l_aux(self):
+        return self.moe.l_aux
+
+
+class GptMoeForCausalLM(nn.Layer):
+    def __init__(self, config: GptMoeConfig):
+        super().__init__()
+        self.config = config
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.blocks = nn.LayerList([GptMoeBlock(config)
+                                    for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size)
+        self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        s = input_ids.shape[1]
+        pos = paddle.arange(s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        aux = None
+        for blk in self.blocks:
+            x = blk(x)
+            aux = blk.l_aux if aux is None else aux + blk.l_aux
+        logits = self.lm_head(self.ln_f(x))
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
+            if aux is not None:
+                loss = loss + self.config.moe_aux_loss_weight * aux.cast(loss.dtype)
+            return loss
+        return logits

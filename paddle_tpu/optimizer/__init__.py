@@ -27,6 +27,23 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
 lr = lr_mod
 
 
+def _colocate(val, state: dict):
+    """When ZeRO-sharded state lives on a multi-device mesh but the param is
+    single-device (eager path), replicate the param onto the state's mesh so
+    the fused update compiles (XLA then reduce-scatters internally)."""
+    if not state:
+        return val
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    for sv in state.values():
+        sh = getattr(sv, "sharding", None)
+        if isinstance(sh, NamedSharding) and len(sv.devices()) > 1:
+            if len(val.devices()) == 1:
+                return jax.device_put(val, NamedSharding(sh.mesh, PartitionSpec()))
+            return val
+    return val
+
+
 class Optimizer:
     """Base optimizer (reference: python/paddle/optimizer/optimizer.py)."""
 
@@ -93,8 +110,10 @@ class Optimizer:
             gv = g._value
             if gv.dtype != p._value.dtype:
                 gv = gv.astype(p._value.dtype)
+            pv = _colocate(p._value, self._state[sid])
+            gv = _colocate(gv, self._state[sid])
             new_p, new_state = self._jit_update(
-                p._value, gv, self._state[sid],
+                pv, gv, self._state[sid],
                 jnp.asarray(cur_lr, jnp.float32), jnp.asarray(self._step_count, jnp.int32),
             )
             p._set_value(new_p)
@@ -226,8 +245,12 @@ class AdamW(Adam):
             if sid not in self._state:
                 self._state[sid] = self._init_state(p)
             gv = g._value
+            if gv.dtype != p._value.dtype:
+                gv = gv.astype(p._value.dtype)
+            pv = _colocate(p._value, self._state[sid])
+            gv = _colocate(gv, self._state[sid])
             fn = self._jit_update if self._decay_flags.get(sid, True) else self._jit_update_nodecay
-            new_p, new_state = fn(p._value, gv, self._state[sid], cur_lr, step)
+            new_p, new_state = fn(pv, gv, self._state[sid], cur_lr, step)
             p._set_value(new_p)
             self._state[sid] = new_state
 
