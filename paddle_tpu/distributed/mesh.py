@@ -36,9 +36,9 @@ def build_mesh(axes: Mapping[str, int] | None = None, devices: Sequence | None =
     names = [a for a in AXIS_ORDER if a in axes] + [a for a in axes if a not in AXIS_ORDER]
     sizes = [int(axes[a]) for a in names]
     total = int(np.prod(sizes))
-    if total != len(devs):
+    if total > len(devs):
         raise ValueError(f"mesh axes {dict(axes)} require {total} devices, have {len(devs)}")
-    arr = np.array(devs).reshape(sizes)
+    arr = np.array(devs[:total]).reshape(sizes)
     mesh = Mesh(arr, tuple(names))
     set_mesh(mesh)
     return mesh
