@@ -1,0 +1,85 @@
+"""ctypes bindings for the native runtime core (paddle_tpu/csrc/core.cc).
+
+The library is built on demand with `make` (g++); if the toolchain or build is
+unavailable, `lib()` returns None and callers fall back to pure Python —
+mirroring how the reference degrades gracefully without optional native deps.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libpaddle_tpu_core.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        res = subprocess.run(["make", "-C", _CSRC], capture_output=True, timeout=120)
+        return res.returncode == 0 and os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def lib():
+    """Load (building if needed) the native core; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            L = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        # signatures
+        L.pt_store_server_start.restype = ctypes.c_void_p
+        L.pt_store_server_start.argtypes = [ctypes.c_int]
+        L.pt_store_server_port.restype = ctypes.c_int
+        L.pt_store_server_port.argtypes = [ctypes.c_void_p]
+        L.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+        L.pt_store_client_connect.restype = ctypes.c_void_p
+        L.pt_store_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        L.pt_store_client_close.argtypes = [ctypes.c_void_p]
+        L.pt_store_set.restype = ctypes.c_int
+        L.pt_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        L.pt_store_get.restype = ctypes.c_int
+        L.pt_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        L.pt_store_add.restype = ctypes.c_int64
+        L.pt_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        L.pt_store_wait.restype = ctypes.c_int
+        L.pt_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        L.pt_flag_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        L.pt_flag_get.restype = ctypes.c_int
+        L.pt_flag_get.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        L.pt_trace_enable.argtypes = [ctypes.c_int]
+        L.pt_trace_now_ns.restype = ctypes.c_int64
+        L.pt_trace_record.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                                      ctypes.c_uint64]
+        L.pt_trace_dump.restype = ctypes.c_int
+        L.pt_trace_dump.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        L.pt_pool_alloc.restype = ctypes.c_void_p
+        L.pt_pool_alloc.argtypes = [ctypes.c_int64]
+        L.pt_pool_free.argtypes = [ctypes.c_void_p]
+        L.pt_pool_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 3
+        L.pt_version.restype = ctypes.c_char_p
+        _lib = L
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None

@@ -1,0 +1,476 @@
+// paddle_tpu native runtime core.
+//
+// Reference parity (C++ where the reference is C++):
+//  - TCPStore: KV rendezvous over TCP sockets with blocking wait + atomic add
+//    (reference: paddle/phi/core/distributed/store/tcp_store.h:121, socket.cpp)
+//  - Flag registry: typed global flags (reference: paddle/common/flags.cc)
+//  - Host tracer: RecordEvent ring buffer -> chrome trace
+//    (reference: paddle/fluid/platform/profiler/host_tracer.h:26)
+//  - Pinned host buffer pool with stats: aligned staging buffers for H2D
+//    (reference: paddle/fluid/memory/allocation/allocator_facade.h:45)
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#define EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// TCP helpers: length-prefixed messages. Protocol:
+//   request:  op(1) keylen(u32) key vallen(u32) val
+//   ops: 0=SET 1=GET 2=ADD(val=int64 delta) 3=WAIT
+//   reply:    status(1: 0=ok 1=missing) vallen(u32) val
+// ---------------------------------------------------------------------------
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct StoreServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::atomic<bool> running{false};
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> data;
+
+  void handle_client(int fd) {
+    for (;;) {
+      uint8_t op;
+      if (!recv_all(fd, &op, 1)) break;
+      uint32_t klen;
+      if (!recv_all(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !recv_all(fd, &key[0], klen)) break;
+      uint32_t vlen;
+      if (!recv_all(fd, &vlen, 4)) break;
+      std::string val(vlen, '\0');
+      if (vlen && !recv_all(fd, &val[0], vlen)) break;
+
+      uint8_t status = 0;
+      std::string out;
+      if (op == 0) {  // SET
+        std::lock_guard<std::mutex> lk(mu);
+        data[key] = val;
+        cv.notify_all();
+      } else if (op == 1) {  // GET (non-blocking)
+        std::lock_guard<std::mutex> lk(mu);
+        auto it = data.find(key);
+        if (it == data.end()) {
+          status = 1;
+        } else {
+          out = it->second;
+        }
+      } else if (op == 2) {  // ADD
+        int64_t delta = 0;
+        if (val.size() == 8) memcpy(&delta, val.data(), 8);
+        std::lock_guard<std::mutex> lk(mu);
+        int64_t cur = 0;
+        auto it = data.find(key);
+        if (it != data.end() && it->second.size() == 8)
+          memcpy(&cur, it->second.data(), 8);
+        cur += delta;
+        std::string enc(8, '\0');
+        memcpy(&enc[0], &cur, 8);
+        data[key] = enc;
+        out = enc;
+        cv.notify_all();
+      } else if (op == 3) {  // WAIT (blocking until key exists)
+        int64_t timeout_ms = 300000;
+        if (val.size() == 8) memcpy(&timeout_ms, val.data(), 8);
+        std::unique_lock<std::mutex> lk(mu);
+        bool ok = cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                              [&] { return data.count(key) > 0; });
+        if (!ok) {
+          status = 1;
+        } else {
+          out = data[key];
+        }
+      }
+      uint32_t olen = static_cast<uint32_t>(out.size());
+      if (!send_all(fd, &status, 1)) break;
+      if (!send_all(fd, &olen, 4)) break;
+      if (olen && !send_all(fd, out.data(), olen)) break;
+    }
+    ::close(fd);
+  }
+
+  bool start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return false;
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 128) != 0) return false;
+    running = true;
+    accept_thread = std::thread([this] {
+      while (running) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) break;
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        workers.emplace_back([this, fd] { handle_client(fd); });
+      }
+    });
+    return true;
+  }
+
+  void stop() {
+    running = false;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    for (auto& w : workers)
+      if (w.joinable()) w.detach();  // blocked clients release on socket close
+    workers.clear();
+  }
+
+  ~StoreServer() { stop(); }
+};
+
+struct StoreClient {
+  int fd = -1;
+  std::mutex mu;
+
+  bool connect_to(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (std::chrono::steady_clock::now() < deadline) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      inet_pton(AF_INET, host, &addr.sin_addr);
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd);
+      fd = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    return false;
+  }
+
+  bool request(uint8_t op, const std::string& key, const std::string& val,
+               uint8_t* status, std::string* out) {
+    std::lock_guard<std::mutex> lk(mu);
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    uint32_t vlen = static_cast<uint32_t>(val.size());
+    if (!send_all(fd, &op, 1) || !send_all(fd, &klen, 4) ||
+        (klen && !send_all(fd, key.data(), klen)) || !send_all(fd, &vlen, 4) ||
+        (vlen && !send_all(fd, val.data(), vlen)))
+      return false;
+    if (!recv_all(fd, status, 1)) return false;
+    uint32_t olen;
+    if (!recv_all(fd, &olen, 4)) return false;
+    out->resize(olen);
+    if (olen && !recv_all(fd, &(*out)[0], olen)) return false;
+    return true;
+  }
+
+  ~StoreClient() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Flag registry
+// ---------------------------------------------------------------------------
+struct FlagRegistry {
+  std::mutex mu;
+  std::map<std::string, std::string> flags;
+};
+FlagRegistry g_flags;
+
+// ---------------------------------------------------------------------------
+// Host tracer: fixed ring of events
+// ---------------------------------------------------------------------------
+struct TraceEvent {
+  char name[64];
+  int64_t t_begin_ns;
+  int64_t t_end_ns;
+  uint64_t tid;
+};
+
+struct Tracer {
+  std::mutex mu;
+  std::vector<TraceEvent> ring;
+  size_t head = 0;
+  bool full = false;
+  bool enabled = false;
+  explicit Tracer(size_t cap = 1 << 16) { ring.resize(cap); }
+};
+Tracer g_tracer;
+
+// ---------------------------------------------------------------------------
+// Pinned host buffer pool
+// ---------------------------------------------------------------------------
+struct BufferPool {
+  std::mutex mu;
+  std::multimap<size_t, void*> free_list;
+  std::map<void*, size_t> allocated;
+  std::atomic<int64_t> bytes_in_use{0};
+  std::atomic<int64_t> bytes_pooled{0};
+  std::atomic<int64_t> peak_bytes{0};
+};
+BufferPool g_pool;
+
+}  // namespace
+
+// ===========================================================================
+// C ABI
+// ===========================================================================
+
+EXPORT void* pt_store_server_start(int port) {
+  auto* s = new StoreServer();
+  if (!s->start(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+EXPORT int pt_store_server_port(void* h) {
+  return static_cast<StoreServer*>(h)->port;
+}
+
+EXPORT void pt_store_server_stop(void* h) {
+  auto* s = static_cast<StoreServer*>(h);
+  s->stop();
+  delete s;
+}
+
+EXPORT void* pt_store_client_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient();
+  if (!c->connect_to(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+EXPORT void pt_store_client_close(void* h) { delete static_cast<StoreClient*>(h); }
+
+EXPORT int pt_store_set(void* h, const char* key, const uint8_t* val, int vlen) {
+  uint8_t status;
+  std::string out;
+  auto* c = static_cast<StoreClient*>(h);
+  if (!c->request(0, key, std::string(reinterpret_cast<const char*>(val), vlen),
+                  &status, &out))
+    return -1;
+  return status;
+}
+
+// returns length, or -1 missing / -2 io error; caller buffer must be big enough
+EXPORT int pt_store_get(void* h, const char* key, uint8_t* buf, int cap) {
+  uint8_t status;
+  std::string out;
+  auto* c = static_cast<StoreClient*>(h);
+  if (!c->request(1, key, "", &status, &out)) return -2;
+  if (status != 0) return -1;
+  int n = static_cast<int>(out.size());
+  if (n > cap) return -3;
+  memcpy(buf, out.data(), n);
+  return n;
+}
+
+EXPORT int64_t pt_store_add(void* h, const char* key, int64_t delta) {
+  uint8_t status;
+  std::string out, val(8, '\0');
+  memcpy(&val[0], &delta, 8);
+  auto* c = static_cast<StoreClient*>(h);
+  if (!c->request(2, key, val, &status, &out) || out.size() != 8) return INT64_MIN;
+  int64_t res;
+  memcpy(&res, out.data(), 8);
+  return res;
+}
+
+EXPORT int pt_store_wait(void* h, const char* key, int64_t timeout_ms, uint8_t* buf,
+                         int cap) {
+  uint8_t status;
+  std::string out, val(8, '\0');
+  memcpy(&val[0], &timeout_ms, 8);
+  auto* c = static_cast<StoreClient*>(h);
+  if (!c->request(3, key, val, &status, &out)) return -2;
+  if (status != 0) return -1;
+  int n = static_cast<int>(out.size());
+  if (n > cap) return -3;
+  memcpy(buf, out.data(), n);
+  return n;
+}
+
+// ---- flags ----------------------------------------------------------------
+
+EXPORT void pt_flag_set(const char* name, const char* value) {
+  std::lock_guard<std::mutex> lk(g_flags.mu);
+  g_flags.flags[name] = value;
+}
+
+EXPORT int pt_flag_get(const char* name, char* buf, int cap) {
+  std::lock_guard<std::mutex> lk(g_flags.mu);
+  auto it = g_flags.flags.find(name);
+  if (it == g_flags.flags.end()) return -1;
+  int n = static_cast<int>(it->second.size());
+  if (n + 1 > cap) return -2;
+  memcpy(buf, it->second.c_str(), n + 1);
+  return n;
+}
+
+// ---- tracer ---------------------------------------------------------------
+
+EXPORT void pt_trace_enable(int on) { g_tracer.enabled = on != 0; }
+
+EXPORT int64_t pt_trace_now_ns() { return now_ns(); }
+
+EXPORT void pt_trace_record(const char* name, int64_t t_begin_ns, int64_t t_end_ns,
+                            uint64_t tid) {
+  if (!g_tracer.enabled) return;
+  std::lock_guard<std::mutex> lk(g_tracer.mu);
+  TraceEvent& e = g_tracer.ring[g_tracer.head];
+  strncpy(e.name, name, sizeof(e.name) - 1);
+  e.name[sizeof(e.name) - 1] = '\0';
+  e.t_begin_ns = t_begin_ns;
+  e.t_end_ns = t_end_ns;
+  e.tid = tid;
+  g_tracer.head = (g_tracer.head + 1) % g_tracer.ring.size();
+  if (g_tracer.head == 0) g_tracer.full = true;
+}
+
+// fills arrays; returns count
+EXPORT int pt_trace_dump(char* names, int name_stride, int64_t* begins,
+                         int64_t* ends, uint64_t* tids, int cap) {
+  std::lock_guard<std::mutex> lk(g_tracer.mu);
+  size_t n = g_tracer.full ? g_tracer.ring.size() : g_tracer.head;
+  int count = 0;
+  for (size_t i = 0; i < n && count < cap; ++i, ++count) {
+    const TraceEvent& e = g_tracer.ring[i];
+    strncpy(names + count * name_stride, e.name, name_stride - 1);
+    names[count * name_stride + name_stride - 1] = '\0';
+    begins[count] = e.t_begin_ns;
+    ends[count] = e.t_end_ns;
+    tids[count] = e.tid;
+  }
+  return count;
+}
+
+EXPORT void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_tracer.mu);
+  g_tracer.head = 0;
+  g_tracer.full = false;
+}
+
+// ---- pinned pool ----------------------------------------------------------
+
+EXPORT void* pt_pool_alloc(int64_t nbytes) {
+  {
+    std::lock_guard<std::mutex> lk(g_pool.mu);
+    auto it = g_pool.free_list.lower_bound(static_cast<size_t>(nbytes));
+    if (it != g_pool.free_list.end() &&
+        it->first <= static_cast<size_t>(nbytes) * 2) {
+      void* p = it->second;
+      g_pool.bytes_pooled -= static_cast<int64_t>(it->first);
+      g_pool.allocated[p] = it->first;
+      g_pool.bytes_in_use += static_cast<int64_t>(it->first);
+      g_pool.free_list.erase(it);
+      int64_t peak = g_pool.peak_bytes.load();
+      while (g_pool.bytes_in_use > peak &&
+             !g_pool.peak_bytes.compare_exchange_weak(peak, g_pool.bytes_in_use)) {
+      }
+      return p;
+    }
+  }
+  void* p = nullptr;
+  if (posix_memalign(&p, 4096, static_cast<size_t>(nbytes)) != 0) return nullptr;
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  g_pool.allocated[p] = static_cast<size_t>(nbytes);
+  g_pool.bytes_in_use += nbytes;
+  int64_t peak = g_pool.peak_bytes.load();
+  while (g_pool.bytes_in_use > peak &&
+         !g_pool.peak_bytes.compare_exchange_weak(peak, g_pool.bytes_in_use)) {
+  }
+  return p;
+}
+
+EXPORT void pt_pool_free(void* p) {
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  auto it = g_pool.allocated.find(p);
+  if (it == g_pool.allocated.end()) return;
+  size_t sz = it->second;
+  g_pool.allocated.erase(it);
+  g_pool.bytes_in_use -= static_cast<int64_t>(sz);
+  g_pool.bytes_pooled += static_cast<int64_t>(sz);
+  g_pool.free_list.emplace(sz, p);
+}
+
+EXPORT void pt_pool_stats(int64_t* in_use, int64_t* pooled, int64_t* peak) {
+  *in_use = g_pool.bytes_in_use.load();
+  *pooled = g_pool.bytes_pooled.load();
+  *peak = g_pool.peak_bytes.load();
+}
+
+EXPORT void pt_pool_trim() {
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  for (auto& kv : g_pool.free_list) free(kv.second);
+  g_pool.bytes_pooled = 0;
+  g_pool.free_list.clear();
+}
+
+EXPORT const char* pt_version() { return "paddle_tpu_core 0.1"; }

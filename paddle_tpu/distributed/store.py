@@ -1,0 +1,253 @@
+"""TCPStore rendezvous (reference: phi/core/distributed/store/tcp_store.h:121).
+
+Backed by the native C++ socket server/client (csrc/core.cc) — the same
+length-prefixed KV protocol with blocking `wait` and atomic `add` the
+reference uses for NCCL-uniqueId-style bootstrap. On TPU pods this carries
+multi-host rendezvous metadata (coordinator address, per-host ranks) before
+jax.distributed initializes over DCN. Pure-Python fallback when the native
+lib is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+from paddle_tpu.core.native import lib as _native_lib
+
+__all__ = ["TCPStore", "create_or_get_global_tcp_store"]
+
+
+class _PyStoreServer:
+    """Fallback pure-Python server implementing the same semantics."""
+
+    def __init__(self, port=0):
+        self.data = {}
+        self.cv = threading.Condition()
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", port))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(64)
+        self._running = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while self._running:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn):
+        def recv_all(n):
+            buf = b""
+            while len(buf) < n:
+                c = conn.recv(n - len(buf))
+                if not c:
+                    raise ConnectionError
+                buf += c
+            return buf
+
+        try:
+            while True:
+                op = recv_all(1)[0]
+                klen = struct.unpack("<I", recv_all(4))[0]
+                key = recv_all(klen).decode()
+                vlen = struct.unpack("<I", recv_all(4))[0]
+                val = recv_all(vlen)
+                status, out = 0, b""
+                if op == 0:
+                    with self.cv:
+                        self.data[key] = val
+                        self.cv.notify_all()
+                elif op == 1:
+                    with self.cv:
+                        if key in self.data:
+                            out = self.data[key]
+                        else:
+                            status = 1
+                elif op == 2:
+                    delta = struct.unpack("<q", val)[0]
+                    with self.cv:
+                        cur = struct.unpack("<q", self.data.get(key, b"\0" * 8))[0]
+                        cur += delta
+                        self.data[key] = struct.pack("<q", cur)
+                        out = self.data[key]
+                        self.cv.notify_all()
+                elif op == 3:
+                    timeout = struct.unpack("<q", val)[0] / 1000.0
+                    with self.cv:
+                        ok = self.cv.wait_for(lambda: key in self.data, timeout)
+                        if ok:
+                            out = self.data[key]
+                        else:
+                            status = 1
+                conn.sendall(bytes([status]) + struct.pack("<I", len(out)) + out)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._running = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """KV store client (+embedded server on the master rank)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, is_master: bool = True,
+                 world_size: int = 1, timeout: float = 300.0):
+        self.timeout_ms = int(timeout * 1000)
+        self._native = _native_lib()
+        self._server = None
+        self._srv_py = None
+        if is_master:
+            if self._native is not None:
+                self._server = self._native.pt_store_server_start(port)
+                if self._server:
+                    port = self._native.pt_store_server_port(self._server)
+                else:
+                    self._native = None
+            if self._server is None:
+                self._srv_py = _PyStoreServer(port)
+                port = self._srv_py.port
+        self.host = host
+        self.port = port
+        if self._native is not None:
+            self._client = self._native.pt_store_client_connect(
+                host.encode(), port, self.timeout_ms)
+            if not self._client:
+                raise ConnectionError(f"TCPStore: cannot reach {host}:{port}")
+        else:
+            self._client = _PyClient(host, port, self.timeout_ms)
+
+    # -- API (reference Store interface) ------------------------------------
+    def set(self, key: str, value):
+        data = value if isinstance(value, bytes) else pickle.dumps(value)
+        if self._native is not None:
+            arr = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+            rc = self._native.pt_store_set(self._client, key.encode(), arr, len(data))
+            if rc != 0:
+                raise ConnectionError("TCPStore set failed")
+        else:
+            self._client.request(0, key, data)
+
+    def get(self, key: str, default=None):
+        if self._native is not None:
+            buf = (ctypes.c_uint8 * (1 << 20))()
+            n = self._native.pt_store_get(self._client, key.encode(), buf, len(buf))
+            if n == -1:
+                return default
+            if n < 0:
+                raise ConnectionError("TCPStore get failed")
+            return bytes(buf[:n])
+        st, out = self._client.request(1, key, b"")
+        return out if st == 0 else default
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._native is not None:
+            res = self._native.pt_store_add(self._client, key.encode(), delta)
+            if res == -(2 ** 63):
+                raise ConnectionError("TCPStore add failed")
+            return int(res)
+        st, out = self._client.request(2, key, struct.pack("<q", delta))
+        return struct.unpack("<q", out)[0]
+
+    def wait(self, keys, timeout: float | None = None):
+        tmo = int((timeout or self.timeout_ms / 1000.0) * 1000)
+        if isinstance(keys, str):
+            keys = [keys]
+        outs = []
+        for key in keys:
+            if self._native is not None:
+                buf = (ctypes.c_uint8 * (1 << 20))()
+                n = self._native.pt_store_wait(self._client, key.encode(), tmo, buf, len(buf))
+                if n == -1:
+                    raise TimeoutError(f"TCPStore wait timed out on '{key}'")
+                if n < 0:
+                    raise ConnectionError("TCPStore wait failed")
+                outs.append(bytes(buf[:n]))
+            else:
+                st, out = self._client.request(3, key, struct.pack("<q", tmo))
+                if st != 0:
+                    raise TimeoutError(f"TCPStore wait timed out on '{key}'")
+                outs.append(out)
+        return outs[0] if len(outs) == 1 else outs
+
+    def barrier(self, name: str, world_size: int, timeout: float = 300.0):
+        n = self.add(f"__barrier__/{name}", 1)
+        if n == world_size:
+            self.set(f"__barrier_done__/{name}", b"1")
+        self.wait(f"__barrier_done__/{name}", timeout)
+
+    def close(self):
+        if self._native is not None:
+            if self._client:
+                self._native.pt_store_client_close(self._client)
+                self._client = None
+            if self._server:
+                self._native.pt_store_server_stop(self._server)
+                self._server = None
+        elif self._srv_py is not None:
+            self._srv_py.stop()
+
+
+class _PyClient:
+    def __init__(self, host, port, timeout_ms):
+        deadline = time.time() + timeout_ms / 1000.0
+        last = None
+        while time.time() < deadline:
+            try:
+                self.sock = socket.create_connection((host, port), timeout=5)
+                self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._lock = threading.Lock()
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.05)
+        raise ConnectionError(f"TCPStore: cannot reach {host}:{port}: {last}")
+
+    def request(self, op, key, val):
+        kb = key.encode()
+        msg = bytes([op]) + struct.pack("<I", len(kb)) + kb + struct.pack("<I", len(val)) + val
+        with self._lock:
+            self.sock.sendall(msg)
+            st = self._recv(1)[0]
+            n = struct.unpack("<I", self._recv(4))[0]
+            out = self._recv(n)
+        return st, out
+
+    def _recv(self, n):
+        buf = b""
+        while len(buf) < n:
+            c = self.sock.recv(n - len(buf))
+            if not c:
+                raise ConnectionError
+            buf += c
+        return buf
+
+
+_global_store = [None]
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """reference: parallel.py:1101 core.create_or_get_global_tcp_store."""
+    if _global_store[0] is None:
+        master = os.getenv("PADDLE_MASTER", "")
+        rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        if master and ":" in master:
+            host, port = master.rsplit(":", 1)
+            _global_store[0] = TCPStore(host, int(port), is_master=(rank == 0))
+        else:
+            _global_store[0] = TCPStore(is_master=True)
+    return _global_store[0]

@@ -1,0 +1,109 @@
+"""Native C++ runtime core tests: TCPStore rendezvous, flags, tracer, pool
+(reference analogs: tcp_store.h, common/flags.cc, host_tracer.h,
+allocator_facade.h). Skipped only if no C++ toolchain is present."""
+import ctypes
+import threading
+
+import pytest
+
+from paddle_tpu.core.native import available, lib
+
+
+native = pytest.mark.skipif(not available(), reason="native core unavailable")
+
+
+@native
+class TestNativeTCPStore:
+    def test_set_get_add_wait(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore(is_master=True)
+        try:
+            client = TCPStore("127.0.0.1", master.port, is_master=False)
+            client.set("hello", b"world")
+            assert master.get("hello") == b"world"
+            assert client.get("missing", default=None) is None
+            assert client.add("ctr", 5) == 5
+            assert master.add("ctr", 2) == 7
+
+            results = []
+
+            def waiter():
+                results.append(client.wait("late_key", timeout=10))
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            import time
+
+            time.sleep(0.1)
+            master.set("late_key", b"arrived")
+            t.join(5)
+            assert results == [b"arrived"]
+        finally:
+            master.close()
+
+    def test_barrier(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore(is_master=True)
+        try:
+            clients = [TCPStore("127.0.0.1", master.port, is_master=False) for _ in range(3)]
+            done = []
+
+            def enter(c, i):
+                c.barrier("b1", 3, timeout=10)
+                done.append(i)
+
+            threads = [threading.Thread(target=enter, args=(c, i)) for i, c in enumerate(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            assert sorted(done) == [0, 1, 2]
+        finally:
+            master.close()
+
+
+@native
+class TestNativeFlagsTracerPool:
+    def test_flags(self):
+        L = lib()
+        L.pt_flag_set(b"check_nan_inf", b"true")
+        buf = ctypes.create_string_buffer(64)
+        n = L.pt_flag_get(b"check_nan_inf", buf, 64)
+        assert n == 4 and buf.value == b"true"
+        assert L.pt_flag_get(b"nope", buf, 64) == -1
+
+    def test_tracer_roundtrip(self):
+        L = lib()
+        L.pt_trace_enable(1)
+        t0 = L.pt_trace_now_ns()
+        L.pt_trace_record(b"matmul", t0, t0 + 1000, 1)
+        L.pt_trace_record(b"conv2d", t0 + 2000, t0 + 5000, 1)
+        cap, stride = 16, 64
+        names = ctypes.create_string_buffer(cap * stride)
+        begins = (ctypes.c_int64 * cap)()
+        ends = (ctypes.c_int64 * cap)()
+        tids = (ctypes.c_uint64 * cap)()
+        n = L.pt_trace_dump(names, stride, begins, ends, tids, cap)
+        assert n >= 2
+        got = [names[i * stride : i * stride + 6].split(b"\0")[0] for i in range(n)]
+        assert b"matmul" in got and b"conv2d" in got
+        L.pt_trace_enable(0)
+
+    def test_pool_reuse_and_stats(self):
+        L = lib()
+        p1 = L.pt_pool_alloc(1 << 20)
+        assert p1
+        in_use = ctypes.c_int64()
+        pooled = ctypes.c_int64()
+        peak = ctypes.c_int64()
+        L.pt_pool_stats(ctypes.byref(in_use), ctypes.byref(pooled), ctypes.byref(peak))
+        assert in_use.value >= 1 << 20
+        L.pt_pool_free(p1)
+        p2 = L.pt_pool_alloc(1 << 20)  # should reuse the pooled block
+        assert p2 == p1
+        L.pt_pool_free(p2)
+        L.pt_pool_stats(ctypes.byref(in_use), ctypes.byref(pooled), ctypes.byref(peak))
+        assert pooled.value >= 1 << 20
+        assert peak.value >= 1 << 20
