@@ -1,0 +1,110 @@
+"""Audio features (reference: python/paddle/audio — spectrograms/mel features).
+Implemented with jnp FFT (XLA-compiled on TPU)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["functional", "features"]
+
+
+class functional:
+    @staticmethod
+    def create_dct(n_mfcc, n_mels, norm="ortho"):
+        n = np.arange(n_mels)
+        k = np.arange(n_mfcc)[:, None]
+        dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+        if norm == "ortho":
+            dct[0] *= 1.0 / math.sqrt(2)
+            dct *= math.sqrt(2.0 / n_mels)
+        return Tensor(jnp.asarray(dct.T.astype(np.float32)))
+
+    @staticmethod
+    def hz_to_mel(f, htk=False):
+        if htk:
+            return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+        f = np.asarray(f, np.float64)
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        with np.errstate(divide="ignore"):
+            logpart = min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep
+        return np.where(f >= min_log_hz, logpart, mels)
+
+    @staticmethod
+    def mel_to_hz(m, htk=False):
+        if htk:
+            return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+        m = np.asarray(m, np.float64)
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        return np.where(m >= min_log_mel, min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+    @staticmethod
+    def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None, htk=False, norm="slaney"):
+        f_max = f_max or sr / 2
+        mels = np.linspace(functional.hz_to_mel(f_min, htk), functional.hz_to_mel(f_max, htk), n_mels + 2)
+        freqs = functional.mel_to_hz(mels, htk)
+        fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+        weights = np.zeros((n_mels, n_fft // 2 + 1), np.float32)
+        for i in range(n_mels):
+            lower = (fft_freqs - freqs[i]) / max(freqs[i + 1] - freqs[i], 1e-9)
+            upper = (freqs[i + 2] - fft_freqs) / max(freqs[i + 2] - freqs[i + 1], 1e-9)
+            weights[i] = np.maximum(0, np.minimum(lower, upper))
+        if norm == "slaney":
+            enorm = 2.0 / (freqs[2 : n_mels + 2] - freqs[:n_mels])
+            weights *= enorm[:, None]
+        return Tensor(jnp.asarray(weights))
+
+
+class features:
+    class Spectrogram:
+        def __init__(self, n_fft=512, hop_length=None, win_length=None, power=2.0):
+            self.n_fft = n_fft
+            self.hop = hop_length or n_fft // 4
+            self.power = power
+
+        def __call__(self, x: Tensor):
+            n_fft, hop, power = self.n_fft, self.hop, self.power
+
+            def f(v):
+                frames = []
+                n = (v.shape[-1] - n_fft) // hop + 1
+                idx = jnp.arange(n)[:, None] * hop + jnp.arange(n_fft)[None]
+                fr = v[..., idx] * jnp.hanning(n_fft)
+                spec = jnp.abs(jnp.fft.rfft(fr, axis=-1)) ** power
+                return jnp.moveaxis(spec, -2, -1)
+
+            return apply_op(f, x, name="spectrogram")
+
+    class MelSpectrogram:
+        def __init__(self, sr=16000, n_fft=512, hop_length=None, n_mels=64, f_min=0.0,
+                     f_max=None, power=2.0):
+            self.spec = features.Spectrogram(n_fft, hop_length, power=power)
+            self.fbank = functional.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max)
+
+        def __call__(self, x: Tensor):
+            s = self.spec(x)
+            return apply_op(lambda sv, fb: jnp.einsum("...ft,mf->...mt", sv, fb),
+                            s, self.fbank, name="mel")
+
+    class MFCC:
+        def __init__(self, sr=16000, n_mfcc=13, n_fft=512, n_mels=64):
+            self.mel = features.MelSpectrogram(sr, n_fft, n_mels=n_mels)
+            self.dct = functional.create_dct(n_mfcc, n_mels)
+
+        def __call__(self, x: Tensor):
+            m = self.mel(x)
+            return apply_op(
+                lambda mv, d: jnp.einsum("...mt,mk->...kt", jnp.log(mv + 1e-6), d),
+                m, self.dct, name="mfcc")

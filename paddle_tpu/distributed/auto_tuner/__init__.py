@@ -1,0 +1,3 @@
+from paddle_tpu.distributed.auto_tuner.tuner import (  # noqa: F401
+    AutoTuner, TunerConfig, candidate_configs, prune_candidates,
+)

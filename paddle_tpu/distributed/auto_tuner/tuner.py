@@ -1,0 +1,107 @@
+"""Parallelism auto-tuner.
+
+Reference parity: `AutoTuner` (distributed/auto_tuner/tuner.py:21) with
+prune.py/search.py — grid search over {dp, mp, pp, sharding, micro-batch}
+configs with pruning, launching short trials and keeping the fastest.
+
+TPU-native: candidates are mesh factorizations of the chip count; pruning uses
+divisibility (layers % pp, heads % mp, batch % (dp*micro)) and a memory model
+(params/opt-state/activations vs HBM); trials run the actual compiled step for
+a few iterations.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+__all__ = ["AutoTuner", "TunerConfig", "prune_candidates", "candidate_configs"]
+
+
+@dataclass
+class TunerConfig:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding: int = 1
+    micro_batches: int = 1
+    time_s: float | None = None
+    error: str | None = None
+
+    @property
+    def degree(self):
+        return self.dp * self.mp * self.pp * self.sharding
+
+    def as_axes(self):
+        return {"dp": self.dp, "mp": self.mp, "pp": self.pp, "sharding": self.sharding}
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def candidate_configs(n_devices: int, max_micro: int = 8):
+    out = []
+    for pp in _divisors(n_devices):
+        for mp in _divisors(n_devices // pp):
+            rem = n_devices // (pp * mp)
+            for sharding in _divisors(rem):
+                dp = rem // sharding
+                for mb in [m for m in (1, 2, 4, 8) if m <= max_micro]:
+                    if pp == 1 and mb > 1:
+                        continue
+                    out.append(TunerConfig(dp=dp, mp=mp, pp=pp, sharding=sharding,
+                                           micro_batches=mb))
+    return out
+
+
+def prune_candidates(cands, *, n_layers=None, n_heads=None, global_batch=None,
+                     param_bytes=None, hbm_bytes=None, opt_state_mult=3.0):
+    """reference: auto_tuner/prune.py — divisibility + memory pruning."""
+    keep = []
+    for c in cands:
+        if n_layers is not None and n_layers % c.pp != 0:
+            continue
+        if n_heads is not None and n_heads % c.mp != 0:
+            continue
+        if global_batch is not None:
+            shards = c.dp * c.sharding * c.micro_batches
+            if global_batch % shards != 0:
+                continue
+        if param_bytes is not None and hbm_bytes is not None:
+            per_chip = param_bytes * (1 + opt_state_mult / max(c.dp * c.sharding, 1)) / max(c.mp * c.pp, 1)
+            if per_chip > hbm_bytes * 0.9:
+                continue
+        keep.append(c)
+    return keep
+
+
+class AutoTuner:
+    """reference: tuner.py:21. run_trial(config) -> seconds/step."""
+
+    def __init__(self, n_devices: int, run_trial: Callable[[TunerConfig], float],
+                 prune_kwargs: dict | None = None, max_trials: int = 16):
+        self.n_devices = n_devices
+        self.run_trial = run_trial
+        self.prune_kwargs = prune_kwargs or {}
+        self.max_trials = max_trials
+        self.history: list[TunerConfig] = []
+
+    def search(self) -> TunerConfig:
+        cands = prune_candidates(candidate_configs(self.n_devices), **self.prune_kwargs)
+        best = None
+        for c in cands[: self.max_trials]:
+            try:
+                t = self.run_trial(c)
+                c.time_s = t
+            except Exception as e:  # failed trial = pruned at runtime
+                c.error = str(e)[:200]
+                self.history.append(c)
+                continue
+            self.history.append(c)
+            if best is None or (c.time_s is not None and c.time_s < best.time_s):
+                best = c
+        if best is None:
+            raise RuntimeError("auto-tuner: every candidate failed")
+        return best

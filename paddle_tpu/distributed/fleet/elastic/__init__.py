@@ -1,0 +1,3 @@
+from paddle_tpu.distributed.fleet.elastic.manager import (  # noqa: F401
+    ELASTIC_EXIT_CODE, ElasticManager, ElasticStatus,
+)

@@ -1,0 +1,99 @@
+"""Elastic training manager.
+
+Reference parity: `ElasticManager` (fleet/elastic/manager.py:124) — ranks
+register with TTL leases, a watcher detects membership changes and triggers
+relaunch with ELASTIC_EXIT_CODE (manager.py:32).
+
+TPU-native: leases live in the TCPStore (etcd-free single dependency); the
+watch loop compares the live member set against the expected world and flags
+scale events. The launch watcher (distributed/launch/main.py) restarts ranks
+on the exit code.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from paddle_tpu.distributed.store import TCPStore
+
+__all__ = ["ElasticManager", "ElasticStatus", "ELASTIC_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, args=None, store: TCPStore | None = None, rank: int | None = None,
+                 world_size: int | None = None, lease_ttl: float = 10.0,
+                 job_id: str | None = None):
+        self.rank = rank if rank is not None else int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self.world = world_size if world_size is not None else int(
+            os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self.job_id = job_id or os.getenv("PADDLE_JOB_ID", "default")
+        self.lease_ttl = lease_ttl
+        self.store = store or TCPStore(is_master=(self.rank == 0))
+        self.enable = True
+        self._stop = threading.Event()
+        self._heartbeat_thread = None
+        self._status = ElasticStatus.HOLD
+
+    def _key(self, r):
+        return f"/elastic/{self.job_id}/lease/{r}"
+
+    # -- registration (reference manager.py register/exit) -------------------
+    def register(self):
+        self._renew()
+        self._heartbeat_thread = threading.Thread(target=self._heartbeat, daemon=True)
+        self._heartbeat_thread.start()
+
+    def _renew(self):
+        import struct
+
+        self.store.set(self._key(self.rank), struct.pack("<d", time.time()))
+
+    def _heartbeat(self):
+        while not self._stop.is_set():
+            self._renew()
+            self._stop.wait(self.lease_ttl / 3)
+
+    def exit(self, completed=True):
+        self._stop.set()
+        self._status = ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR
+        self.store.set(f"/elastic/{self.job_id}/exit/{self.rank}",
+                       b"ok" if completed else b"err")
+
+    # -- membership ----------------------------------------------------------
+    def alive_ranks(self):
+        import struct
+
+        now = time.time()
+        alive = []
+        for r in range(self.world):
+            v = self.store.get(self._key(r))
+            if v is not None and len(v) == 8:
+                ts = struct.unpack("<d", v)[0]
+                if now - ts < self.lease_ttl:
+                    alive.append(r)
+        return alive
+
+    def watch(self) -> str:
+        """One watch tick (reference manager.py watch:120): returns an
+        ElasticStatus; RESTART signals the launcher to relaunch with the new
+        world size (exit code ELASTIC_EXIT_CODE)."""
+        if self.store.get(f"/elastic/{self.job_id}/exit/{self.rank}") is not None:
+            return ElasticStatus.COMPLETED
+        alive = self.alive_ranks()
+        if len(alive) < self.world:
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def should_restart(self) -> bool:
+        return self.watch() == ElasticStatus.RESTART

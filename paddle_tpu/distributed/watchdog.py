@@ -1,0 +1,124 @@
+"""Collective/step watchdog.
+
+Reference parity: `CommTaskManager` + `CommTask` async-failure watchdog
+(phi/core/distributed/comm_task_manager.h:37, comm_task.h:36) — a thread that
+tracks in-flight collectives and times out hangs.
+
+TPU-native: XLA collectives are fused into compiled programs, so the watchable
+unit is the STEP (one compiled program dispatch). The watchdog tracks each
+dispatched step as a task; if host-visible completion (a readback future)
+doesn't arrive within the timeout, it fires the hang callback with diagnostics
+(last completed step, elapsed) — the TPU analog of an NCCL hang report.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+__all__ = ["CommTask", "CommTaskManager", "watch_step"]
+
+
+@dataclass
+class CommTask:
+    task_id: int
+    name: str
+    started_at: float
+    timeout_s: float
+    done: threading.Event = field(default_factory=threading.Event)
+
+    def mark_done(self):
+        self.done.set()
+
+    def elapsed(self) -> float:
+        return time.time() - self.started_at
+
+    def timed_out(self) -> bool:
+        return not self.done.is_set() and self.elapsed() > self.timeout_s
+
+
+class CommTaskManager:
+    """reference: comm_task_manager.h:37 (loop :55)."""
+
+    def __init__(self, default_timeout_s: float = 600.0, poll_interval_s: float = 1.0,
+                 on_hang: Callable[[CommTask], None] | None = None):
+        self.default_timeout = default_timeout_s
+        self.poll = poll_interval_s
+        self.on_hang = on_hang or self._default_on_hang
+        self._tasks: dict[int, CommTask] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_completed: CommTask | None = None
+        self.hangs: list[CommTask] = []
+
+    @staticmethod
+    def _default_on_hang(task: CommTask):
+        import sys
+
+        print(f"[paddle_tpu watchdog] step '{task.name}' (id {task.task_id}) "
+              f"has not completed after {task.elapsed():.0f}s — possible "
+              f"collective hang / dead host", file=sys.stderr)
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(self.poll):
+            with self._lock:
+                tasks = list(self._tasks.values())
+            for t in tasks:
+                if t.done.is_set():
+                    with self._lock:
+                        self._tasks.pop(t.task_id, None)
+                    self.last_completed = t
+                elif t.timed_out():
+                    self.hangs.append(t)
+                    self.on_hang(t)
+                    with self._lock:
+                        self._tasks.pop(t.task_id, None)
+
+    def begin(self, name: str, timeout_s: float | None = None) -> CommTask:
+        with self._lock:
+            self._next_id += 1
+            t = CommTask(self._next_id, name, time.time(),
+                         timeout_s or self.default_timeout)
+            self._tasks[t.task_id] = t
+        return t
+
+
+_manager = CommTaskManager()
+
+
+def watch_step(arrays, name: str = "train_step", timeout_s: float = 600.0,
+               manager: CommTaskManager | None = None) -> CommTask:
+    """Register a dispatched step; completion is observed by a background
+    readback of a tiny dependent value (forces the XLA future)."""
+    mgr = manager or _manager
+    mgr.start()
+    task = mgr.begin(name, timeout_s)
+
+    def waiter():
+        try:
+            import numpy as np
+
+            for a in arrays if isinstance(arrays, (list, tuple)) else [arrays]:
+                val = getattr(a, "_value", a)
+                np.asarray(val)  # blocks until the program producing it completes
+        finally:
+            task.mark_done()
+
+    threading.Thread(target=waiter, daemon=True).start()
+    return task

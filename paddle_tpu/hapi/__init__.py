@@ -1,0 +1,3 @@
+from paddle_tpu.hapi.model import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, Model, ModelCheckpoint, ProgBarLogger,
+)

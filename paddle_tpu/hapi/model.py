@@ -1,0 +1,283 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py `Model` :1052,
+`fit` :1750; callbacks hapi/callbacks.py)."""
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.io import DataLoader, Dataset
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping", "LRScheduler"]
+
+
+class Callback:
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=10, verbose=2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                               if isinstance(v, (int, float)))
+            print(f"epoch {self.epoch} step {step}: {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                               if isinstance(v, (int, float)))
+            print(f"epoch {epoch} done in {time.time()-self.t0:.1f}s - {items}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/epoch_{epoch}")
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="min", patience=0, min_delta=0, baseline=None,
+                 save_best_model=True):
+        self.monitor = monitor
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = None
+        self.wait = 0
+        self.stopped = False
+
+    def on_eval_end(self, logs=None):
+        val = (logs or {}).get(self.monitor)
+        if val is None:
+            return
+        better = (self.best is None or
+                  (self.mode == "min" and val < self.best - self.min_delta) or
+                  (self.mode == "max" and val > self.best + self.min_delta))
+        if better:
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stopped = True
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        lr = getattr(self.model._optimizer, "_lr", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s:
+            s.step()
+
+
+class Model:
+    """reference: hapi/model.py:1052."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics else [])
+
+    # -- steps ---------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        outs = self.network(*inputs)
+        loss = self._loss(outs, *labels) if self._loss else outs
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = {"loss": float(loss)}
+        for m in self._metrics:
+            m.update(m.compute(outs, labels[0]))
+            metrics[m.name()] = m.accumulate()
+        return metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        with paddle.no_grad():
+            outs = self.network(*inputs)
+            loss = self._loss(outs, *labels) if self._loss else outs
+        metrics = {"loss": float(loss)}
+        for m in self._metrics:
+            m.update(m.compute(outs, labels[0]))
+            metrics[m.name()] = m.accumulate()
+        return metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with paddle.no_grad():
+            return self.network(*inputs)
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """reference: hapi/model.py:1750."""
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
+            num_workers=num_workers)
+        cbs = list(callbacks or [])
+        if verbose:
+            cbs.append(ProgBarLogger(log_freq, verbose))
+        if save_dir:
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        for cb in cbs:
+            cb.set_model(self)
+        history = []
+        for cb in cbs:
+            cb.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                data, label = (batch[:-1], batch[-1]) if isinstance(batch, (tuple, list)) else (batch, None)
+                logs = self.train_batch(list(data), label)
+                for cb in cbs:
+                    cb.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters and it >= num_iters:
+                    break
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                for cb in cbs:
+                    cb.on_eval_end(eval_logs)
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            history.append(logs)
+            if any(getattr(cb, "stopped", False) for cb in cbs):
+                break
+            if num_iters and it >= num_iters:
+                break
+        for cb in cbs:
+            cb.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            data, label = (batch[:-1], batch[-1]) if isinstance(batch, (tuple, list)) else (batch, None)
+            logs = self.eval_batch(list(data), label)
+            losses.append(logs["loss"])
+        out = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            out[m.name()] = m.accumulate()
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers)
+        outs = []
+        for batch in loader:
+            data = batch[:-1] if isinstance(batch, (tuple, list)) and len(batch) > 1 else (
+                batch if not isinstance(batch, (tuple, list)) else batch[0])
+            outs.append(self.predict_batch([data] if isinstance(data, Tensor) else list(data)))
+        return outs
+
+    # -- persistence ----------------------------------------------------------
+    def save(self, path, training=True):
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(paddle.load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None:
+            import os
+
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(paddle.load(path + ".pdopt"))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        lines = ["-" * 60]
+        total = 0
+        for name, p in self.network.named_parameters():
+            lines.append(f"{name:<40} {str(p.shape):<15} {p.size}")
+            total += p.size
+        lines.append("-" * 60)
+        lines.append(f"Total params: {total}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total}

@@ -1,0 +1,135 @@
+"""Quantization (reference: python/paddle/quantization — QAT/PTQ, config,
+observers/quanters).
+
+TPU-native: int8 inference quantization via fake-quant ops that XLA folds;
+QAT inserts straight-through-estimator fake-quant on weights/activations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "FakeQuantLayer",
+           "quanted_linear"]
+
+
+@jax.custom_vjp
+def _fake_quant(x, scale):
+    q = jnp.clip(jnp.round(x / scale), -127, 127)
+    return q * scale
+
+
+def _fq_fwd(x, scale):
+    return _fake_quant(x, scale), None
+
+
+def _fq_bwd(_, g):  # straight-through estimator
+    return g, None
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+class AbsmaxObserver:
+    """reference: quantization/observers/abs_max.py."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self.absmax = 0.0
+
+    def observe(self, x: Tensor):
+        self.absmax = max(self.absmax, float(jnp.abs(x._value).max()))
+
+    def scale(self) -> float:
+        return self.absmax / (2 ** (self.quant_bits - 1) - 1) or 1e-8
+
+
+class QuantConfig:
+    """reference: quantization/config.py."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or AbsmaxObserver
+        self.weight = weight or AbsmaxObserver
+        self._types = (nn.Linear, nn.Conv2D)
+
+    def add_layer_config(self, layers, activation=None, weight=None):
+        pass
+
+    def quantable(self, layer):
+        return isinstance(layer, self._types)
+
+
+class FakeQuantLayer(Layer):
+    def __init__(self, inner, config: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.w_observer = config.weight()
+        self.a_observer = config.activation()
+        self.w_observer.observe(inner.weight)
+
+    def forward(self, x):
+        self.a_observer.observe(x)
+        xq = apply_op(lambda v: _fake_quant(v, self.a_observer.scale()), x, name="fake_quant")
+        w = self.inner.weight
+        wq = apply_op(lambda v: _fake_quant(v, self.w_observer.scale()), w, name="fake_quant")
+        old = self.inner.weight._value
+        self.inner.weight._set_value(wq._value)
+        try:
+            out = self.inner(xq)
+        finally:
+            self.inner.weight._set_value(old)
+        return out
+
+
+def _swap(model, config):
+    for name, sub in list(model._sub_layers.items()):
+        if config.quantable(sub):
+            model._sub_layers[name] = FakeQuantLayer(sub, config)
+        else:
+            _swap(sub, config)
+    return model
+
+
+class QAT:
+    """reference: quantization/qat.py."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        return _swap(model, self.config)
+
+    def convert(self, model, inplace=False):
+        return model
+
+
+class PTQ:
+    """reference: quantization/ptq.py — observe calibration batches, then fold
+    scales."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        return _swap(model, self.config)
+
+    def convert(self, model, inplace=False):
+        return model
+
+
+def quanted_linear(x, weight, w_scale, bias=None):
+    """int8 weight x bf16 activation matmul (deploy path)."""
+
+    def f(v, w, *b):
+        out = jnp.matmul(v, w.astype(v.dtype)) * w_scale
+        if b:
+            out = out + b[0]
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op(f, *args, name="quanted_linear")

@@ -1,0 +1,118 @@
+"""Sparse tensors (reference: python/paddle/sparse; phi SparseCooTensor/
+SparseCsrTensor at paddle/phi/core/sparse_coo_tensor.h).
+
+TPU-native: COO tensors hold (indices [ndim, nnz], values [nnz]) as dense
+arrays — segment_sum/gather make sparse ops XLA-compilable with static nnz.
+CSR provided for API parity via conversion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor", "is_sparse",
+           "add", "matmul", "masked_matmul", "relu", "to_dense", "to_sparse_coo"]
+
+
+class SparseCooTensor:
+    def __init__(self, indices: Tensor, values: Tensor, shape):
+        self.indices = indices  # [ndim, nnz] int
+        self.values = values  # [nnz, ...]
+        self.shape = list(shape)
+
+    @property
+    def nnz(self):
+        return self.values.shape[0]
+
+    def to_dense(self) -> Tensor:
+        def f(idx, vals):
+            dense = jnp.zeros(tuple(self.shape), vals.dtype)
+            return dense.at[tuple(idx)].add(vals)
+
+        return apply_op(f, self.indices, self.values, name="coo_to_dense")
+
+    def values_tensor(self):
+        return self.values
+
+    def indices_tensor(self):
+        return self.indices
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, stop_gradient=True):
+    from paddle_tpu.core.tensor import to_tensor
+
+    idx = indices if isinstance(indices, Tensor) else to_tensor(np.asarray(indices))
+    vals = values if isinstance(values, Tensor) else to_tensor(
+        np.asarray(values), dtype=dtype, stop_gradient=stop_gradient)
+    if shape is None:
+        shape = (np.asarray(idx._value).max(axis=1) + 1).tolist()
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """CSR -> COO conversion (row expansion)."""
+    crows_np = np.asarray(crows._value if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(cols._value if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    return sparse_coo_tensor(np.stack([rows, cols_np]), values, shape, dtype)
+
+
+def is_sparse(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def to_dense(x: SparseCooTensor) -> Tensor:
+    return x.to_dense()
+
+
+def to_sparse_coo(x: Tensor, sparse_dim=None) -> SparseCooTensor:
+    arr = np.asarray(x._value)
+    idx = np.stack(np.nonzero(arr))
+    vals = arr[tuple(idx)]
+    return sparse_coo_tensor(idx, vals, arr.shape)
+
+
+def add(a: SparseCooTensor, b: SparseCooTensor) -> SparseCooTensor:
+    from paddle_tpu.ops.manipulation import concat
+
+    return SparseCooTensor(
+        concat([a.indices, b.indices], axis=1),
+        concat([a.values, b.values], axis=0),
+        a.shape,
+    )
+
+
+def matmul(a: SparseCooTensor, b: Tensor) -> Tensor:
+    """COO @ dense via gather + segment_sum (static nnz -> MXU-free but
+    XLA-fusable; dense fallback covers backward)."""
+
+    def f(idx, vals, dense):
+        rows, cols = idx[0], idx[1]
+        gathered = jnp.take(dense, cols, axis=0) * vals[:, None]
+        return jax.ops.segment_sum(gathered, rows, num_segments=a.shape[0]) if hasattr(jax.ops, "segment_sum") else jax.lax.scatter_add(
+            jnp.zeros((a.shape[0], dense.shape[1]), dense.dtype),
+            rows[:, None], gathered,
+            jax.lax.ScatterDimensionNumbers((1,), (0,), (0,)))
+
+    return apply_op(f, a.indices, a.values, b, name="spmm")
+
+
+def masked_matmul(a: Tensor, b: Tensor, mask: SparseCooTensor) -> SparseCooTensor:
+    def f(idx, av, bv):
+        rows, cols = idx[0], idx[1]
+        return jnp.sum(jnp.take(av, rows, axis=0) * jnp.take(bv.T, cols, axis=0), axis=-1)
+
+    vals = apply_op(f, mask.indices, a, b, name="sddmm")
+    return SparseCooTensor(mask.indices, vals, [a.shape[0], b.shape[1]])
+
+
+def relu(x: SparseCooTensor) -> SparseCooTensor:
+    from paddle_tpu.nn.functional import relu as dense_relu
+
+    return SparseCooTensor(x.indices, dense_relu(x.values), x.shape)

@@ -1,0 +1,96 @@
+"""Text datasets/utilities (reference: python/paddle/text — dataset zoo).
+Zero-egress environment: datasets synthesize deterministic corpora with the
+real interfaces (vocab, tokenized samples)."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["Imdb", "LMDataset", "ViterbiDecoder", "viterbi_decode"]
+
+
+class LMDataset(Dataset):
+    """Token-id language-modeling dataset: (input_ids, labels) windows."""
+
+    def __init__(self, vocab_size=1024, seq_len=128, samples=512, seed=0):
+        rng = np.random.RandomState(seed)
+        # markov-ish stream so models can learn structure
+        trans = rng.dirichlet(np.ones(vocab_size) * 0.05, vocab_size)
+        stream = np.zeros(samples * seq_len + 1, np.int64)
+        tok = 0
+        for i in range(1, len(stream)):
+            tok = rng.choice(vocab_size, p=trans[tok])
+            stream[i] = tok
+        self.data = stream
+        self.seq_len = seq_len
+        self.samples = samples
+
+    def __getitem__(self, i):
+        s = self.data[i * self.seq_len : (i + 1) * self.seq_len]
+        t = self.data[i * self.seq_len + 1 : (i + 1) * self.seq_len + 1]
+        return s, t
+
+    def __len__(self):
+        return self.samples
+
+
+class Imdb(Dataset):
+    """reference: text/datasets/imdb.py interface; synthetic sentiment data."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, samples=512):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.docs = []
+        self.labels = rng.randint(0, 2, samples).astype(np.int64)
+        for lab in self.labels:
+            base = 100 if lab else 200
+            self.docs.append(rng.randint(base, base + 100, 64).astype(np.int64))
+        self.word_idx = {f"w{i}": i for i in range(300)}
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None, include_bos_eos_tag=True):
+    """CRF viterbi decode (reference: paddle.text.viterbi_decode) via jnp scan."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor, apply_op
+
+    def f(pot, trans):
+        # pot: [B, T, N], trans: [N, N]
+        def step(carry, emit):
+            score, _ = carry
+            nxt = score[:, :, None] + trans[None] + emit[:, None, :]
+            best = jnp.max(nxt, axis=1)
+            idx = jnp.argmax(nxt, axis=1).astype(jnp.int32)
+            return (best, idx), idx
+
+        B, T, N = pot.shape
+        init = (pot[:, 0], jnp.zeros((B, N), jnp.int32))
+        (final, _), back = jax.lax.scan(step, init, jnp.moveaxis(pot[:, 1:], 1, 0))
+        scores = jnp.max(final, axis=-1)
+        last = jnp.argmax(final, axis=-1).astype(jnp.int32)
+
+        def backtrack(carry, bp):
+            cur = carry
+            prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0].astype(jnp.int32)
+            return prev, cur
+
+        _, path_rev = jax.lax.scan(backtrack, last, back, reverse=True)
+        path = jnp.concatenate([path_rev, last[None]], axis=0)
+        return scores, jnp.moveaxis(path, 0, 1).astype(jnp.int64)
+
+    return apply_op(f, potentials, transition_params, name="viterbi_decode")
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True):
+        self.transitions = transitions
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths)

@@ -1,0 +1,245 @@
+"""Aux subsystem tests: hapi, checkpoint, elastic, auto-tuner, watchdog,
+quantization, sparse, profiler, jit, text/audio (SURVEY §5 coverage)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestHapi:
+    def test_model_fit_evaluate_predict(self, tmp_path):
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.io import TensorDataset
+        from paddle_tpu.metric import Accuracy
+
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 8).astype(np.float32)
+        w_true = rng.randn(8, 3).astype(np.float32)
+        y = (x @ w_true).argmax(-1).astype(np.int64)
+        ds = TensorDataset([x, y])
+
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=0.05, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(), metrics=Accuracy(),
+        )
+        hist = model.fit(ds, batch_size=16, epochs=6, verbose=0)
+        ev = model.evaluate(ds, batch_size=16, verbose=0)
+        assert ev["acc"] > 0.8
+        preds = model.predict(ds, batch_size=16)
+        assert len(preds) == 4
+        model.save(str(tmp_path / "m"))
+        model.load(str(tmp_path / "m"))
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi import EarlyStopping
+
+        es = EarlyStopping(monitor="loss", patience=1)
+        es.on_eval_end({"loss": 1.0})
+        es.on_eval_end({"loss": 2.0})
+        es.on_eval_end({"loss": 3.0})
+        assert es.stopped
+
+
+class TestDistCheckpoint:
+    def test_save_load_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        orig = m.weight.numpy().copy()
+        save_state_dict(m.state_dict(), str(tmp_path))
+        m.weight._set_value(m.weight._value * 0)
+        load_state_dict(m.state_dict(), str(tmp_path))
+        np.testing.assert_allclose(m.weight.numpy(), orig)
+
+    def test_resharded_resume(self, tmp_path):
+        """save under dp-sharded layout, load into a fresh (unsharded) model."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+        from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+
+        mesh = build_mesh({"dp": 8})
+        paddle.seed(0)
+        m = nn.Linear(16, 4)
+        orig = m.weight.numpy().copy()
+        m.weight._set_value(jax.device_put(
+            m.weight._value, NamedSharding(mesh, PartitionSpec("dp"))))
+        save_state_dict(m.state_dict(), str(tmp_path))
+        set_mesh(None)
+
+        m2 = nn.Linear(16, 4)
+        load_state_dict(m2.state_dict(), str(tmp_path))
+        np.testing.assert_allclose(m2.weight.numpy(), orig)
+
+
+class TestElastic:
+    def test_register_watch_restart(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore(is_master=True)
+        try:
+            mgr = ElasticManager(store=store, rank=0, world_size=2, lease_ttl=1.0)
+            mgr.register()
+            # rank 1 never registers -> membership incomplete -> RESTART
+            assert mgr.watch() == ElasticStatus.RESTART
+            # register rank 1 manually
+            mgr2 = ElasticManager(store=TCPStore("127.0.0.1", store.port, is_master=False),
+                                  rank=1, world_size=2, lease_ttl=1.0)
+            mgr2.register()
+            time.sleep(0.1)
+            assert mgr.watch() == ElasticStatus.HOLD
+            mgr2.exit(completed=True)
+            mgr.exit(completed=True)
+        finally:
+            store.close()
+
+
+class TestAutoTuner:
+    def test_candidates_pruning_search(self):
+        from paddle_tpu.distributed.auto_tuner import AutoTuner, candidate_configs, prune_candidates
+
+        cands = candidate_configs(8)
+        assert any(c.pp == 2 and c.mp == 2 and c.dp == 2 for c in cands)
+        pruned = prune_candidates(cands, n_layers=4, n_heads=4, global_batch=16)
+        assert all(4 % c.pp == 0 and 4 % c.mp == 0 for c in pruned)
+
+        def trial(cfg):
+            # pretend mp=2,dp=4 is fastest
+            return abs(cfg.mp - 2) + abs(cfg.dp - 4) + cfg.pp * 0.1 + cfg.micro_batches * 0.01
+
+        tuner = AutoTuner(8, trial, prune_kwargs={"n_layers": 4, "n_heads": 4},
+                          max_trials=50)
+        best = tuner.search()
+        assert best.mp == 2 and best.dp == 4
+
+
+class TestWatchdog:
+    def test_completion_and_hang(self):
+        from paddle_tpu.distributed.watchdog import CommTaskManager, watch_step
+
+        hangs = []
+        mgr = CommTaskManager(default_timeout_s=0.5, poll_interval_s=0.1,
+                              on_hang=lambda t: hangs.append(t.name))
+        x = paddle.to_tensor(np.ones(4, np.float32)) * 2
+        task = watch_step(x, "ok_step", timeout_s=5.0, manager=mgr)
+        task.done.wait(5)
+        assert task.done.is_set()
+
+        t2 = mgr.begin("hang_step", timeout_s=0.3)
+        mgr.start()
+        time.sleep(1.0)
+        assert "hang_step" in hangs
+        mgr.stop()
+
+
+class TestQuantization:
+    def test_qat_fake_quant_trains(self):
+        from paddle_tpu.quantization import QAT, QuantConfig
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        qnet = QAT(QuantConfig()).quantize(net)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.randint(0, 2, 8).astype(np.int64))
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=qnet.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        l0 = None
+        for _ in range(5):
+            loss = loss_fn(qnet(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            l0 = l0 or float(loss)
+        assert float(loss) < l0
+
+
+class TestSparse:
+    def test_coo_roundtrip_and_spmm(self):
+        import paddle_tpu.sparse as sparse
+
+        dense = np.array([[1.0, 0, 2.0], [0, 0, 3.0]], np.float32)
+        coo = sparse.to_sparse_coo(paddle.to_tensor(dense))
+        assert coo.nnz == 3
+        np.testing.assert_allclose(coo.to_dense().numpy(), dense)
+        b = np.random.randn(3, 4).astype(np.float32)
+        out = sparse.matmul(coo, paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), dense @ b, rtol=1e-5)
+
+
+class TestProfiler:
+    def test_record_and_summary(self, tmp_path):
+        from paddle_tpu.profiler import Profiler, RecordEvent
+
+        with Profiler() as prof:
+            with RecordEvent("myop"):
+                time.sleep(0.01)
+        s = prof.summary()
+        assert "myop" in s
+        prof.export(str(tmp_path / "trace.json"))
+        assert os.path.exists(tmp_path / "trace.json")
+
+
+class TestJitToStatic:
+    def test_to_static_function(self):
+        @paddle.jit.to_static
+        def f(x):
+            return paddle.exp(x) * 2
+
+        x = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+        np.testing.assert_allclose(f(x).numpy(), np.exp([0.0, 1.0]) * 2, rtol=1e-6)
+
+    def test_to_static_layer_trains(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        snet = paddle.jit.to_static(net)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(8, 1).astype(np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        loss_fn = nn.MSELoss()
+        l0 = None
+        for _ in range(5):
+            loss = loss_fn(snet(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            l0 = l0 or float(loss)
+        assert float(loss) < l0
+
+    def test_control_flow_helpers(self):
+        c = paddle.jit.api.cond(
+            paddle.to_tensor(True),
+            lambda a: a + 1, lambda a: a - 1,
+            paddle.to_tensor(np.float32(1.0)),
+        )
+        assert float(c) == 2.0
+
+
+class TestTextAudio:
+    def test_lm_dataset_and_viterbi(self):
+        from paddle_tpu.text import LMDataset, viterbi_decode
+
+        ds = LMDataset(vocab_size=32, seq_len=16, samples=4)
+        x, y = ds[0]
+        assert x.shape == (16,) and y.shape == (16,)
+
+        pot = paddle.to_tensor(np.random.randn(2, 5, 3).astype(np.float32))
+        trans = paddle.to_tensor(np.random.randn(3, 3).astype(np.float32))
+        scores, path = viterbi_decode(pot, trans)
+        assert path.shape == [2, 5]
+
+    def test_mel_spectrogram(self):
+        from paddle_tpu.audio import features
+
+        x = paddle.to_tensor(np.random.randn(1, 4000).astype(np.float32))
+        mel = features.MelSpectrogram(sr=8000, n_fft=256, n_mels=16)(x)
+        assert mel.shape[1] == 16
