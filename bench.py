@@ -3,12 +3,13 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Baseline framing (BASELINE.md): the north star is LLaMA-2-7B at >=50% of
-H100+NCCL tokens/sec/device. A single v5e chip can't hold 7B, so the bench
-trains a scaled LLaMA (~110M) and reports tokens/sec/chip; `vs_baseline` is
-model-FLOPs-utilization (MFU) divided by 0.20 — i.e. 1.0 == the efficiency a
-7B H100 run at 40% MFU delivers when halved per the >=50% target. MFU is the
-hardware-portable proxy for "would match the reference's per-device rate at
-equal scale".
+H100+NCCL tokens/sec/device. A single v5e (16GB) chip can't hold 7B, so the
+bench trains the largest LLaMA that fits with full AdamW state (~440M,
+bf16 compute + fp32 master/m/v) and reports tokens/sec/chip; `vs_baseline` is
+model-FLOPs-utilization (MFU, against the 197 TFLOP/s v5e bf16 peak) divided
+by 0.20 — i.e. 1.0 == the efficiency a 7B H100 run at 40% MFU delivers when
+halved per the >=50% target. MFU is the hardware-portable proxy for "would
+match the reference's per-device rate at equal scale".
 """
 from __future__ import annotations
 
@@ -29,11 +30,11 @@ def main():
     on_tpu = jax.devices()[0].platform != "cpu"
 
     if on_tpu:
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=768, intermediate_size=2048,
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536, intermediate_size=4096,
                           num_hidden_layers=12, num_attention_heads=12,
                           num_key_value_heads=12, max_position_embeddings=2048,
                           use_parallel_cross_entropy=False)
-        batch, seq, iters = 16, 1024, 20
+        batch, seq, iters = 8, 1024, 20
     else:  # CPU smoke (CI)
         cfg = LlamaConfig(vocab_size=1024, hidden_size=128, intermediate_size=256,
                           num_hidden_layers=2, num_attention_heads=4,
@@ -79,7 +80,11 @@ def main():
             return params, states, loss.astype(jnp.float32)
         return body
 
-    @jax.jit
+    import functools
+
+    # donate params/states: without aliasing, input + output copies double the
+    # model+optimizer footprint and OOM anything past ~200M params
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_n(params, states, n):
         params, states, loss = jax.lax.fori_loop(
             0, n, run_n(n), (params, states, jnp.zeros((), jnp.float32)))
@@ -90,8 +95,9 @@ def main():
     float(loss0)  # compile + settle
 
     def timed(n):
+        nonlocal p, s
         t0 = time.perf_counter()
-        _, _, loss = train_n(p, s, jnp.asarray(n, jnp.int32))
+        p, s, loss = train_n(p, s, jnp.asarray(n, jnp.int32))
         lval = float(loss)
         return time.perf_counter() - t0, lval
 
@@ -107,7 +113,8 @@ def main():
     # MFU: 6 * n_params * tokens/sec / peak_flops (bf16)
     n_params = sum(p.size for p in model.parameters())
     flops_per_token = 6.0 * n_params + 12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq
-    peak = 394e12 if on_tpu else 1e12  # v5e bf16 peak ~394 TFLOP/s; CPU nominal
+    # v5e peak is 197 TFLOP/s bf16 (394 is the int8 number); CPU nominal
+    peak = 197e12 if on_tpu else 1e12
     mfu = tokens_per_sec * flops_per_token / (peak * max(ndev, 1))
     vs_baseline = mfu / 0.20  # 1.0 == 50%-of-H100@40%MFU efficiency bar
 

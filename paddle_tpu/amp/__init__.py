@@ -155,6 +155,10 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer INIT/UNSCALED/STEPPED state so `scaler.unscale_(opt);
+        # clip; scaler.step(opt)` doesn't divide grads by the scale twice
+        # (reference amp/grad_scaler.py OptimizerState)
+        self._opt_states = {}
 
     def scale(self, var):
         if not self._enable:
@@ -164,6 +168,13 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        state = self._opt_states.get(id(optimizer), "INIT")
+        if state == "UNSCALED":
+            raise RuntimeError("unscale_() has already been called on this optimizer "
+                               "since the last update().")
+        if state == "STEPPED":
+            raise RuntimeError("unscale_() is being called after step().")
+        self._opt_states[id(optimizer)] = "UNSCALED"
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list():
@@ -178,15 +189,21 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
+        state = self._opt_states.get(id(optimizer), "INIT")
+        if state == "STEPPED":
+            raise RuntimeError("step() has already been called since the last update().")
+        if state != "UNSCALED":
+            self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        self._opt_states[id(optimizer)] = "STEPPED"
         self.update()
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
 
     def update(self):
+        self._opt_states.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

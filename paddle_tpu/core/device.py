@@ -81,22 +81,25 @@ _ACCEL_PLATFORMS = ("tpu", "axon")  # axon = tunneled TPU platform in this envir
 
 
 def _devices_of_type(device_type: str):
+    # eager tensors live on PROCESS-LOCAL devices: in a multi-process job
+    # (jax.distributed) a device_put to a non-addressable global device would
+    # produce an array this process cannot read
     if device_type == "cpu":
         try:
-            return jax.devices("cpu")
+            return jax.local_devices(backend="cpu")
         except RuntimeError:
-            return [d for d in jax.devices() if d.platform == "cpu"]
+            return [d for d in jax.local_devices() if d.platform == "cpu"]
     if device_type == "tpu":
         for plat in _ACCEL_PLATFORMS:
             try:
-                devs = jax.devices(plat)
+                devs = jax.local_devices(backend=plat)
                 if devs:
                     return devs
             except RuntimeError:
                 continue
         # Under forced-CPU test runs (JAX_PLATFORMS=cpu) 'tpu' resolves to the
         # default devices so the same model code runs everywhere.
-        return jax.devices()
+        return jax.local_devices()
     try:
         return jax.devices(device_type)
     except RuntimeError:

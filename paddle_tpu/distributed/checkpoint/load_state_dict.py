@@ -20,13 +20,15 @@ __all__ = ["load_state_dict"]
 
 
 def _flatten_tensors(sd, prefix=""):
+    """Flat key -> (parent dict, leaf key, value), so non-Tensor entries can be
+    assigned back through their nested location rather than a bogus flat key."""
     out = {}
     for k, v in sd.items():
         key = f"{prefix}.{k}" if prefix else str(k)
         if isinstance(v, dict):
             out.update(_flatten_tensors(v, key))
         else:
-            out[key] = v
+            out[key] = (sd, k, v)
     return out
 
 
@@ -43,7 +45,7 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             shard_data.update(pickle.load(f))
 
     flat = _flatten_tensors(state_dict)
-    for key, target in flat.items():
+    for key, (parent, leaf, target) in flat.items():
         if key not in meta.state_dict_metadata:
             continue
         metas = meta.state_dict_metadata[key]
@@ -69,5 +71,5 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     pass
             target._set_value(val)
         else:
-            state_dict[key] = arr
+            parent[leaf] = arr
     return state_dict

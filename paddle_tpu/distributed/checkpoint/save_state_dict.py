@@ -17,9 +17,22 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.distributed.checkpoint.metadata import (
     LocalTensorIndex, LocalTensorMetadata, Metadata,
 )
-from paddle_tpu.distributed.env import get_rank
+from paddle_tpu.distributed.env import get_rank, get_world_size
 
 __all__ = ["save_state_dict"]
+
+
+def _merge_metas(metas):
+    merged = Metadata()
+    for m in metas:
+        for key, lms in m.state_dict_metadata.items():
+            dst = merged.state_dict_metadata.setdefault(key, [])
+            for lm in lms:
+                if not any(e.global_offset == lm.global_offset for e in dst):
+                    dst.append(lm)
+        for idx, fname in m.storage_metadata.items():
+            merged.storage_metadata.setdefault(idx, fname)
+    return merged
 
 
 def _flatten(sd, prefix=""):
@@ -73,6 +86,17 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         data[(key, off)] = arr
     with open(os.path.join(path, fname), "wb") as f:
         pickle.dump(data, f, protocol=4)
+    world = get_world_size(process_group)
+    if world > 1:
+        # multi-host: each process only sees its local shards, so gather every
+        # rank's contribution and merge before the coordinator writes
+        # (reference save_state_dict.py does the same with all_gather_object);
+        # exchange_objects is sequence-numbered, so repeated saves to the same
+        # path can't read a previous save's metadata, and it doubles as the
+        # barrier ensuring all .distcp files are written first
+        from paddle_tpu.distributed import multiproc
+
+        meta = _merge_metas(multiproc.exchange_objects(meta, world))
     if rank == coordinator_rank:
         with open(os.path.join(path, f"{unique_id or 0}.metadata"), "wb") as f:
             pickle.dump(meta, f, protocol=4)

@@ -13,10 +13,15 @@ the ProcessGroupXLA seam. Two contexts:
    lower to lax collectives over the group's mesh axes. This is the hot path —
    XLA schedules them on ICI with compute overlap (the analog of NCCL comm
    streams + the reference's CommContext).
-2. Eager/host level: the global-SPMD view means every host holds the full
-   logical value, so intra-process "collectives" are arithmetic identities
-   (all_reduce of an already-global tensor = itself). They exist for API
-   parity and for CPU-mesh multiprocess tests.
+2. Eager/host level, multi-process job (init_parallel_env has called
+   jax.distributed.initialize): collectives execute across OS processes via
+   multiproc.py (multihost_utils programs over ICI/DCN + TCPStore p2p) —
+   the ProcessGroup* eager data plane.
+3. Eager/host level, single process: every host holds the full logical
+   value, so collectives are arithmetic identities (all_reduce of an
+   already-global tensor = itself); rank-asymmetric ops that CANNOT be
+   honored in this view (send/recv to a peer that doesn't exist) raise
+   instead of silently approximating.
 """
 from __future__ import annotations
 
@@ -28,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.distributed import multiproc
 from paddle_tpu.distributed.env import get_rank, get_world_size
 from paddle_tpu.distributed.mesh import get_mesh, mesh_axis_size
 
@@ -149,10 +155,34 @@ _REDUCERS = {
 }
 
 
+def _group_ranks(group):
+    g = group if group is not None else _global_group()
+    return g.ranks or None
+
+
+def _require_world_group(group, opname):
+    """Cross-process eager collectives currently run over the full process
+    world; a proper subgroup would silently include outsiders — refuse."""
+    ranks = _group_ranks(group)
+    if ranks is not None and len(ranks) < multiproc.num_processes():
+        raise NotImplementedError(
+            f"cross-process eager {opname}() over a sub-group is not supported "
+            f"yet (group ranks {ranks}, world {multiproc.num_processes()}); "
+            "use the full world group or an in-graph collective")
+
+
+def _set_np(tensor: Tensor, arr):
+    tensor._set_value(jnp.asarray(arr, tensor._value.dtype))
+    return tensor
+
+
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group | None = None, sync_op=True):
     axes = _bound_axes(_axis_names(group))
     if not axes:
-        return tensor  # global view: already reduced
+        if multiproc.cross_process_active():
+            return _set_np(tensor, multiproc.allreduce_np(
+                np.asarray(tensor._value), op, _group_ranks(group)))
+        return tensor  # single-process global view: already reduced
     def f(v):
         if op == ReduceOp.AVG:
             n = int(np.prod([mesh_axis_size(a) for a in axes]))
@@ -172,6 +202,18 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group | None = None, sync
 def all_gather(tensor_list: list, tensor: Tensor, group: Group | None = None, sync_op=True):
     axes = _bound_axes(_axis_names(group))
     if not axes:
+        if multiproc.cross_process_active():
+            _require_world_group(group, "all_gather")
+            gathered = multiproc.allgather_np(np.asarray(tensor._value))
+            from paddle_tpu.core.tensor import to_tensor
+
+            rows = [to_tensor(gathered[r]) for r in range(gathered.shape[0])]
+            if isinstance(tensor_list, list):
+                tensor_list.extend(rows)
+                return tensor_list
+            from paddle_tpu.ops.manipulation import stack
+
+            return stack(rows, 0)
         if isinstance(tensor_list, list):
             tensor_list.append(tensor.clone())
             return tensor_list
@@ -188,11 +230,16 @@ def all_gather(tensor_list: list, tensor: Tensor, group: Group | None = None, sy
 
 
 def all_gather_object(object_list: list, obj, group=None):
+    if multiproc.cross_process_active():
+        object_list.extend(multiproc.exchange_objects(obj))
+        return object_list
     object_list.append(obj)
     return object_list
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # every rank receives the reduced value (a superset of reduce-to-dst;
+    # the dst rank's result is exactly the reference semantics)
     return all_reduce(tensor, op, group, sync_op)
 
 
@@ -214,21 +261,49 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, s
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    # global-SPMD view: value already replicated
+    axes = _bound_axes(_axis_names(group))
+    if not axes and multiproc.cross_process_active():
+        _require_world_group(group, "broadcast")
+        return _set_np(tensor, multiproc.broadcast_np(np.asarray(tensor._value), src))
+    # single-process global-SPMD view: value already replicated
     return tensor
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    if multiproc.cross_process_active():
+        _require_world_group(group, "broadcast_object_list")
+        object_list[:] = multiproc.broadcast_object(list(object_list), src)
     return object_list
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if multiproc.cross_process_active():
+        _require_world_group(group, "scatter")
+        rank = get_rank()
+        if rank == src:
+            if not tensor_list:
+                raise ValueError("scatter: src rank must pass tensor_list")
+            stacked = np.stack([np.asarray(t._value) for t in tensor_list])
+        else:
+            world = multiproc.num_processes()
+            stacked = np.zeros((world,) + tuple(tensor.shape),
+                               dtype=np.asarray(tensor._value).dtype)
+        stacked = multiproc.broadcast_np(stacked, src)
+        return _set_np(tensor, stacked[rank])
     if tensor_list:
         tensor._set_value(tensor_list[get_rank() if get_rank() < len(tensor_list) else 0]._value)
     return tensor
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if multiproc.cross_process_active():
+        _require_world_group(group, "gather")
+        gathered = multiproc.allgather_np(np.asarray(tensor._value))
+        if gather_list is not None and get_rank() == dst:
+            from paddle_tpu.core.tensor import to_tensor
+
+            gather_list.extend(to_tensor(gathered[r]) for r in range(gathered.shape[0]))
+        return gather_list
     if gather_list is not None:
         gather_list.append(tensor.clone())
     return gather_list
@@ -240,6 +315,16 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
     stacked = concat([t.unsqueeze(0) for t in in_tensor_list], axis=0)
     if not axes:
+        if multiproc.cross_process_active():
+            _require_world_group(group, "all_to_all")
+            # row i of every process's stacked input goes to process i
+            gathered = multiproc.allgather_np(np.asarray(stacked._value))  # [P, P, ...]
+            from paddle_tpu.core.tensor import to_tensor
+
+            rank = get_rank()
+            out_tensor_list.extend(to_tensor(gathered[r, rank])
+                                   for r in range(gathered.shape[0]))
+            return out_tensor_list
         out_tensor_list.extend(t.squeeze(0) for t in split(stacked, len(in_tensor_list), 0))
         return out_tensor_list
     ax = axes[0]
@@ -252,6 +337,15 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None, out_split_size
                       group=None, sync_op=True):
     axes = _bound_axes(_axis_names(group))
     if not axes:
+        if multiproc.cross_process_active():
+            _require_world_group(group, "all_to_all_single")
+            gathered = multiproc.allgather_np(np.asarray(in_tensor._value))  # [P, n, ...]
+            world = gathered.shape[0]
+            chunk = gathered.shape[1] // world
+            rank = get_rank()
+            rows = np.concatenate(
+                [gathered[r, rank * chunk:(rank + 1) * chunk] for r in range(world)], 0)
+            return _set_np(out_tensor, rows)
         out_tensor._set_value(in_tensor._value)
         return out_tensor
     ax = axes[0]
@@ -274,10 +368,26 @@ def send(tensor, dst=0, group=None, sync_op=True):
     axes = _bound_axes(_axis_names(group))
     if axes:
         return _ppermute(tensor, axes[0], +1)
+    if multiproc.cross_process_active():
+        multiproc.store_send(np.asarray(tensor._value), dst)
+        return tensor
+    if get_world_size() > 1:
+        raise NotImplementedError(
+            "eager send() between ranks requires init_parallel_env() in a "
+            "multi-process job (or use it inside a compiled pipeline, where it "
+            "lowers to ppermute)")
     return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    if _bound_axes(_axis_names(group)):
+        return tensor  # in-graph: the matching ppermute already delivered
+    if multiproc.cross_process_active():
+        return _set_np(tensor, multiproc.store_recv(src))
+    if get_world_size() > 1:
+        raise NotImplementedError(
+            "eager recv() between ranks requires init_parallel_env() in a "
+            "multi-process job")
     return tensor
 
 
@@ -307,6 +417,9 @@ def batch_isend_irecv(p2p_op_list: Sequence[P2POp]):
 
 
 def barrier(group=None):
+    if multiproc.cross_process_active():
+        multiproc.barrier()
+        return
     from paddle_tpu.core.device import synchronize
 
     synchronize()

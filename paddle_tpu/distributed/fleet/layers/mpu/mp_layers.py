@@ -77,9 +77,24 @@ class VocabParallelEmbedding(Layer):
         )
 
     def forward(self, x):
-        out = F.embedding(x, self.weight)
-        out = _mp_allreduce(out) if mp_axis_bound() else out
-        return out
+        if not mp_axis_bound():
+            # GSPMD/eager path: logical full weight, partitioning via _annotate
+            return F.embedding(x, self.weight)
+
+        # manual (shard_map) path: the local weight is this rank's vocab shard.
+        # Shift ids into the local range, zero out-of-shard rows, then allreduce
+        # (reference mp_layers.py:47 masks against [vocab_start, vocab_end)).
+        def f(ids, w):
+            n_local = w.shape[0]
+            start = jax.lax.axis_index(MP_AXIS) * n_local
+            local = ids - start
+            in_range = (local >= 0) & (local < n_local)
+            safe = jnp.clip(local, 0, n_local - 1)
+            out = jnp.take(w, safe, axis=0)
+            return jnp.where(in_range[..., None], out, jnp.zeros((), out.dtype))
+
+        out = apply_op(f, x, self.weight, name="vocab_parallel_embedding")
+        return _mp_allreduce(out)
 
 
 class ColumnParallelLinear(Layer):

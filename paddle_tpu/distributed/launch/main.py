@@ -35,6 +35,16 @@ def _parse_args(argv):
     return p.parse_args(argv)
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def launch(argv=None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     os.makedirs(args.log_dir, exist_ok=True)
@@ -42,6 +52,18 @@ def launch(argv=None):
     nproc = args.nproc_per_node
     world = args.nnodes * nproc
     base_rank = args.rank * nproc
+    # single-node multi-process: auto-assign rendezvous ports (TCPStore on
+    # PADDLE_MASTER; jax.distributed coordination service on PADDLE_COORDINATOR)
+    coordinator = os.getenv("PADDLE_COORDINATOR", "")
+    if world > 1 and args.nnodes == 1:
+        # ports may only be auto-picked when a single launcher spawns every
+        # rank; multi-node launchers must agree, so they derive the
+        # coordinator deterministically from --master (port+1) in
+        # init_parallel_env instead
+        if not args.master:
+            args.master = f"127.0.0.1:{_free_port()}"
+        if not coordinator:
+            coordinator = f"{args.master.rsplit(':', 1)[0]}:{_free_port()}"
     for local in range(nproc):
         rank = base_rank + local
         env = dict(os.environ)
@@ -53,6 +75,8 @@ def launch(argv=None):
         })
         if args.master:
             env["PADDLE_MASTER"] = args.master
+        if coordinator:
+            env["PADDLE_COORDINATOR"] = coordinator
         log = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
         cmd = [sys.executable, args.training_script] + args.training_script_args
         procs.append((subprocess.Popen(cmd, env=env, stdout=log, stderr=subprocess.STDOUT), log, rank))

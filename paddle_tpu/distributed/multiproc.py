@@ -1,0 +1,125 @@
+"""Cross-process eager collectives (the host-level data plane).
+
+Reference analog: ProcessGroup* eager collectives
+(fluid/distributed/collective/process_group.h:47) — arbitrary-time collectives
+between OS processes, used by eager DataParallel, object collectives, and
+checkpoint metadata exchange.
+
+TPU-native: once `init_parallel_env` has called `jax.distributed.initialize`,
+the job is one JAX "global device" world. Host-level eager collectives ride
+`jax.experimental.multihost_utils` (which compiles tiny XLA collective
+programs over ICI/DCN — the ProcessGroupXLA seam from SURVEY §5); object
+collectives and p2p send/recv ride the TCPStore. In-graph collectives (the
+hot path) never come here — they lower to lax.psum/ppermute inside the
+compiled step (collective.py).
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+import jax
+
+__all__ = [
+    "num_processes", "cross_process_active", "allgather_np", "allreduce_np",
+    "broadcast_np", "exchange_objects", "barrier", "store_send", "store_recv",
+]
+
+_counters: dict[str, int] = {}
+
+
+def _next(tag: str) -> int:
+    _counters[tag] = _counters.get(tag, 0) + 1
+    return _counters[tag]
+
+
+def num_processes() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def cross_process_active() -> bool:
+    return num_processes() > 1
+
+
+def _rank() -> int:
+    return jax.process_index()
+
+
+# ---- array collectives over the global-device world -----------------------
+
+def allgather_np(arr) -> np.ndarray:
+    """Gather per-process arrays; returns [num_processes, *shape] numpy."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(arr), tiled=False))
+
+
+def allreduce_np(arr, op: str = "sum", ranks=None) -> np.ndarray:
+    gathered = allgather_np(arr)
+    if ranks:
+        gathered = gathered[list(ranks)]
+    if op == "sum":
+        return gathered.sum(0)
+    if op == "avg":
+        return gathered.mean(0)
+    if op == "max":
+        return gathered.max(0)
+    if op == "min":
+        return gathered.min(0)
+    if op == "prod":
+        return gathered.prod(0)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def broadcast_np(arr, src: int = 0) -> np.ndarray:
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(np.asarray(arr), is_source=_rank() == src))
+
+
+def barrier(name: str | None = None) -> None:
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name or f"pt_barrier_{_next('barrier')}")
+
+
+# ---- object collectives + p2p over the TCPStore ---------------------------
+
+def _store():
+    from paddle_tpu.distributed.store import create_or_get_global_tcp_store
+
+    return create_or_get_global_tcp_store()
+
+
+def exchange_objects(obj, world: int | None = None) -> list:
+    """All-gather arbitrary pickled objects via the TCPStore."""
+    world = world or num_processes()
+    seq = _next("objgather")
+    store = _store()
+    store.set(f"og/{seq}/{_rank()}", pickle.dumps(obj))
+    return [pickle.loads(store.wait(f"og/{seq}/{r}")) for r in range(world)]
+
+
+def broadcast_object(obj, src: int = 0):
+    """Only the src rank's object crosses the wire (unlike exchange_objects)."""
+    seq = _next("objbcast")
+    store = _store()
+    if _rank() == src:
+        store.set(f"ob/{seq}/{src}", pickle.dumps(obj))
+        return obj
+    return pickle.loads(store.wait(f"ob/{seq}/{src}"))
+
+
+def store_send(arr, dst: int) -> None:
+    seq = _next(f"p2p_s/{_rank()}->{dst}")
+    _store().set(f"p2p/{_rank()}->{dst}/{seq}", pickle.dumps(np.asarray(arr)))
+
+
+def store_recv(src: int):
+    seq = _next(f"p2p_r/{src}->{_rank()}")
+    return pickle.loads(_store().wait(f"p2p/{src}->{_rank()}/{seq}"))

@@ -26,7 +26,40 @@ _initialized = [False]
 
 
 def init_parallel_env():
-    """Bring up the distributed environment (mesh over all devices)."""
+    """Bring up the distributed environment.
+
+    Multi-process (reference: parallel.py:945 init_parallel_env + TCPStore
+    rendezvous + ProcessGroup creation): reads the launch env contract
+    (PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID / PADDLE_COORDINATOR) and calls
+    `jax.distributed.initialize`, after which jax.devices() spans every
+    process's chips — the global mesh then makes N OS processes act as one
+    SPMD job over ICI/DCN. Single-process: builds the mesh over local devices.
+    """
+    env = ParallelEnv()
+    world = int(os.getenv("PADDLE_TRAINERS_NUM", "0") or 0)
+    if world > 1 and not _initialized[0]:
+        try:
+            # NOT jax.process_count(): that would initialize the backend,
+            # making jax.distributed.initialize impossible afterwards
+            from jax._src import distributed as _jdist
+
+            already = _jdist.global_state.client is not None
+        except Exception:
+            already = False
+        if not already:
+            coord = os.getenv("PADDLE_COORDINATOR", "")
+            if not coord:
+                master = os.getenv("PADDLE_MASTER", "")
+                if not master or ":" not in master:
+                    raise RuntimeError(
+                        "multi-process init_parallel_env needs PADDLE_COORDINATOR or "
+                        "PADDLE_MASTER (host:port) — launch via "
+                        "`python -m paddle_tpu.distributed.launch`")
+                host, port = master.rsplit(":", 1)
+                coord = f"{host}:{int(port) + 1}"
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=world,
+                                       process_id=env.rank)
     if get_mesh() is None:
         build_mesh({"dp": len(jax.devices())})
     _initialized[0] = True

@@ -144,13 +144,20 @@ class TCPStore:
 
     def get(self, key: str, default=None):
         if self._native is not None:
-            buf = (ctypes.c_uint8 * (1 << 20))()
-            n = self._native.pt_store_get(self._client, key.encode(), buf, len(buf))
-            if n == -1:
-                return default
-            if n < 0:
-                raise ConnectionError("TCPStore get failed")
-            return bytes(buf[:n])
+            cap = 1 << 20
+            while True:
+                buf = (ctypes.c_uint8 * cap)()
+                n = self._native.pt_store_get(self._client, key.encode(), buf, cap)
+                if n == -1:
+                    return default
+                if n == -3:  # value larger than buffer; it stays server-side — grow
+                    cap *= 4
+                    if cap > (1 << 31):
+                        raise ConnectionError("TCPStore get: value too large")
+                    continue
+                if n < 0:
+                    raise ConnectionError("TCPStore get failed")
+                return bytes(buf[:n])
         st, out = self._client.request(1, key, b"")
         return out if st == 0 else default
 
@@ -174,6 +181,11 @@ class TCPStore:
                 n = self._native.pt_store_wait(self._client, key.encode(), tmo, buf, len(buf))
                 if n == -1:
                     raise TimeoutError(f"TCPStore wait timed out on '{key}'")
+                if n == -3:
+                    # value exceeded the buffer — the wait succeeded, so the
+                    # key now exists; re-read through the growing-get path
+                    outs.append(self.get(key))
+                    continue
                 if n < 0:
                     raise ConnectionError("TCPStore wait failed")
                 outs.append(bytes(buf[:n]))

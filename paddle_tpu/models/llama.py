@@ -108,11 +108,8 @@ class LlamaAttention(nn.Layer):
 
         q, k = apply_op(rope_fn, q, k, cos, sin, name="rope", n_outputs=2)
 
-        if self.num_kv_heads != self.num_heads:
-            rep = self.num_heads // self.num_kv_heads
-            k = apply_op(lambda kv_: jnp.repeat(kv_, rep, axis=2), k, name="repeat_kv")
-            v = apply_op(lambda vv: jnp.repeat(vv, rep, axis=2), v, name="repeat_kv")
-
+        # GQA goes through natively: both the Pallas kernel and the XLA
+        # fallback consume [B,S,Hkv,D] K/V without materializing repeats
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True,
                                              training=self.training)
         out = out.reshape([b, s, -1])

@@ -691,14 +691,22 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
         nclass = logits.shape[axis]
         if soft_label:
             soft = lab
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+            nll = -jnp.sum(soft * logp, axis=axis)
         else:
+            # gather the label log-prob instead of materializing a one-hot
+            # ([N, vocab] would dominate memory at LM scale)
             li = lab
             if li.ndim == logp.ndim:  # [..., 1]
                 li = jnp.squeeze(li, axis)
-            soft = jax.nn.one_hot(li, nclass, dtype=logp.dtype, axis=axis)
-        if label_smoothing > 0.0:
-            soft = soft * (1 - label_smoothing) + label_smoothing / nclass
-        nll = -jnp.sum(soft * logp, axis=axis)
+            safe = jnp.clip(li, 0, nclass - 1)
+            picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis)
+            picked = jnp.squeeze(picked, axis)
+            if label_smoothing > 0.0:
+                nll = -(1 - label_smoothing) * picked - label_smoothing * jnp.mean(logp, axis=axis)
+            else:
+                nll = -picked
         if not soft_label:
             li = lab
             if li.ndim == logp.ndim:
@@ -941,23 +949,29 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             pass  # fall back to XLA path below
 
     def f(q, k, v, *m):
-        # [B,S,H,D] -> [B,H,S,D]
+        # [B,S,H,D] -> [B,H,S,D]; GQA (fewer kv heads) via grouped einsum —
+        # the shared K/V heads are never materialized per query head
         qh = jnp.swapaxes(q, 1, 2)
         kh = jnp.swapaxes(k, 1, 2)
         vh = jnp.swapaxes(v, 1, 2)
-        scores = jnp.einsum("bhsd,bhtd->bhst", qh, kh) / math.sqrt(q.shape[-1])
+        b, hq, s_len, d = qh.shape
+        hkv = kh.shape[1]
+        g = hq // hkv
+        qg = qh.reshape(b, hkv, g, s_len, d)
+        scores = jnp.einsum("bhgsd,bhtd->bhgst", qg, kh) / math.sqrt(q.shape[-1])
         if is_causal:
             s, t = scores.shape[-2], scores.shape[-1]
             causal = jnp.tril(jnp.ones((s, t), bool))
             scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
         if m:
-            mask = m[0]
+            mask = jnp.broadcast_to(m[0], (b, hq, s_len, scores.shape[-1]))
+            mask = mask.reshape(b, hkv, g, s_len, -1)
             if mask.dtype == jnp.bool_:
                 scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
             else:
                 scores = scores + mask
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-        out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+        out = jnp.einsum("bhgst,bhtd->bhgsd", probs, vh).reshape(b, hq, s_len, d)
         return jnp.swapaxes(out, 1, 2)
 
     args = [_t(query), _t(key), _t(value)]

@@ -1,15 +1,24 @@
-"""Pallas flash attention for TPU.
+"""Pallas flash attention for TPU (forward + backward kernels, native GQA).
 
 Reference analog: the vendored FlashAttention-2 CUDA kernels
 (third_party/flashattn; phi/kernels/gpu/flash_attn_kernel.cu) behind
 nn/functional/flash_attention.py:147.
 
-TPU-native design: online-softmax tiling in VMEM. Grid = (batch*heads,
-q_blocks); K/V stream through VMEM blocks; running (max, denom) carried in
-fp32; the causal variant skips K blocks strictly above the diagonal (work
-~halves). Forward emits the logsumexp row stats so backward can rebuild P
-without a second softmax pass; backward is a blocked recompute (flash-style,
-no S^2 materialization in HBM thanks to XLA fusion of the masked einsums).
+TPU-native design: online-softmax tiling in VMEM. Forward grid =
+(batch*q_heads, q_blocks); K/V stream through VMEM blocks; running (max,
+denom) carried in fp32; the causal variant skips K blocks strictly above the
+diagonal. Forward emits the logsumexp row stats; backward is the standard
+flash-2 recurrence in two blocked kernels:
+
+  * dq kernel — grid (BHq, q_blocks, k_blocks): dq[b,qi] accumulated in-place
+    across the trailing (sequential on TPU) k-block grid dim.
+  * dk/dv kernel — grid (BHkv, k_blocks, group*q_blocks): dk/dv[b,kb]
+    accumulated across the trailing q-block dim, which also walks the GQA
+    group so shared K/V heads see every query head.
+
+Peak memory is O(block * D) per grid step — no [S, S] materialization in
+either direction. GQA is handled by BlockSpec index maps (q-head -> kv-head
+= h // group), never by materializing repeated K/V.
 
 Falls back to interpreter mode off-TPU so the same code path is unit-tested
 on CPU (the fake-device pattern, SURVEY §4.4).
@@ -42,6 +51,10 @@ def _on_tpu() -> bool:
     except Exception:
         return False
 
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool,
                 scale: float, seq_len: int, block_q: int):
@@ -88,8 +101,8 @@ def _pick_blocks(seq_len: int):
     return min(bq, seq_len), min(bk, seq_len)
 
 
-def _flash_fwd(q, k, v, causal: bool, scale: float, interpret: bool):
-    """q,k,v: [BH, S, D] -> (out [BH,S,D], lse [BH,S])."""
+def _flash_fwd(q, k, v, causal: bool, scale: float, group: int, interpret: bool):
+    """q: [BHq, S, D]; k,v: [BHkv, S, D] with BHq == BHkv*group -> (out, lse)."""
     bh, s, d = q.shape
     block_q, block_k = _pick_blocks(s)
     grid = (bh, s // block_q)
@@ -105,8 +118,8 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, interpret: bool):
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda b, i: (b // group, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda b, i: (b // group, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -121,44 +134,171 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, interpret: bool):
     return out, lse[..., 0]
 
 
-def _bwd_xla(q, k, v, out, lse, do, causal: bool, scale: float):
-    """Flash-style backward from saved lse (XLA-fused; fp32 accumulation)."""
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    of = out.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+# ---------------------------------------------------------------------------
+# backward kernels (flash-2 recurrence from saved lse; no S^2 anywhere)
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    # causal: K blocks strictly above the diagonal contribute nothing
+    needed = True
     if causal:
-        qpos = jnp.arange(q.shape[1])[:, None]
-        kpos = jnp.arange(k.shape[1])[None, :]
-        s = jnp.where(qpos >= kpos, s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])
-    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
-    delta = jnp.sum(dof * of, axis=-1, keepdims=True)
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        needed = kb * block_k <= (qi + 1) * block_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [BQ, D]
+        k_blk = k_ref[0].astype(jnp.float32)      # [BK, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                          # [BQ, 1]
+        delta = delta_ref[0]                      # [BQ, 1]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            bq = q.shape[0]
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                      # [BQ, BK]
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_ref[0] += jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                q_blocks: int):
+    kb = pl.program_id(1)
+    qj = pl.program_id(2)           # walks group-major over (group, q_blocks)
+    qi = qj % q_blocks              # q-block index within the query head
+
+    @pl.when(qj == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    needed = True
+    if causal:
+        # whole q block above the diagonal w.r.t. this k block -> no contribution
+        needed = (qi + 1) * block_q - 1 >= kb * block_k
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [BQ, D]
+        k_blk = k_ref[0].astype(jnp.float32)      # [BK, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            bq = q.shape[0]
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                      # [BQ, BK]
+        dv_ref[0] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_ref[0] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+
+def _flash_bwd(q, k, v, out, lse, do, causal: bool, scale: float, group: int,
+               interpret: bool):
+    """Blocked flash-2 backward. q/do/out/lse: [BHq, ...]; k/v: [BHkv, ...]."""
+    bhq, s, d = q.shape
+    bhkv = k.shape[0]
+    block_q, block_k = _pick_blocks(s)
+    q_blocks, k_blocks = s // block_q, s // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+                    keepdims=True)                       # [BHq, S, 1]
+    lse3 = lse[..., None]                                # [BHq, S, 1]
+
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k),
+            grid=(bhq, q_blocks, k_blocks),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bhq, s, d), jnp.float32),
+            interpret=interpret,
+        )(q, k, v, do, lse3, delta)
+
+        # trailing grid dim walks (group, q_blocks) group-major so each kv head
+        # accumulates contributions from every query head in its GQA group
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k, q_blocks=q_blocks),
+            grid=(bhkv, k_blocks, group * q_blocks),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda b, j, qj: (b * group + qj // q_blocks, qj % q_blocks, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, qj: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, qj: (b, j, 0)),
+                pl.BlockSpec((1, block_q, d),
+                             lambda b, j, qj: (b * group + qj // q_blocks, qj % q_blocks, 0)),
+                pl.BlockSpec((1, block_q, 1),
+                             lambda b, j, qj: (b * group + qj // q_blocks, qj % q_blocks, 0)),
+                pl.BlockSpec((1, block_q, 1),
+                             lambda b, j, qj: (b * group + qj // q_blocks, qj % q_blocks, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j, qj: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, qj: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bhkv, s, d), jnp.float32),
+                jax.ShapeDtypeStruct((bhkv, s, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, do, lse3, delta)
+
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash3(q, k, v, causal, scale):
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash3(q, k, v, causal, scale, group):
     interpret = not _on_tpu()
-    out, _ = _flash_fwd(q, k, v, causal, scale, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, scale, group, interpret)
     return out
 
 
-def _flash3_fwd(q, k, v, causal, scale):
+def _flash3_fwd(q, k, v, causal, scale, group):
     interpret = not _on_tpu()
-    out, lse = _flash_fwd(q, k, v, causal, scale, interpret)
+    out, lse = _flash_fwd(q, k, v, causal, scale, group, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash3_bwd(causal, scale, res, do):
+def _flash3_bwd(causal, scale, group, res, do):
     q, k, v, out, lse = res
-    dq, dk, dv = _bwd_xla(q, k, v, out, lse, do, causal, scale)
+    interpret = not _on_tpu()
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, causal, scale, group, interpret)
     return dq, dk, dv
 
 
@@ -166,19 +306,22 @@ _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
 def flash_attention_bhsd(q, k, v, causal: bool = False, scale: float | None = None):
-    """q,k,v: [B, H, S, D]."""
-    b, h, s, d = q.shape
+    """q: [B, Hq, S, D]; k,v: [B, Hkv, S, D] with Hq % Hkv == 0 (GQA/MQA)."""
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    assert hq % hkv == 0, f"GQA needs q_heads % kv_heads == 0, got {hq} % {hkv}"
+    group = hq // hkv
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    q3 = q.reshape(b * h, s, d)
-    k3 = k.reshape(b * h, s, d)
-    v3 = v.reshape(b * h, s, d)
-    out = _flash3(q3, k3, v3, causal, scale)
-    return out.reshape(b, h, s, d)
+    q3 = q.reshape(b * hq, s, d)
+    k3 = k.reshape(b * hkv, s, d)
+    v3 = v.reshape(b * hkv, s, d)
+    out = _flash3(q3, k3, v3, causal, scale, group)
+    return out.reshape(b, hq, s, d)
 
 
 def flash_attention_bshd(q, k, v, causal: bool = False, scale: float | None = None):
-    """q,k,v: [B, S, H, D] (paddle flash-attention layout)."""
+    """q,k,v: [B, S, H, D] (paddle flash-attention layout); GQA via H_kv < H_q."""
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)

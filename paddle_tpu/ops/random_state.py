@@ -16,13 +16,20 @@ __all__ = ["seed", "default_generator", "Generator"]
 
 
 class Generator:
+    """Key creation is LAZY: constructing a Generator (including the module
+    default at import) must not initialize the XLA backend, or
+    `jax.distributed.initialize` in init_parallel_env would be impossible
+    afterwards (it requires no prior backend use in the process)."""
+
     def __init__(self, seed_: int = 0):
         self._lock = threading.Lock()
-        self.manual_seed(seed_)
+        self._seed = int(seed_)
+        self._key = None
 
     def manual_seed(self, seed_: int):
         self._seed = int(seed_)
-        self._key = jax.random.key(int(seed_))
+        with self._lock:
+            self._key = None
         return self
 
     def initial_seed(self) -> int:
@@ -30,11 +37,16 @@ class Generator:
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return self._key
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
+            return self._key
 
     def set_state(self, key):
         self._key = key

@@ -1,0 +1,119 @@
+"""2-process worker: real multi-process runtime over jax.distributed.
+
+Launched by test_multiprocess.py via `python -m paddle_tpu.distributed.launch
+--nproc_per_node 2`. Validates (reference test pattern:
+test/custom_runtime/test_collective_process_group_xccl.py:23-60):
+  1. rendezvous: init_parallel_env -> jax.distributed.initialize -> global
+     device world spans both processes
+  2. eager cross-process collectives (all_reduce/broadcast/all_gather/
+     send/recv/object gather) with rank-asymmetric semantics
+  3. a jitted computation over a global mesh spanning both processes
+  4. eager DDP training with allreduce-averaged grads -> identical losses
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}", flush=True)
+        sys.exit(1)
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    check(world == 2, f"world_size {world} != 2")
+    check(jax.process_count() == 2, f"process_count {jax.process_count()} != 2")
+    check(len(jax.devices()) == 2, f"global devices {len(jax.devices())} != 2")
+    check(len(jax.local_devices()) == 1, "expected 1 local device per process")
+
+    # ---- eager cross-process collectives ----------------------------------
+    t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), np.full((4,), 3.0, np.float32))
+
+    b = paddle.to_tensor(np.full((3,), float(rank * 10 + 5), np.float32))
+    dist.broadcast(b, src=1)
+    np.testing.assert_allclose(b.numpy(), np.full((3,), 15.0, np.float32))
+
+    gathered = []
+    dist.all_gather(gathered, paddle.to_tensor(np.array([float(rank)], np.float32)))
+    check(len(gathered) == 2, "all_gather length")
+    np.testing.assert_allclose(gathered[0].numpy(), [0.0])
+    np.testing.assert_allclose(gathered[1].numpy(), [1.0])
+
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+    check([o["rank"] for o in objs] == [0, 1], "all_gather_object ranks")
+
+    # rank-asymmetric p2p through the store
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.arange(6, dtype=np.float32)), dst=1)
+    else:
+        r = paddle.to_tensor(np.zeros(6, np.float32))
+        dist.recv(r, src=0)
+        np.testing.assert_allclose(r.numpy(), np.arange(6, dtype=np.float32))
+
+    # scatter from rank 0
+    recv_t = paddle.to_tensor(np.zeros(2, np.float32))
+    tl = ([paddle.to_tensor(np.array([1.0, 2.0], np.float32)),
+           paddle.to_tensor(np.array([3.0, 4.0], np.float32))] if rank == 0 else None)
+    dist.scatter(recv_t, tl, src=0)
+    np.testing.assert_allclose(recv_t.numpy(), [1.0, 2.0] if rank == 0 else [3.0, 4.0])
+
+    # ---- jit over the global 2-process mesh -------------------------------
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    local = np.full((2, 4), float(rank + 1), np.float32)
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), local, (4, 4))
+    total = jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, P()))(garr)
+    # rank0 shard sums to 8, rank1 to 16
+    np.testing.assert_allclose(np.asarray(total), 24.0)
+
+    # ---- eager DDP: allreduce-averaged grads => identical losses ----------
+    paddle.seed(7)
+    model = paddle.nn.Linear(8, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    rng = np.random.RandomState(100 + rank)  # different per-rank data
+    eval_x = paddle.to_tensor(np.linspace(0, 1, 32, dtype=np.float32).reshape(4, 8))
+    eval_y = paddle.to_tensor(np.ones((4, 1), np.float32))
+    losses = []
+    for _ in range(3):
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(4, 1).astype(np.float32))
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        for p in model.parameters():
+            if p.grad is not None:
+                dist.all_reduce(p.grad, op=dist.ReduceOp.AVG)
+        opt.step()
+        opt.clear_grad()
+        eval_loss = float(((model(eval_x) - eval_y) ** 2).mean())
+        losses.append(eval_loss)
+    from paddle_tpu.distributed import multiproc
+
+    all_losses = multiproc.exchange_objects(losses)
+    np.testing.assert_allclose(all_losses[0], all_losses[1], rtol=0, atol=0)
+
+    dist.barrier()
+    print(f"rank {rank} MP_WORKER_OK losses={losses}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
