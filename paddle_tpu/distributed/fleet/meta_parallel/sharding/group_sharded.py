@@ -28,16 +28,26 @@ __all__ = ["GroupShardedStage2", "GroupShardedStage3", "GroupShardedOptimizerSta
            "group_sharded_parallel", "shard_array_over"]
 
 
-def shard_array_over(val, axis_name: str, mesh=None):
+def shard_array_over(val, axis_name: str, mesh=None, offload=False):
     """Place `val` sharded on dim-0 over `axis_name` (pad-free only when
-    divisible; else keep replicated — correctness first)."""
+    divisible; else keep replicated — correctness first). offload=True
+    additionally places it in pinned host memory when the backend has one
+    (reference sharding offload variants)."""
+    from paddle_tpu.parallel.train_step import host_memory_supported
+
     mesh = mesh or get_mesh()
     if mesh is None or axis_name not in mesh.shape or mesh.shape[axis_name] <= 1:
         return val
-    if val.ndim == 0 or val.shape[0] % mesh.shape[axis_name] != 0:
+    spec = (PartitionSpec(axis_name) if val.ndim > 0
+            and val.shape[0] % mesh.shape[axis_name] == 0 else PartitionSpec())
+    if spec == PartitionSpec() and not offload:
         return val
     try:
-        return jax.device_put(val, NamedSharding(mesh, PartitionSpec(axis_name)))
+        if offload and host_memory_supported():
+            return jax.device_put(val, NamedSharding(mesh, spec, memory_kind="pinned_host"))
+        if spec == PartitionSpec():
+            return val
+        return jax.device_put(val, NamedSharding(mesh, spec))
     except (ValueError, RuntimeError):
         return val
 
@@ -52,20 +62,44 @@ class GroupShardedOptimizerStage2:
         self._optim = optim
         self._axis = "sharding" if mesh_axis_size("sharding") > 1 else "dp"
         self._offload = offload
-        # intercept state creation to shard it
+        # intercept state creation to shard (and optionally host-offload) it
         orig_init_state = optim._init_state
 
         def sharded_init_state(p):
             st = orig_init_state(p)
-            return {k: shard_array_over(v, self._axis) for k, v in st.items()}
+            return {k: shard_array_over(v, self._axis, offload=offload)
+                    for k, v in st.items()}
 
         optim._init_state = sharded_init_state
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_optim"], name)
 
+    def _move_states(self, memory_kind):
+        state_map = getattr(self._optim, "_state", None)
+        if not state_map:
+            return
+        for sid, st in state_map.items():
+            moved = {}
+            for k, v in st.items():
+                sh = getattr(v, "sharding", None)
+                if sh is not None and getattr(sh, "memory_kind", None) not in (None, memory_kind):
+                    try:
+                        v = jax.device_put(v, sh.with_memory_kind(memory_kind))
+                    except (ValueError, RuntimeError):
+                        pass
+                moved[k] = v
+            state_map[sid] = moved
+
     def step(self):
+        if self._offload:
+            # eager update computes on-device: stream host states to HBM for
+            # the update, back to pinned host after (the compiled step does
+            # the same inside the program, train_step.py _step_fn)
+            self._move_states("device")
         self._optim.step()
+        if self._offload:
+            self._move_states("pinned_host")
 
     def clear_grad(self, *a, **k):
         self._optim.clear_grad()

@@ -60,6 +60,30 @@ def _state_pspec(p_spec: PartitionSpec, state_val, axis: str | None, mesh: Mesh 
     return PartitionSpec(*dims[: state_val.ndim])
 
 
+def _zero3_param_spec(spec: PartitionSpec, val, axis: str | None, mesh: Mesh | None):
+    """ZeRO-3: persist the parameter itself sharded on dim 0 over `axis`
+    (GSPMD all-gathers on use inside the step — the reference stage-3
+    forward-pre-hook allgather, group_sharded_stage3.py:85)."""
+    if (mesh is None or axis is None or axis not in mesh.shape
+            or mesh.shape[axis] <= 1 or val.ndim == 0):
+        return spec
+    dims = list(spec) + [None] * (val.ndim - len(list(spec)))
+    if dims[0] is None and axis not in dims and val.shape[0] % mesh.shape[axis] == 0:
+        dims[0] = axis
+        return PartitionSpec(*dims[: val.ndim])
+    return spec
+
+
+def host_memory_supported() -> bool:
+    """True when the backend exposes a pinned-host memory space (TPU does;
+    the CPU test backend does not — offload then degrades to device)."""
+    try:
+        dev = jax.local_devices()[0]
+        return any(m.kind == "pinned_host" for m in dev.addressable_memories())
+    except Exception:
+        return False
+
+
 def functional_call(model, params_vals: Sequence, args, kwargs=None, training=True):
     """Run `model` with its parameters temporarily bound to `params_vals`
     (possibly tracers). All paddle_tpu ops are pure jax fns of Tensor._value,
@@ -84,11 +108,18 @@ class CompiledTrainStep:
 
     batch_spec: PartitionSpec for each batch input (default: shard dim0 over
     every data-like axis present in the mesh).
-    zero_axis: mesh axis to shard optimizer state over (ZeRO-1/2); None = off.
+    zero_axis: mesh axis for ZeRO sharding; None = off.
+    zero_stage: 1/2 = optimizer state sharded over zero_axis (grad
+      reduce-scatter is GSPMD's choice once the update is sharded); 3 = the
+      parameters themselves are ALSO persisted sharded (gather-on-use).
+    offload_optimizer: place optimizer state in pinned host memory
+      (reference sharding offload variants); requires backend host-memory
+      support (TPU), silently stays in HBM otherwise.
     """
 
     def __init__(self, model, loss_fn: Callable, optimizer=None, mesh: Mesh | None = None,
                  batch_spec: PartitionSpec | None = None, zero_axis: str | None = None,
+                 zero_stage: int = 1, offload_optimizer: bool = False,
                  donate: bool = True, remat: bool = False, seed: int = 0):
         self.model = model
         self.loss_fn = loss_fn
@@ -97,6 +128,10 @@ class CompiledTrainStep:
         self._params = model.parameters()
         self._trainable = [not p.stop_gradient for p in self._params]
         self.remat = remat
+        self.zero_stage = zero_stage
+        # offload needs the mesh-based shardings to stream states H2D in-step
+        self._offload = (offload_optimizer and host_memory_supported()
+                         and (mesh is not None or get_mesh() is not None))
 
         if batch_spec is None and self.mesh is not None:
             data_axes = tuple(a for a in ("dp", "sharding", "sep") if
@@ -105,6 +140,11 @@ class CompiledTrainStep:
         self.batch_spec = batch_spec or PartitionSpec()
 
         self._param_specs = [_param_pspec(p, self.mesh) for p in self._params]
+        if zero_stage >= 3:
+            self._param_specs = [
+                _zero3_param_spec(s, p._value, zero_axis, self.mesh)
+                for s, p in zip(self._param_specs, self._params)
+            ]
         self._key = jax.random.key(seed)
         self._step_i = 0
 
@@ -128,10 +168,15 @@ class CompiledTrainStep:
                 st_sh = {}
                 for k, v in st.items():
                     sp = _state_pspec(spec, v, zero_axis, self.mesh)
+                    sh = None
                     if self.mesh is not None:
-                        v = jax.device_put(v, NamedSharding(self.mesh, sp))
+                        if self._offload:
+                            sh = NamedSharding(self.mesh, sp, memory_kind="pinned_host")
+                        else:
+                            sh = NamedSharding(self.mesh, sp)
+                        v = jax.device_put(v, sh)
                     st[k] = v
-                    st_sh[k] = sp
+                    st_sh[k] = sh
                 self._opt_states.append(st)
                 self._state_shardings.append(st_sh)
 
@@ -179,7 +224,15 @@ class CompiledTrainStep:
                 g = grads[j]
                 if g.dtype != param_vals[i].dtype:
                     g = g.astype(param_vals[i].dtype)
-                np_, ns_ = self.optimizer._update(param_vals[i], g, opt_states[i], lr, step_i)
+                st = opt_states[i]
+                if self._offload and self._state_shardings is not None:
+                    # states live in pinned host memory; stream to HBM for the
+                    # update (out_shardings stream the results back) — the
+                    # reference's offload variants do the same H2D/D2H per step
+                    st = {k: jax.device_put(v, self._state_shardings[i][k]
+                                            .with_memory_kind("device"))
+                          for k, v in st.items()}
+                np_, ns_ = self.optimizer._update(param_vals[i], g, st, lr, step_i)
                 new_params[i] = np_
                 new_states[i] = ns_
         return loss, new_params, new_states
@@ -188,8 +241,7 @@ class CompiledTrainStep:
         mesh = self.mesh
         if mesh is not None and self.optimizer is not None:
             pshard = [NamedSharding(mesh, s) for s in self._param_specs]
-            sshard = [{k: NamedSharding(mesh, s) for k, s in d.items()}
-                      for d in self._state_shardings]
+            sshard = self._state_shardings
             repl = NamedSharding(mesh, PartitionSpec())
             self._jitted = jax.jit(
                 self._step_fn,
