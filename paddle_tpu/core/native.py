@@ -34,7 +34,9 @@ def lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) and not _build():
+        # make is incremental: a no-op when the .so is current, a rebuild when
+        # core.cc changed (a stale .so would miss newer symbols)
+        if not _build() and not os.path.exists(_SO):
             return None
         try:
             L = ctypes.CDLL(_SO)
@@ -57,6 +59,8 @@ def lib():
                                    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
         L.pt_store_add.restype = ctypes.c_int64
         L.pt_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        L.pt_store_delete.restype = ctypes.c_int
+        L.pt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         L.pt_store_wait.restype = ctypes.c_int
         L.pt_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
                                     ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]

@@ -131,6 +131,9 @@ struct StoreServer {
         } else {
           out = data[key];
         }
+      } else if (op == 4) {  // DELETE (consumed keys must not accumulate)
+        std::lock_guard<std::mutex> lk(mu);
+        status = data.erase(key) ? 0 : 1;
       }
       uint32_t olen = static_cast<uint32_t>(out.size());
       if (!send_all(fd, &status, 1)) break;
@@ -341,6 +344,14 @@ EXPORT int64_t pt_store_add(void* h, const char* key, int64_t delta) {
   int64_t res;
   memcpy(&res, out.data(), 8);
   return res;
+}
+
+EXPORT int pt_store_delete(void* h, const char* key) {
+  uint8_t status;
+  std::string out;
+  auto* c = static_cast<StoreClient*>(h);
+  if (!c->request(4, key, "", &status, &out)) return -2;
+  return status;  // 0 deleted, 1 key absent
 }
 
 EXPORT int pt_store_wait(void* h, const char* key, int64_t timeout_ms, uint8_t* buf,

@@ -160,17 +160,6 @@ def _group_ranks(group):
     return g.ranks or None
 
 
-def _require_world_group(group, opname):
-    """Cross-process eager collectives currently run over the full process
-    world; a proper subgroup would silently include outsiders — refuse."""
-    ranks = _group_ranks(group)
-    if ranks is not None and len(ranks) < multiproc.num_processes():
-        raise NotImplementedError(
-            f"cross-process eager {opname}() over a sub-group is not supported "
-            f"yet (group ranks {ranks}, world {multiproc.num_processes()}); "
-            "use the full world group or an in-graph collective")
-
-
 def _set_np(tensor: Tensor, arr):
     tensor._set_value(jnp.asarray(arr, tensor._value.dtype))
     return tensor
@@ -203,8 +192,8 @@ def all_gather(tensor_list: list, tensor: Tensor, group: Group | None = None, sy
     axes = _bound_axes(_axis_names(group))
     if not axes:
         if multiproc.cross_process_active():
-            _require_world_group(group, "all_gather")
-            gathered = multiproc.allgather_np(np.asarray(tensor._value))
+            gathered = multiproc.allgather_np(np.asarray(tensor._value),
+                                              _group_ranks(group))
             from paddle_tpu.core.tensor import to_tensor
 
             rows = [to_tensor(gathered[r]) for r in range(gathered.shape[0])]
@@ -218,7 +207,7 @@ def all_gather(tensor_list: list, tensor: Tensor, group: Group | None = None, sy
             tensor_list.append(tensor.clone())
             return tensor_list
         return tensor
-    ax = axes[0]
+    ax = axes if len(axes) > 1 else axes[0]
     out = apply_op(lambda v: jax.lax.all_gather(v, ax), tensor, name="all_gather")
     n = out.shape[0]
     if isinstance(tensor_list, list):
@@ -231,15 +220,22 @@ def all_gather(tensor_list: list, tensor: Tensor, group: Group | None = None, sy
 
 def all_gather_object(object_list: list, obj, group=None):
     if multiproc.cross_process_active():
-        object_list.extend(multiproc.exchange_objects(obj))
+        object_list.extend(multiproc.exchange_objects(obj, _group_ranks(group)))
         return object_list
     object_list.append(obj)
     return object_list
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    # every rank receives the reduced value (a superset of reduce-to-dst;
-    # the dst rank's result is exactly the reference semantics)
+    axes = _bound_axes(_axis_names(group))
+    if not axes and multiproc.cross_process_active():
+        # reference semantics: only dst's buffer receives the reduction
+        reduced = multiproc.allreduce_np(np.asarray(tensor._value), op,
+                                         _group_ranks(group))
+        if get_rank() == dst:
+            _set_np(tensor, reduced)
+        return tensor
+    # in-graph / single-process: psum (superset — dst's value is exact)
     return all_reduce(tensor, op, group, sync_op)
 
 
@@ -251,9 +247,15 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, s
 
         src = concat(src, axis=0)
     if not axes:
+        if multiproc.cross_process_active():
+            ranks = _group_ranks(group) or tuple(range(multiproc.num_processes()))
+            reduced = multiproc.allreduce_np(np.asarray(src._value), op, ranks)
+            pos = list(sorted(ranks)).index(get_rank())
+            chunk = reduced.shape[0] // len(ranks)
+            return _set_np(tensor, reduced[pos * chunk:(pos + 1) * chunk])
         tensor._set_value(src._value)
         return tensor
-    ax = axes[0]
+    ax = axes if len(axes) > 1 else axes[0]
     out = apply_op(lambda v: jax.lax.psum_scatter(v, ax, tiled=True), src, name="reduce_scatter")
     tensor._set_value(out._value)
     tensor._grad_node = out._grad_node
@@ -263,33 +265,36 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, s
 def broadcast(tensor, src=0, group=None, sync_op=True):
     axes = _bound_axes(_axis_names(group))
     if not axes and multiproc.cross_process_active():
-        _require_world_group(group, "broadcast")
-        return _set_np(tensor, multiproc.broadcast_np(np.asarray(tensor._value), src))
+        return _set_np(tensor, multiproc.broadcast_np(
+            np.asarray(tensor._value), src, _group_ranks(group)))
     # single-process global-SPMD view: value already replicated
     return tensor
 
 
 def broadcast_object_list(object_list, src=0, group=None):
     if multiproc.cross_process_active():
-        _require_world_group(group, "broadcast_object_list")
-        object_list[:] = multiproc.broadcast_object(list(object_list), src)
+        object_list[:] = multiproc.broadcast_object(
+            list(object_list), src, _group_ranks(group))
     return object_list
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if multiproc.cross_process_active():
-        _require_world_group(group, "scatter")
+        ranks = sorted(_group_ranks(group) or range(multiproc.num_processes()))
         rank = get_rank()
         if rank == src:
             if not tensor_list:
                 raise ValueError("scatter: src rank must pass tensor_list")
-            stacked = np.stack([np.asarray(t._value) for t in tensor_list])
-        else:
-            world = multiproc.num_processes()
-            stacked = np.zeros((world,) + tuple(tensor.shape),
-                               dtype=np.asarray(tensor._value).dtype)
-        stacked = multiproc.broadcast_np(stacked, src)
-        return _set_np(tensor, stacked[rank])
+            if len(tensor_list) != len(ranks):
+                raise ValueError(
+                    f"scatter: len(tensor_list)={len(tensor_list)} must equal "
+                    f"the group size {len(ranks)}")
+            # per-rank rows go point-to-point: each peer receives only its row
+            for r, t in zip(ranks, tensor_list):
+                if r != src:
+                    multiproc.store_send(np.asarray(t._value), r)
+            return _set_np(tensor, np.asarray(tensor_list[ranks.index(src)]._value))
+        return _set_np(tensor, multiproc.store_recv(src))
     if tensor_list:
         tensor._set_value(tensor_list[get_rank() if get_rank() < len(tensor_list) else 0]._value)
     return tensor
@@ -297,8 +302,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     if multiproc.cross_process_active():
-        _require_world_group(group, "gather")
-        gathered = multiproc.allgather_np(np.asarray(tensor._value))
+        ranks = _group_ranks(group)
+        gathered = multiproc.allgather_np(np.asarray(tensor._value), ranks)
         if gather_list is not None and get_rank() == dst:
             from paddle_tpu.core.tensor import to_tensor
 
@@ -316,14 +321,18 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     stacked = concat([t.unsqueeze(0) for t in in_tensor_list], axis=0)
     if not axes:
         if multiproc.cross_process_active():
-            _require_world_group(group, "all_to_all")
-            # row i of every process's stacked input goes to process i
-            gathered = multiproc.allgather_np(np.asarray(stacked._value))  # [P, P, ...]
+            # row j of each member's input goes point-to-point to member j
+            ranks = sorted(_group_ranks(group) or range(multiproc.num_processes()))
+            rank = get_rank()
+            rows = np.asarray(stacked._value)
+            for j, r in enumerate(ranks):
+                if r != rank:
+                    multiproc.store_send(rows[j], r)
             from paddle_tpu.core.tensor import to_tensor
 
-            rank = get_rank()
-            out_tensor_list.extend(to_tensor(gathered[r, rank])
-                                   for r in range(gathered.shape[0]))
+            out_tensor_list.extend(
+                to_tensor(rows[j]) if r == rank else to_tensor(multiproc.store_recv(r))
+                for j, r in enumerate(ranks))
             return out_tensor_list
         out_tensor_list.extend(t.squeeze(0) for t in split(stacked, len(in_tensor_list), 0))
         return out_tensor_list
@@ -338,13 +347,18 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None, out_split_size
     axes = _bound_axes(_axis_names(group))
     if not axes:
         if multiproc.cross_process_active():
-            _require_world_group(group, "all_to_all_single")
-            gathered = multiproc.allgather_np(np.asarray(in_tensor._value))  # [P, n, ...]
-            world = gathered.shape[0]
-            chunk = gathered.shape[1] // world
+            ranks = sorted(_group_ranks(group) or range(multiproc.num_processes()))
+            n = len(ranks)
             rank = get_rank()
+            src_rows = np.asarray(in_tensor._value)
+            chunk = src_rows.shape[0] // n
+            for j, r in enumerate(ranks):
+                if r != rank:
+                    multiproc.store_send(src_rows[j * chunk:(j + 1) * chunk], r)
+            pos = ranks.index(rank)
             rows = np.concatenate(
-                [gathered[r, rank * chunk:(rank + 1) * chunk] for r in range(world)], 0)
+                [src_rows[pos * chunk:(pos + 1) * chunk] if r == rank
+                 else multiproc.store_recv(r) for r in ranks], 0)
             return _set_np(out_tensor, rows)
         out_tensor._set_value(in_tensor._value)
         return out_tensor
@@ -418,7 +432,7 @@ def batch_isend_irecv(p2p_op_list: Sequence[P2POp]):
 
 def barrier(group=None):
     if multiproc.cross_process_active():
-        multiproc.barrier()
+        multiproc.barrier(ranks=_group_ranks(group))
         return
     from paddle_tpu.core.device import synchronize
 

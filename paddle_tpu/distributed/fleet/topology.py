@@ -126,7 +126,8 @@ class HybridCommunicateGroup:
         self._sharding_group = new_group(axes=("sharding",), ranks=self._ranks_in("sharding"))
         self._sep_group = new_group(axes=("sep",), ranks=self._ranks_in("sep")) if self._sep_degree > 1 else None
         # fused dp+sharding group for grad sync (reference topology dp_sharding fusion)
-        self._dp_sharding_group = new_group(axes=("dp", "sharding"))
+        self._dp_sharding_group = new_group(axes=("dp", "sharding"),
+                                            ranks=self._ranks_in("data", "sharding"))
         self._check_group = new_group(axes=tuple())
 
     def _ensure_mesh(self):
@@ -144,18 +145,20 @@ class HybridCommunicateGroup:
             # keep a degenerate mesh; sharded compilation uses dryrun meshes.
             build_mesh({"dp": ndev})
 
-    def _ranks_in(self, axis_name):
+    def _ranks_in(self, *axis_names):
+        """Ranks sharing this rank's coordinates on every axis NOT listed,
+        sweeping the listed axes (one or fused — reference dp×sharding)."""
         rank = min(self.global_rank, self.nranks - 1)
         coord = self._topo.get_coord(rank)
         names = self._topo.get_hybrid_group_names()
         idx = {n: c for n, c in zip(names, coord)}
-        ax = names.index(axis_name)
+        sweep = [range(self._topo.get_dim(a)) for a in axis_names]
         ranks = []
-        for i in range(self._topo.get_dim(axis_name)):
-            c = [idx[n] for n in names]
-            c[ax] = i
-            ranks.append(self._topo.get_rank(**dict(zip(names, c))))
-        return tuple(ranks)
+        for combo in product(*sweep):
+            c = dict(idx)
+            c.update(dict(zip(axis_names, combo)))
+            ranks.append(self._topo.get_rank(**c))
+        return tuple(sorted(ranks))
 
     # ---- mode -------------------------------------------------------------
     def get_parallel_mode(self):

@@ -72,6 +72,14 @@ def launch(argv=None):
             "PADDLE_LOCAL_RANK": str(local),
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_JOB_ID": args.job_id,
+            # session id namespaces store keys; single-node launches get a
+            # fresh one per launch (stale keys from a previous incarnation are
+            # dead), multi-node launchers must agree so it derives from the
+            # job identity (operators can override via env)
+            "PADDLE_JOB_SESSION": os.getenv(
+                "PADDLE_JOB_SESSION",
+                f"{args.job_id}-{os.getpid()}-{int(time.time())}" if args.nnodes == 1
+                else f"{args.job_id}-{args.master or 'nomaster'}"),
         })
         if args.master:
             env["PADDLE_MASTER"] = args.master

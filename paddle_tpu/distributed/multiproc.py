@@ -2,19 +2,27 @@
 
 Reference analog: ProcessGroup* eager collectives
 (fluid/distributed/collective/process_group.h:47) — arbitrary-time collectives
-between OS processes, used by eager DataParallel, object collectives, and
-checkpoint metadata exchange.
+between OS processes over per-axis sub-groups (the reference builds one comm
+group per mesh axis, fleet/base/topology.py:223-244), used by eager
+DataParallel, object collectives, and checkpoint metadata exchange.
 
 TPU-native: once `init_parallel_env` has called `jax.distributed.initialize`,
-the job is one JAX "global device" world. Host-level eager collectives ride
-`jax.experimental.multihost_utils` (which compiles tiny XLA collective
-programs over ICI/DCN — the ProcessGroupXLA seam from SURVEY §5); object
-collectives and p2p send/recv ride the TCPStore. In-graph collectives (the
-hot path) never come here — they lower to lax.psum/ppermute inside the
+the job is one JAX "global device" world. Full-world host collectives ride
+`jax.experimental.multihost_utils` (tiny XLA collective programs over ICI/DCN
+— the ProcessGroupXLA seam from SURVEY §5). Sub-group collectives and p2p
+send/recv ride the TCPStore (gloo-style rendezvous data plane): only the
+member ranks enter the call — matching ProcessGroup semantics — so a dp-axis
+allreduce with dp ⊂ world cannot deadlock non-members. In-graph collectives
+(the hot path) never come here — they lower to lax.psum/ppermute inside the
 compiled step (collective.py).
+
+Keys are namespaced by a job session id and deleted after the last member
+consumes them, so long runs do not grow the store server; a fresh session id
+(set by the launcher) makes any stale keys from a previous job invisible.
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import numpy as np
@@ -23,7 +31,9 @@ import jax
 
 __all__ = [
     "num_processes", "cross_process_active", "allgather_np", "allreduce_np",
-    "broadcast_np", "exchange_objects", "barrier", "store_send", "store_recv",
+    "broadcast_np", "subgroup_allgather_np", "subgroup_broadcast_np",
+    "exchange_objects", "broadcast_object", "barrier", "subgroup_barrier",
+    "store_send", "store_recv",
 ]
 
 _counters: dict[str, int] = {}
@@ -32,6 +42,12 @@ _counters: dict[str, int] = {}
 def _next(tag: str) -> int:
     _counters[tag] = _counters.get(tag, 0) + 1
     return _counters[tag]
+
+
+def _session() -> str:
+    """Job-session namespace for store keys (set by launch/main.py; a restart
+    gets a new session so stale keys from the previous incarnation are dead)."""
+    return os.getenv("PADDLE_JOB_SESSION", "s0")
 
 
 def num_processes() -> int:
@@ -49,19 +65,25 @@ def _rank() -> int:
     return jax.process_index()
 
 
+def _is_subgroup(ranks) -> bool:
+    return ranks is not None and len(ranks) < num_processes()
+
+
 # ---- array collectives over the global-device world -----------------------
 
-def allgather_np(arr) -> np.ndarray:
-    """Gather per-process arrays; returns [num_processes, *shape] numpy."""
+def allgather_np(arr, ranks=None) -> np.ndarray:
+    """Gather per-process arrays; returns [group_size, *shape] numpy.
+
+    Full world → multihost_utils (XLA program over ICI/DCN). Proper sub-group
+    → store data plane, entered by member ranks only."""
+    if _is_subgroup(ranks):
+        return subgroup_allgather_np(arr, ranks)
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(np.asarray(arr), tiled=False))
 
 
-def allreduce_np(arr, op: str = "sum", ranks=None) -> np.ndarray:
-    gathered = allgather_np(arr)
-    if ranks:
-        gathered = gathered[list(ranks)]
+def _reduce_rows(gathered: np.ndarray, op: str) -> np.ndarray:
     if op == "sum":
         return gathered.sum(0)
     if op == "avg":
@@ -75,20 +97,29 @@ def allreduce_np(arr, op: str = "sum", ranks=None) -> np.ndarray:
     raise ValueError(f"unknown reduce op {op!r}")
 
 
-def broadcast_np(arr, src: int = 0) -> np.ndarray:
+def allreduce_np(arr, op: str = "sum", ranks=None) -> np.ndarray:
+    return _reduce_rows(allgather_np(arr, ranks), op)
+
+
+def broadcast_np(arr, src: int = 0, ranks=None) -> np.ndarray:
+    if _is_subgroup(ranks):
+        return subgroup_broadcast_np(arr, src, ranks)
     from jax.experimental import multihost_utils
 
     return np.asarray(
         multihost_utils.broadcast_one_to_all(np.asarray(arr), is_source=_rank() == src))
 
 
-def barrier(name: str | None = None) -> None:
+def barrier(name: str | None = None, ranks=None) -> None:
+    if _is_subgroup(ranks):
+        subgroup_barrier(ranks)
+        return
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name or f"pt_barrier_{_next('barrier')}")
 
 
-# ---- object collectives + p2p over the TCPStore ---------------------------
+# ---- sub-group collectives over the TCPStore ------------------------------
 
 def _store():
     from paddle_tpu.distributed.store import create_or_get_global_tcp_store
@@ -96,30 +127,97 @@ def _store():
     return create_or_get_global_tcp_store()
 
 
-def exchange_objects(obj, world: int | None = None) -> list:
-    """All-gather arbitrary pickled objects via the TCPStore."""
-    world = world or num_processes()
-    seq = _next("objgather")
+def _gc_keys(store, keys: list[str], ack_key: str, nmembers: int) -> None:
+    """Last member to finish deletes the exchange's keys (+ the ack counter),
+    so per-step traffic cannot grow the store server without bound."""
+    if store.add(ack_key, 1) == nmembers:
+        for k in keys:
+            store.delete_key(k)
+        store.delete_key(ack_key)
+
+
+def _group_prefix(kind: str, ranks) -> tuple[str, list[int]]:
+    members = sorted(int(r) for r in ranks)
+    if _rank() not in members:
+        raise RuntimeError(
+            f"rank {_rank()} entered a {kind} over group {members} it is not a "
+            "member of (ProcessGroup semantics: only members participate)")
+    tag = "-".join(map(str, members))
+    seq = _next(f"{kind}/{tag}")
+    return f"{_session()}/{kind}/{tag}/{seq}", members
+
+
+def subgroup_allgather_np(arr, ranks) -> np.ndarray:
+    """Gather member arrays [len(ranks), *shape]; only members enter."""
+    pre, members = _group_prefix("sg", ranks)
     store = _store()
-    store.set(f"og/{seq}/{_rank()}", pickle.dumps(obj))
-    return [pickle.loads(store.wait(f"og/{seq}/{r}")) for r in range(world)]
+    store.set(f"{pre}/{_rank()}", pickle.dumps(np.asarray(arr)))
+    rows = [pickle.loads(store.wait(f"{pre}/{r}")) for r in members]
+    _gc_keys(store, [f"{pre}/{r}" for r in members], f"{pre}/acks", len(members))
+    return np.stack(rows)
 
 
-def broadcast_object(obj, src: int = 0):
-    """Only the src rank's object crosses the wire (unlike exchange_objects)."""
-    seq = _next("objbcast")
+def subgroup_broadcast_np(arr, src: int, ranks) -> np.ndarray:
+    """Only the src rank's payload crosses the wire."""
+    pre, members = _group_prefix("sb", ranks)
     store = _store()
     if _rank() == src:
-        store.set(f"ob/{seq}/{src}", pickle.dumps(obj))
-        return obj
-    return pickle.loads(store.wait(f"ob/{seq}/{src}"))
+        store.set(f"{pre}/v", pickle.dumps(np.asarray(arr)))
+        out = np.asarray(arr)
+    else:
+        out = pickle.loads(store.wait(f"{pre}/v"))
+    _gc_keys(store, [f"{pre}/v"], f"{pre}/acks", len(members))
+    return out
+
+
+def subgroup_barrier(ranks) -> None:
+    pre, members = _group_prefix("bar", ranks)
+    store = _store()
+    if store.add(f"{pre}/n", 1) == len(members):
+        store.set(f"{pre}/done", b"1")
+    store.wait(f"{pre}/done")
+    _gc_keys(store, [f"{pre}/n", f"{pre}/done"], f"{pre}/acks", len(members))
+
+
+# ---- object collectives + p2p over the TCPStore ---------------------------
+
+def exchange_objects(obj, ranks=None) -> list:
+    """All-gather arbitrary pickled objects via the TCPStore."""
+    members = sorted(ranks) if ranks else list(range(num_processes()))
+    pre, members = _group_prefix("og", members)
+    store = _store()
+    store.set(f"{pre}/{_rank()}", pickle.dumps(obj))
+    out = [pickle.loads(store.wait(f"{pre}/{r}")) for r in members]
+    _gc_keys(store, [f"{pre}/{r}" for r in members], f"{pre}/acks", len(members))
+    return out
+
+
+def broadcast_object(obj, src: int = 0, ranks=None):
+    """Only the src rank's object crosses the wire (unlike exchange_objects)."""
+    members = sorted(ranks) if ranks else list(range(num_processes()))
+    pre, members = _group_prefix("ob", members)
+    store = _store()
+    if _rank() == src:
+        store.set(f"{pre}/v", pickle.dumps(obj))
+        out = obj
+    else:
+        out = pickle.loads(store.wait(f"{pre}/v"))
+    _gc_keys(store, [f"{pre}/v"], f"{pre}/acks", len(members))
+    return out
 
 
 def store_send(arr, dst: int) -> None:
-    seq = _next(f"p2p_s/{_rank()}->{dst}")
-    _store().set(f"p2p/{_rank()}->{dst}/{seq}", pickle.dumps(np.asarray(arr)))
+    """Peer-addressed eager send (reference isend, process_group.h:205); the
+    per-(src,dst) sequence pairs each send with exactly one recv."""
+    seq = _next(f"p2p/{_rank()}->{dst}")
+    key = f"{_session()}/p2p/{_rank()}->{dst}/{seq}"
+    _store().set(key, pickle.dumps(np.asarray(arr)))
 
 
 def store_recv(src: int):
-    seq = _next(f"p2p_r/{src}->{_rank()}")
-    return pickle.loads(_store().wait(f"p2p/{src}->{_rank()}/{seq}"))
+    seq = _next(f"p2p/{src}->{_rank()}")
+    store = _store()
+    key = f"{_session()}/p2p/{src}->{_rank()}/{seq}"
+    out = pickle.loads(store.wait(key))
+    store.delete_key(key)  # consumed exactly once — GC immediately
+    return out

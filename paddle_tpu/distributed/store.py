@@ -88,6 +88,9 @@ class _PyStoreServer:
                             out = self.data[key]
                         else:
                             status = 1
+                elif op == 4:
+                    with self.cv:
+                        status = 0 if self.data.pop(key, None) is not None else 1
                 conn.sendall(bytes([status]) + struct.pack("<I", len(out)) + out)
         except (ConnectionError, OSError):
             pass
@@ -169,6 +172,14 @@ class TCPStore:
             return int(res)
         st, out = self._client.request(2, key, struct.pack("<q", delta))
         return struct.unpack("<q", out)[0]
+
+    def delete_key(self, key: str) -> bool:
+        """Remove a consumed key so collective/p2p traffic can't grow the
+        server without bound (reference Store::deleteKey)."""
+        if self._native is not None:
+            return self._native.pt_store_delete(self._client, key.encode()) == 0
+        st, _ = self._client.request(4, key, b"")
+        return st == 0
 
     def wait(self, keys, timeout: float | None = None):
         tmo = int((timeout or self.timeout_ms / 1000.0) * 1000)

@@ -1,0 +1,124 @@
+"""4-process worker: Fleet HybridParallel sub-group collectives across OS
+processes (dp=2 x mp=2).
+
+Launched by test_multiprocess.py via `python -m paddle_tpu.distributed.launch
+--nproc_per_node 4`. Validates the reference's per-axis ProcessGroup pattern
+(fleet/base/topology.py:223-244 creates one comm group per mesh axis;
+process_group.h:47 collectives run among MEMBER ranks only):
+  1. HybridCommunicateGroup builds dp/mp sub-groups with correct rank lists
+  2. eager all_reduce / all_gather / broadcast / reduce over a PROPER
+     sub-group, entered only by that group's members, verified vs numpy
+  3. peer-addressed send/recv honoring dst/src (not a ring)
+  4. sub-group barrier + all_to_all
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}", flush=True)
+        sys.exit(1)
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    check(dist.get_world_size() == 4, "world_size != 4")
+    check(jax.process_count() == 4, "process_count != 4")
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+
+    # topology (pipe, data, sharding, sep, model) row-major:
+    # rank = data*2 + model -> dp groups {0,2},{1,3}? No: data-major means
+    # ranks (data, model): 0=(0,0) 1=(0,1) 2=(1,0) 3=(1,1)
+    # mp group = fixed data, sweep model -> {0,1} and {2,3}
+    # dp group = fixed model, sweep data -> {0,2} and {1,3}
+    mp_group = hcg.get_model_parallel_group()
+    dp_group = hcg.get_data_parallel_group()
+    exp_mp = (0, 1) if rank in (0, 1) else (2, 3)
+    exp_dp = (0, 2) if rank in (0, 2) else (1, 3)
+    check(tuple(mp_group.ranks) == exp_mp, f"mp ranks {mp_group.ranks} != {exp_mp}")
+    check(tuple(dp_group.ranks) == exp_dp, f"dp ranks {dp_group.ranks} != {exp_dp}")
+    check(mp_group.rank == exp_mp.index(rank), "mp group-local rank")
+    check(dp_group.nranks == 2, "dp group size")
+
+    # ---- sub-group all_reduce: only members enter; sums differ per group ----
+    t = paddle.to_tensor(np.full((3,), float(rank + 1), np.float32))
+    dist.all_reduce(t, group=mp_group)
+    want = float(sum(r + 1 for r in exp_mp))
+    np.testing.assert_allclose(t.numpy(), np.full((3,), want, np.float32))
+
+    t2 = paddle.to_tensor(np.full((3,), float(rank + 1), np.float32))
+    dist.all_reduce(t2, group=dp_group, op=dist.ReduceOp.MAX)
+    np.testing.assert_allclose(
+        t2.numpy(), np.full((3,), float(max(exp_dp) + 1), np.float32))
+
+    # ---- sub-group all_gather (row order = group rank order) ---------------
+    got = []
+    dist.all_gather(got, paddle.to_tensor(np.array([rank * 10.0], np.float32)),
+                    group=dp_group)
+    np.testing.assert_allclose(
+        np.concatenate([g.numpy() for g in got]),
+        np.array([r * 10.0 for r in exp_dp], np.float32))
+
+    # ---- sub-group broadcast from the group's last member ------------------
+    b = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+    dist.broadcast(b, src=exp_mp[-1], group=mp_group)
+    np.testing.assert_allclose(b.numpy(), np.full((2,), float(exp_mp[-1]), np.float32))
+
+    # ---- reduce to dst: only dst's buffer updated --------------------------
+    rt = paddle.to_tensor(np.full((2,), float(rank + 1), np.float32))
+    dist.reduce(rt, dst=exp_dp[0], group=dp_group)
+    if rank == exp_dp[0]:
+        np.testing.assert_allclose(
+            rt.numpy(), np.full((2,), float(sum(r + 1 for r in exp_dp)), np.float32))
+    else:
+        np.testing.assert_allclose(rt.numpy(), np.full((2,), float(rank + 1), np.float32))
+
+    # ---- peer-addressed p2p: 0->3 and 3->0 (neither a ring neighbor pair) --
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.array([42.0, 43.0], np.float32)), dst=3)
+        r = paddle.to_tensor(np.zeros(2, np.float32))
+        dist.recv(r, src=3)
+        np.testing.assert_allclose(r.numpy(), [7.0, 8.0])
+    elif rank == 3:
+        r = paddle.to_tensor(np.zeros(2, np.float32))
+        dist.recv(r, src=0)
+        np.testing.assert_allclose(r.numpy(), [42.0, 43.0])
+        dist.send(paddle.to_tensor(np.array([7.0, 8.0], np.float32)), dst=0)
+
+    # ---- sub-group all_to_all over the mp group ----------------------------
+    ins = [paddle.to_tensor(np.array([float(rank * 10 + j)], np.float32))
+           for j in range(2)]
+    outs = []
+    dist.all_to_all(outs, ins, group=mp_group)
+    pos = exp_mp.index(rank)
+    np.testing.assert_allclose(
+        np.concatenate([o.numpy() for o in outs]),
+        np.array([r * 10.0 + pos for r in exp_mp], np.float32))
+
+    # ---- sub-group barrier then whole-world barrier ------------------------
+    dist.barrier(group=mp_group)
+    dist.barrier()
+    print(f"rank {rank} HYBRID_WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
