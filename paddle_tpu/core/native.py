@@ -20,9 +20,22 @@ _tried = False
 
 
 def _build() -> bool:
+    """Incremental make, serialized ACROSS PROCESSES with a lockfile so
+    concurrently launched workers never relink (and then CDLL) a
+    partially-written .so."""
     try:
-        res = subprocess.run(["make", "-C", _CSRC], capture_output=True, timeout=120)
-        return res.returncode == 0 and os.path.exists(_SO)
+        os.makedirs(os.path.join(_CSRC, "build"), exist_ok=True)
+        lockpath = os.path.join(_CSRC, "build", ".build.lock")
+        import fcntl
+
+        with open(lockpath, "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                res = subprocess.run(["make", "-C", _CSRC], capture_output=True,
+                                     timeout=120)
+                return res.returncode == 0 and os.path.exists(_SO)
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
     except Exception:
         return False
 

@@ -20,12 +20,76 @@ TPU-native design: two execution paths with identical math:
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers import PipelineLayer
+from paddle_tpu.nn.layer.layers import Layer
 
 __all__ = ["PipelineParallel"]
+
+
+class _Chain(Layer):
+    """Sequential wrapper for the non-repeating prefix (embedding side) or
+    suffix (head side) of a PipelineLayer's run list. Registers Layer members
+    so functional_call sees their parameters; plain callables pass through."""
+
+    def __init__(self, fns):
+        super().__init__()
+        self._fns = list(fns)
+        for i, fn in enumerate(self._fns):
+            if isinstance(fn, Layer):
+                self.add_sublayer(f"seg_{i}", fn)
+
+    def forward(self, x):
+        for fn in self._fns:
+            x = fn(*x) if isinstance(x, tuple) else fn(x)
+        return x
+
+
+def _param_sig(layer: Layer):
+    return tuple((tuple(p.shape), str(p.dtype)) for p in layer.parameters())
+
+
+def _decompose_run(run_function, num_stages):
+    """Split a PipelineLayer run list into (prefix, homogeneous blocks, suffix)
+    for the scanned compiled pipeline: the longest run of same-class layers
+    with identical parameter signatures, length divisible by num_stages."""
+    n = len(run_function)
+    best = None  # (length, start, end)
+    i = 0
+    while i < n:
+        fn = run_function[i]
+        if not isinstance(fn, Layer) or not fn.parameters():
+            i += 1
+            continue
+        sig = (type(fn), _param_sig(fn))
+        j = i + 1
+        while j < n:
+            g = run_function[j]
+            if not (isinstance(g, Layer) and (type(g), _param_sig(g)) == sig):
+                break
+            j += 1
+        # distinct objects only (SharedLayerDesc reuses one instance)
+        seen = set()
+        uniq_end = i
+        for k in range(i, j):
+            if id(run_function[k]) in seen:
+                break
+            seen.add(id(run_function[k]))
+            uniq_end = k + 1
+        length = uniq_end - i
+        length -= length % num_stages
+        if length >= num_stages and (best is None or length > best[0]):
+            best = (length, i, i + length)
+        i = max(j, i + 1)
+    if best is None:
+        return None
+    _, s, e = best
+    return (_Chain(run_function[:s]), list(run_function[s:e]),
+            _Chain(run_function[e:]))
 
 
 class PipelineParallel:
@@ -38,26 +102,83 @@ class PipelineParallel:
         cfg = strategy.pipeline_configs if strategy is not None else {}
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
+        self._compile_requested = bool(cfg.get("compile", True))
         self.num_stages = hcg.get_pipe_parallel_world_size()
         self.stage_id = hcg.get_stage_id()
         self.total_loss = None
         self._compiled_step = None
+        self._compile_failed = False
+
+    # -- compiled route ------------------------------------------------------
+    def _maybe_compiled(self, optimizer):
+        """Build (once) the compiled scanned-1F1B step from the PipelineLayer.
+        Returns None — with a one-time warning — when the mesh has no pp axis
+        or the layer list has no homogeneous block run to scan over."""
+        if not self._compile_requested or self._compile_failed:
+            return None
+        if self._compiled_step is not None:
+            return self._compiled_step
+        from paddle_tpu.distributed.mesh import get_mesh
+
+        mesh = get_mesh()
+        if (mesh is None or "pp" not in mesh.shape
+                or mesh.shape["pp"] != self.num_stages or self.num_stages < 2):
+            self._compile_failed = True
+            return None
+        parts = _decompose_run(self._layers.run_function, self.num_stages)
+        if parts is None:
+            warnings.warn(
+                "PipelineParallel: layer list has no homogeneous block run; "
+                "falling back to eager micro-batch gradient accumulation")
+            self._compile_failed = True
+            return None
+        embed, blocks, head = parts
+        vpp = int(getattr(self._layers, "_num_virtual_pipeline_stages", 1) or 1)
+        if len(blocks) % (self.num_stages * vpp) != 0:
+            vpp = 1
+        from paddle_tpu.parallel.pipeline import PipelinedTrainStep
+
+        try:
+            self._compiled_step = PipelinedTrainStep(
+                embed, blocks, head,
+                lambda out, lab: self._layers.loss(out, lab),
+                optimizer=optimizer, mesh=mesh, num_micro=self.accumulate_steps,
+                remat=self._layers._recompute_interval > 0, virtual_pp=vpp)
+        except Exception as e:  # shape/mesh mismatch: degrade, don't die
+            warnings.warn(
+                f"PipelineParallel: compiled pipeline unavailable ({e}); "
+                "using eager micro-batch gradient accumulation")
+            self._compile_failed = True
+            return None
+        return self._compiled_step
+
+    def _sync_from_compiled(self):
+        if self._compiled_step is not None:
+            self._compiled_step.sync_params_to_model()
 
     # -- passthrough --------------------------------------------------------
     def __getattr__(self, name):
         return getattr(self.__dict__["_layers"], name)
 
     def __call__(self, *args, **kwargs):
+        self._sync_from_compiled()
         return self._layers(*args, **kwargs)
 
     def parameters(self):
+        self._sync_from_compiled()
         return self._layers.parameters()
 
     def state_dict(self, *a, **k):
+        self._sync_from_compiled()
         return self._layers.state_dict(*a, **k)
 
     def set_state_dict(self, *a, **k):
-        return self._layers.set_state_dict(*a, **k)
+        # loaded weights land on the layer Tensors: drop the compiled step so
+        # it rebuilds (and re-shards) from the new values on next train_batch
+        out = self._layers.set_state_dict(*a, **k)
+        self._compiled_step = None
+        self._compile_failed = False
+        return out
 
     def train(self):
         self._layers.train()
@@ -99,8 +220,29 @@ class PipelineParallel:
         return total
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """reference: pipeline_parallel.py:697."""
+        """reference: pipeline_parallel.py:697. Routes to the compiled scanned
+        1F1B/VPP program (paddle_tpu.parallel.pipeline) when
+        strategy.pipeline_configs['compile'] (default) and the mesh has a pp
+        axis; the optimizer update then runs inside the same XLA program.
+        GradScaler implies a fp16 loss-scaling loop, which stays eager."""
         self._layers.train()
+        if scaler is not None and self._compiled_step is not None:
+            # switching to the eager scaler route mid-run: pull the compiled
+            # weights back and retire the compiled step (eager updates would
+            # otherwise diverge from its internal device arrays)
+            self._sync_from_compiled()
+            self._compiled_step = None
+            self._compile_failed = True
+        if scaler is None:
+            compiled = self._maybe_compiled(optimizer)
+            if compiled is not None:
+                x, y = data
+                loss = compiled(x, y)
+                self.total_loss = loss
+                optimizer.clear_grad()
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
+                return loss
         loss = self.forward_backward_pipeline(data, scaler)
         if scaler is not None:
             scaler.step(optimizer)
@@ -112,6 +254,7 @@ class PipelineParallel:
         return loss
 
     def eval_batch(self, data, compute_loss=True):
+        self._sync_from_compiled()
         self._layers.eval()
         from paddle_tpu.autograd.tape import no_grad
 

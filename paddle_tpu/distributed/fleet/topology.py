@@ -140,6 +140,13 @@ class HybridCommunicateGroup:
         need = int(np.prod(list(axes.values())))
         if need == ndev:
             build_mesh(axes)
+        elif get_mesh() is None and ndev % need == 0:
+            # single-process SPMD with more devices than the logical topology:
+            # realize every hybrid axis and widen dp with the leftover factor
+            # (pure data parallelism GSPMD handles transparently), so pp/mp
+            # paths compile onto real device axes.
+            axes["dp"] = axes["dp"] * (ndev // need)
+            build_mesh(axes)
         elif get_mesh() is None and ndev >= 1:
             # logical topology larger than physical devices (tests on 1 chip):
             # keep a degenerate mesh; sharded compilation uses dryrun meshes.

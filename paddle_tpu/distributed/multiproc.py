@@ -182,7 +182,10 @@ def subgroup_barrier(ranks) -> None:
 # ---- object collectives + p2p over the TCPStore ---------------------------
 
 def exchange_objects(obj, ranks=None) -> list:
-    """All-gather arbitrary pickled objects via the TCPStore."""
+    """All-gather arbitrary pickled objects via the TCPStore. `ranks` is a
+    member list (or an int world size, meaning ranks 0..n-1)."""
+    if isinstance(ranks, int):
+        ranks = range(ranks)
     members = sorted(ranks) if ranks else list(range(num_processes()))
     pre, members = _group_prefix("og", members)
     store = _store()

@@ -122,6 +122,51 @@ class TestLlamaTPPP:
         set_mesh(None)
         assert l1 < l0
 
+    def test_train_batch_compiled_matches_eager(self):
+        """The compiled scanned-1F1B route (pipeline_configs['compile'], the
+        default) must produce the same losses as eager micro-batch grad
+        accumulation — same model init, same data, three steps."""
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+        from paddle_tpu.models.llama import (
+            LlamaForCausalLM, LlamaPretrainingCriterion, llama_tiny_config,
+        )
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 256, (8, 16)).astype(np.int64)
+        labels = rng.randint(0, 256, (8, 16)).astype(np.int64)
+
+        def run(compile_flag):
+            set_mesh(None)
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                       "pp_degree": 2, "sharding_degree": 1,
+                                       "sep_degree": 1}
+            strategy.pipeline_configs = {"accumulate_steps": 2,
+                                         "micro_batch_size": 4,
+                                         "compile": compile_flag}
+            fleet.init(is_collective=True, strategy=strategy)
+            paddle.seed(7)
+            cfg = llama_tiny_config(num_hidden_layers=2,
+                                    use_parallel_cross_entropy=False)
+            crit = LlamaPretrainingCriterion(cfg)
+            pipe = PipelineLayer(layers=LlamaForCausalLM.pipeline_layers(cfg),
+                                 num_stages=2, loss_fn=lambda o, l: crit(o, l))
+            model = fleet.distributed_model(pipe)
+            opt = fleet.distributed_optimizer(paddle.optimizer.AdamW(
+                learning_rate=1e-3, parameters=pipe.parameters()))
+            out = [float(model.train_batch(
+                [paddle.to_tensor(ids), paddle.to_tensor(labels)], opt))
+                for _ in range(3)]
+            used_compiled = model._compiled_step is not None
+            set_mesh(None)
+            return out, used_compiled
+
+        eager_losses, used_e = run(False)
+        comp_losses, used_c = run(True)
+        assert not used_e and used_c
+        np.testing.assert_allclose(comp_losses, eager_losses, rtol=2e-4, atol=2e-4)
+
 
 class TestGptMoEP:
     """config[5]: GPT-MoE expert parallel over the 'ep'/'mp' axis."""
