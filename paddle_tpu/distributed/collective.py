@@ -336,7 +336,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
             return out_tensor_list
         out_tensor_list.extend(t.squeeze(0) for t in split(stacked, len(in_tensor_list), 0))
         return out_tensor_list
-    ax = axes[0]
+    ax = axes if len(axes) > 1 else axes[0]
     out = apply_op(lambda v: jax.lax.all_to_all(v, ax, 0, 0, tiled=False), stacked, name="all_to_all")
     out_tensor_list.extend(t.squeeze(0) for t in split(out, out.shape[0], 0))
     return out_tensor_list
@@ -362,7 +362,7 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None, out_split_size
             return _set_np(out_tensor, rows)
         out_tensor._set_value(in_tensor._value)
         return out_tensor
-    ax = axes[0]
+    ax = axes if len(axes) > 1 else axes[0]
     out = apply_op(lambda v: jax.lax.all_to_all(v, ax, 0, 0, tiled=True), in_tensor,
                    name="all_to_all_single")
     out_tensor._set_value(out._value)
@@ -381,6 +381,10 @@ def _ppermute(tensor, axis, shift):
 def send(tensor, dst=0, group=None, sync_op=True):
     axes = _bound_axes(_axis_names(group))
     if axes:
+        if len(axes) > 1:
+            raise NotImplementedError(
+                "in-graph send() over a fused multi-axis group has no single "
+                "ppermute ring; use a per-axis group")
         return _ppermute(tensor, axes[0], +1)
     if multiproc.cross_process_active():
         multiproc.store_send(np.asarray(tensor._value), dst)
@@ -394,7 +398,12 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    if _bound_axes(_axis_names(group)):
+    axes = _bound_axes(_axis_names(group))
+    if axes:
+        if len(axes) > 1:
+            raise NotImplementedError(
+                "in-graph recv() over a fused multi-axis group has no single "
+                "ppermute ring; use a per-axis group")
         return tensor  # in-graph: the matching ppermute already delivered
     if multiproc.cross_process_active():
         return _set_np(tensor, multiproc.store_recv(src))

@@ -3,19 +3,23 @@
 Reference parity: `MoELayer` (incubate/distributed/models/moe/moe_layer.py:263)
 with `MoEScatter`/`MoEGather` PyLayers (:99/:149) and gates
 (gate/{naive,gshard,switch}_gate.py); dispatch collectives
-`global_scatter`/`global_gather` (distributed/utils/moe_utils.py:20).
+`global_scatter`/`global_gather` (distributed/utils/moe_utils.py:20, CUDA ops
+fluid/operators/collective/global_scatter_op.cu).
 
-TPU-native design: FIXED-CAPACITY dense dispatch (GShard style) — the
-token→expert routing is an einsum with a [tokens, E, C] one-hot dispatch mask,
-so shapes stay static for XLA. Expert weights are BATCHED over a leading
-expert dim annotated to shard over the "ep"/"mp" mesh axis; under GSPMD the
-dispatch/combine einsums lower to the all-to-all over ICI that the reference
-implements with global_scatter/global_gather CUDA ops. Aux (load-balance) loss
-follows GShard.
+TPU-native design: SPARSE fixed-capacity dispatch. Tokens are scatter-added
+into per-expert capacity buckets ([E, C, d] — O(E*C*d) memory, never the
+[N, E, C] one-hot dispatch mask), exchanged with the expert owners via
+`lax.all_to_all` over the "ep" mesh axis inside shard_map (the reference's
+global_scatter/global_gather), run through the BATCHED expert FFNs (weights
+[E_local, d, h], one einsum on the MXU), and returned by the inverse
+all_to_all + gather-combine. Capacities stay static for XLA; overflow tokens
+are dropped and counted (`tokens_dropped`). Aux (load-balance) loss follows
+GShard.
 """
 from __future__ import annotations
 
 import math
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -93,11 +97,81 @@ class ExpertFFN(Layer):
         return apply_op(f, x, self.w1, self.b1, self.w2, self.b2, name="expert_ffn")
 
 
+def _sparse_moe(xv, gv, w1, b1, w2, b2, *, E, k, cf, act,
+                ep, ep_axis, token_axes, other_axes):
+    """Sparse capacity-bucketed dispatch/combine on LOCAL arrays.
+
+    xv [N, d] (this rank's tokens), gv [N, E] gate logits, weights are this
+    rank's expert shard [E//ep, ...]. When ep > 1 the capacity buffers ride
+    lax.all_to_all over `ep_axis` to/from the expert owners (reference
+    global_scatter/global_gather). Returns (out [N, d], l_aux, dropped)."""
+    N, d = xv.shape
+    C = max(1, int(math.ceil(cf * k * N / E)))
+
+    probs = jax.nn.softmax(gv.astype(jnp.float32), axis=-1)         # [N, E]
+    topv, topi = jax.lax.top_k(probs, k)                            # [N, k]
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+    flat_e = topi.reshape(-1)                                       # [N*k]
+    oh = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)                 # [N*k, E]
+    pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh, axis=-1) - 1         # [N*k]
+    valid = pos < C
+    dropped = jnp.sum((~valid).astype(jnp.float32))
+    dest = flat_e * C + jnp.minimum(pos, C - 1)                     # [N*k]
+
+    # scatter tokens into their (expert, slot) buckets: O(E*C*d) memory
+    xp = jnp.repeat(xv, k, axis=0)                                  # [N*k, d]
+    buf = jnp.zeros((E * C, d), xv.dtype)
+    buf = buf.at[dest].add(xp * valid[:, None].astype(xv.dtype))
+
+    if ep > 1:
+        El = E // ep
+        # [E, C, d] -> [ep(owner), El, C, d] -> a2a -> [ep(source), El, C, d]
+        b4 = buf.reshape(ep, El, C, d)
+        b4 = jax.lax.all_to_all(b4, ep_axis, 0, 0, tiled=True)
+        ein = jnp.moveaxis(b4, 1, 0).reshape(El, ep * C, d)
+    else:
+        ein = buf.reshape(E, C, d)
+
+    h = jnp.einsum("ecd,edh->ech", ein, w1) + b1
+    h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
+    eo = jnp.einsum("ech,ehd->ecd", h, w2) + b2                     # [El, ep*C, d]
+
+    if ep > 1:
+        El = E // ep
+        r4 = jnp.moveaxis(eo.reshape(El, ep, C, d), 1, 0)           # [ep, El, C, d]
+        r4 = jax.lax.all_to_all(r4, ep_axis, 0, 0, tiled=True)      # back at source
+        ybuf = r4.reshape(E * C, d)
+    else:
+        ybuf = eo.reshape(E * C, d)
+
+    w = (topv.reshape(-1) * valid.astype(jnp.float32)).astype(xv.dtype)
+    yp = ybuf[dest] * w[:, None]                                    # [N*k, d]
+    out = jnp.sum(yp.reshape(N, k, d), axis=1)
+
+    # GShard load-balance aux loss over this rank's tokens
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.float32), axis=1), axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    if token_axes:
+        dropped = jax.lax.psum(dropped, token_axes)
+        l_aux = jax.lax.pmean(l_aux, token_axes)
+    if other_axes:
+        dropped = jax.lax.pmean(dropped, other_axes)
+        l_aux = jax.lax.pmean(l_aux, other_axes)
+    return out, l_aux.astype(xv.dtype), dropped
+
+
+from paddle_tpu.distributed.mesh import shard_map_compat as _shard_map  # noqa: E402
+
+
 class MoELayer(Layer):
     """reference: moe_layer.py:263.
 
     recompute_interval/moe_group kept for API parity; `gate` may be a string
-    ('naive'|'gshard'|'switch') or a gate Layer.
+    ('naive'|'gshard'|'switch') or a gate Layer. After forward, `l_aux` holds
+    the load-balance loss and `tokens_dropped` the over-capacity token count.
     """
 
     def __init__(self, d_model, experts=None, gate=None, moe_group=None, mp_group=None,
@@ -129,6 +203,62 @@ class MoELayer(Layer):
         else:
             self.gate = gate
         self.l_aux = None
+        self.tokens_dropped = None
+        self._spmd_cache = {}
+
+    def _dispatch_plan(self, n_tokens):
+        """Pick the execution mode: ('bound', ep) inside an enclosing
+        shard_map with ep bound; ('spmd', ep) wrap our own shard_map over the
+        global mesh; ('local', 1) single-group sparse path (GSPMD still shards
+        the expert einsum via the weights' ep annotations)."""
+        from paddle_tpu.distributed.collective import _bound_axes
+        from paddle_tpu.distributed.mesh import get_mesh
+
+        mesh = get_mesh()
+        E = self.num_expert
+        if _bound_axes((EP_AXIS,)):
+            ep = int(mesh.shape[EP_AXIS]) if mesh is not None else 1
+            if ep > 1 and E % ep == 0:
+                return "bound", ep, mesh, ()
+            return "bound", 1, mesh, ()
+        if mesh is not None and EP_AXIS in mesh.shape and mesh.shape[EP_AXIS] > 1 \
+                and E % mesh.shape[EP_AXIS] == 0:
+            tok_axes = tuple(a for a in ("dp", "sharding", "sep", EP_AXIS)
+                             if a in mesh.shape and mesh.shape[a] > 1)
+            div = 1
+            for a in tok_axes:
+                div *= int(mesh.shape[a])
+            if tok_axes and n_tokens % div == 0:
+                return "spmd", int(mesh.shape[EP_AXIS]), mesh, tok_axes
+        return "local", 1, mesh, ()
+
+    def _spmd_fn(self, mesh, ep, tok_axes, n_tokens, E, k):
+        """Build (and cache) the jitted shard_map dispatch program — rebuilt
+        per forward it would retrace every step."""
+        key = (mesh, ep, tok_axes, n_tokens, E, k, self.capacity_factor)
+        cached = self._spmd_cache.get(key)
+        if cached is not None:
+            return cached
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        other = tuple(a for a in mesh.axis_names if a not in tok_axes)
+        body = partial(_sparse_moe, E=E, k=k, cf=self.capacity_factor,
+                       act=self.experts.act, ep=ep, ep_axis=EP_AXIS,
+                       token_axes=tok_axes, other_axes=other)
+        tok_spec = P(tok_axes, None)
+        w_spec = P(EP_AXIS, None, None)
+        in_specs = (tok_spec, P(tok_axes, None), w_spec, w_spec, w_spec, w_spec)
+        out_specs = (tok_spec, P(), P())
+        smapped = jax.jit(_shard_map(body, mesh, in_specs, out_specs))
+
+        def fn(*vals):
+            placed = [jax.device_put(v, NamedSharding(mesh, s))
+                      for v, s in zip(vals, in_specs)]
+            return smapped(*placed)
+
+        self._spmd_cache[key] = fn
+        return fn
 
     def forward(self, x):
         """x: [B, S, d] (or [N, d])."""
@@ -136,51 +266,24 @@ class MoELayer(Layer):
         d = orig_shape[-1]
         x2 = x.reshape([-1, d])
         n_tokens = x2.shape[0]
-        E = self.num_expert
-        k = self.top_k
-        C = max(1, int(self.capacity_factor * n_tokens * k / E))
-
+        E, k = self.num_expert, self.top_k
         logits = self.gate(x2)  # [N, E]
+        mode, ep, mesh, tok_axes = self._dispatch_plan(n_tokens)
 
-        def dispatch_combine(xv, gv, ew1, eb1, ew2, eb2):
-            probs = jax.nn.softmax(gv.astype(jnp.float32), axis=-1)  # [N, E]
-            # top-k choice per token
-            topv, topi = jax.lax.top_k(probs, k)  # [N, k]
-            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        if mode == "spmd":
+            fn = self._spmd_fn(mesh, ep, tok_axes, n_tokens, E, k)
+        else:
+            ep_eff = ep if mode == "bound" else 1
+            fn = partial(_sparse_moe, E=E, k=k,
+                         cf=self.capacity_factor, act=self.experts.act,
+                         ep=ep_eff, ep_axis=EP_AXIS if ep_eff > 1 else None,
+                         token_axes=(), other_axes=())
 
-            # position of each (token, choice) in its expert's buffer
-            onehot = jax.nn.one_hot(topi, E, dtype=jnp.int32)  # [N, k, E]
-            flat = onehot.reshape(-1, E)  # [N*k, E]
-            pos = jnp.cumsum(flat, axis=0) * flat - 1  # [N*k, E] position or -1
-            pos = pos.reshape(n_tokens, k, E)
-            within = (pos >= 0) & (pos < C)
-
-            # dispatch mask [N, E, C]
-            posc = jnp.clip(pos, 0, C - 1)
-            disp = (jax.nn.one_hot(posc, C, dtype=xv.dtype)
-                    * within[..., None].astype(xv.dtype)
-                    * onehot[..., None].astype(xv.dtype))  # [N, k, E, C]
-            disp_mask = jnp.sum(disp, axis=1)  # [N, E, C]
-
-            expert_in = jnp.einsum("nd,nec->ecd", xv, disp_mask)
-            h = jnp.einsum("ecd,edh->ech", expert_in, ew1) + eb1
-            h = jax.nn.gelu(h)
-            expert_out = jnp.einsum("ech,ehd->ecd", h, ew2) + eb2
-
-            combine = jnp.einsum("nkec,nk->nec", disp,
-                                 topv.astype(xv.dtype))  # weighted combine
-            out = jnp.einsum("ecd,nec->nd", expert_out, combine)
-
-            # GShard load-balance aux loss
-            me = jnp.mean(probs, axis=0)  # mean prob per expert
-            ce = jnp.mean(jnp.sum(onehot, axis=1).astype(jnp.float32), axis=0)
-            l_aux = jnp.sum(me * ce) * E
-            return out, l_aux.astype(xv.dtype)
-
-        out, l_aux = apply_op(
-            dispatch_combine, x2, logits,
+        out, l_aux, dropped = apply_op(
+            fn, x2, logits,
             self.experts.w1, self.experts.b1, self.experts.w2, self.experts.b2,
             name="moe_dispatch",
         )
         self.l_aux = l_aux
+        self.tokens_dropped = dropped
         return out.reshape(orig_shape)

@@ -956,6 +956,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         vh = jnp.swapaxes(v, 1, 2)
         b, hq, s_len, d = qh.shape
         hkv = kh.shape[1]
+        if hkv == 0 or hq % hkv != 0:
+            raise ValueError(
+                f"q heads must be a multiple of kv heads, got {hq} and {hkv}")
         g = hq // hkv
         qg = qh.reshape(b, hkv, g, s_len, d)
         scores = jnp.einsum("bhgsd,bhtd->bhgst", qg, kh) / math.sqrt(q.shape[-1])

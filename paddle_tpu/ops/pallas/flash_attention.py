@@ -309,7 +309,9 @@ def flash_attention_bhsd(q, k, v, causal: bool = False, scale: float | None = No
     """q: [B, Hq, S, D]; k,v: [B, Hkv, S, D] with Hq % Hkv == 0 (GQA/MQA)."""
     b, hq, s, d = q.shape
     hkv = k.shape[1]
-    assert hq % hkv == 0, f"GQA needs q_heads % kv_heads == 0, got {hq} % {hkv}"
+    if hkv == 0 or hq % hkv != 0:
+        raise ValueError(
+            f"q heads must be a multiple of kv heads, got {hq} and {hkv}")
     group = hq // hkv
     if scale is None:
         scale = 1.0 / math.sqrt(d)

@@ -36,18 +36,7 @@ from paddle_tpu.parallel.train_step import _param_pspec, functional_call
 
 __all__ = ["PipelinedTrainStep"]
 
-
-def _shard_map(body, mesh, in_specs, out_specs):
-    try:
-        from jax import shard_map
-
-        return shard_map(body, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
-    except (ImportError, TypeError):  # older jax API
-        from jax.experimental.shard_map import shard_map
-
-        return shard_map(body, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_rep=False)
+from paddle_tpu.distributed.mesh import shard_map_compat as _shard_map  # noqa: E402
 
 
 def _stack_params(stages):

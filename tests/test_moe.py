@@ -1,0 +1,100 @@
+"""Sparse expert-parallel MoE dispatch (VERDICT r2 #3): capacity-bucketed
+scatter -> all_to_all over ep -> batched experts -> inverse all_to_all ->
+gather-combine, never materializing the dense [N, E, C] dispatch mask.
+Reference: incubate/distributed/models/moe/moe_layer.py:263 + the
+global_scatter/global_gather CUDA ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+
+
+def _mk_moe(E=8, d=32, h=64, k=2, cf=8.0):
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    paddle.seed(0)
+    return MoELayer(d_model=d, num_expert=E, d_hidden=h, top_k=k,
+                    capacity_factor=cf)
+
+
+class TestSparseDispatch:
+    def test_ep4_matches_local(self):
+        """With capacity high enough that nothing drops, the ep=4 shard_map
+        a2a path must produce the same outputs as the single-group path."""
+        set_mesh(None)
+        moe = _mk_moe()
+        x = np.random.RandomState(0).randn(4, 16, 32).astype(np.float32)
+        out_local = np.asarray(moe(paddle.to_tensor(x))._value)
+        aux_local = float(moe.l_aux)
+
+        build_mesh({"dp": 2, "ep": 4})
+        mode, ep, _, tok = moe._dispatch_plan(4 * 16)
+        assert mode == "spmd" and ep == 4
+        out_ep = np.asarray(moe(paddle.to_tensor(x))._value)
+        aux_ep = float(moe.l_aux)
+        set_mesh(None)
+        np.testing.assert_allclose(out_ep, out_local, rtol=2e-5, atol=2e-5)
+        # aux uses per-device statistics (GShard convention), so values differ
+        # across shardings but stay the same order of magnitude
+        assert np.isfinite(aux_ep) and 0.2 * aux_local < aux_ep < 5 * aux_local
+
+    def test_dispatch_memory_is_capacity_bounded(self):
+        """No intermediate anywhere in the traced program (including the
+        shard_map body) may reach the dense dispatch-mask size N*E*C."""
+        import jax
+
+        set_mesh(None)
+        E, d, k, cf = 8, 32, 2, 1.25
+        moe = _mk_moe(E=E, d=d, cf=cf)
+        N = 1024
+        x = np.random.RandomState(0).randn(N, d).astype(np.float32)
+        C = int(np.ceil(cf * k * N / E))
+        dense_mask = N * E * C
+
+        from paddle_tpu.core.tensor import Tensor
+
+        def fwd(xv):
+            return moe(Tensor(xv))._value
+
+        jaxpr = jax.make_jaxpr(fwd)(x)
+
+        def max_size(jp):
+            m = 0
+            for eqn in jp.eqns:
+                for v in list(eqn.outvars) + list(eqn.invars):
+                    aval = getattr(v, "aval", None)
+                    if aval is not None and hasattr(aval, "shape"):
+                        m = max(m, int(np.prod(aval.shape)) if aval.shape else 1)
+                for pv in eqn.params.values():
+                    inner = getattr(pv, "jaxpr", None)
+                    if inner is not None:
+                        m = max(m, max_size(inner))
+            return m
+
+        biggest = max_size(jaxpr.jaxpr)
+        assert biggest < dense_mask / 4, (biggest, dense_mask)
+
+    def test_token_drop_counting(self):
+        """Tiny capacity forces drops; the layer reports how many."""
+        set_mesh(None)
+        moe = _mk_moe(E=4, cf=0.25, k=2)
+        x = np.random.RandomState(1).randn(2, 32, 32).astype(np.float32)
+        moe(paddle.to_tensor(x))
+        assert float(moe.tokens_dropped) > 0
+
+    def test_ep_grads_flow(self):
+        """Gate and expert weights both receive gradients through the
+        a2a dispatch path."""
+        build_mesh({"dp": 2, "ep": 4})
+        moe = _mk_moe()
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(4, 16, 32).astype(np.float32),
+            stop_gradient=False)
+        out = moe(x)
+        (out.sum() + moe.l_aux).backward()
+        set_mesh(None)
+        assert moe.experts.w1.grad is not None
+        assert float(np.abs(np.asarray(moe.experts.w1.grad._value)).sum()) > 0
+        assert moe.gate.gate_weight.grad is not None
+        assert float(np.abs(np.asarray(moe.gate.gate_weight.grad._value)).sum()) > 0
