@@ -61,6 +61,7 @@ class StaticFunction:
         functools.update_wrapper(self, fn, updated=())
         self._params: list[Tensor] | None = None
         self._jitted = None
+        self._warmed = False
 
     # -- functionalization --------------------------------------------------
     def _collect_params(self):
@@ -114,6 +115,29 @@ class StaticFunction:
         out_vals = self._jitted([p._value for p in params], args_vals, kwargs_vals)
         return jax.tree_util.tree_map(lambda v: Tensor(v) if _is_arr(v) else v, out_vals)
 
+    def warmup(self):
+        """AOT-compile from the declared InputSpec shapes (reference: the
+        static program is built at to_static time, not first call). Only
+        fully-concrete specs warm up — compiling a stand-in batch size for a
+        dynamic dim would never be reused."""
+        if self._input_spec is None:
+            return False
+        if any(d is None or d == -1 for s in self._input_spec for d in s.shape):
+            return False
+        if self._params is None:
+            self._params = self._collect_params()
+        abstract = tuple(
+            jax.ShapeDtypeStruct(tuple(int(d) for d in s.shape),
+                                 to_jax_dtype(s.dtype))
+            for s in self._input_spec)
+        if self._jitted is None:
+            self._jitted = jax.jit(lambda pv, av, kv: self._pure(pv, av, kv))
+        p_abs = [jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)
+                 for p in self._params]
+        self._jitted.lower(p_abs, abstract, {}).compile()
+        self._warmed = True
+        return True
+
     @property
     def code(self):
         import inspect
@@ -145,6 +169,11 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
         if isinstance(fn, Layer):
             sf = StaticFunction(fn.forward, layer=fn, input_spec=input_spec, backend=backend)
             fn.forward = sf
+            if input_spec is not None:
+                try:
+                    sf.warmup()
+                except Exception:
+                    pass  # warmup is an optimization; first call still compiles
             return fn
         return StaticFunction(fn, layer=None, input_spec=input_spec, backend=backend)
 
@@ -203,18 +232,146 @@ def scan(body_fn, init, xs):
 
 # ---- save / load (deployment artifacts) -----------------------------------
 
+def _export(jit_fn, p_abs, abstract):
+    """jax.export across API generations, probing the signature instead of
+    catching TypeError around the traced call (which would misattribute
+    user-code errors and silently drop cross-platform lowering)."""
+    import inspect
+
+    from jax import export as jexport
+
+    params = inspect.signature(jexport.export).parameters
+    if "platforms" in params:
+        return jexport.export(jit_fn, platforms=("cpu", "tpu"))(p_abs, abstract)
+    if "lowering_platforms" in params:
+        return jexport.export(jit_fn, lowering_platforms=("cpu", "tpu"))(p_abs, abstract)
+    return jexport.export(jit_fn)(p_abs, abstract)
+
+
 def save(layer, path, input_spec=None, **configs):
-    """Serialize a layer: params + config. (Reference: paddle.jit.save producing
-    inference programs; here the artifact is params + a module path, since XLA
-    recompiles the program from code at load time.)"""
+    """Serialize a layer into a RUNNABLE deployment artifact: the forward is
+    captured and exported as serialized StableHLO (jax.export) together with
+    the parameter values, so `jit.load` returns a callable that executes
+    WITHOUT importing the model class — the TPU-native analog of the
+    reference's saved inference program + TranslatedLayer
+    (python/paddle/jit/api.py:173 save, translated_layer.py; served by
+    AnalysisPredictor in C++).
+
+    input_spec: list of InputSpec/Tensors/arrays declaring the forward's
+    input shapes+dtypes. Required for export; without it only the legacy
+    params artifact is written.
+    """
+    import pickle
+
     from paddle_tpu.framework.io_ import save as _save
 
     state = layer.state_dict() if hasattr(layer, "state_dict") else layer
-    _save({"state_dict": state, "class": type(layer).__module__ + "." + type(layer).__name__},
-          path + ".pdparams")
+    cls = type(layer).__module__ + "." + type(layer).__name__
+    _save({"state_dict": state, "class": cls}, path + ".pdparams")
+
+    if input_spec is None:
+        return
+
+    params = list(layer.parameters()) if hasattr(layer, "parameters") else []
+    param_vals = [np.asarray(p._value) for p in params]
+
+    def pure(pv, xs):
+        old = [p._value for p in params]
+        try:
+            for p, v in zip(params, pv):
+                p._set_value(v)
+            t_args = [Tensor(x) for x in xs]
+            with _tape.no_grad():
+                out = layer(*t_args)
+            return _unwrap_tree(out)
+        finally:
+            for p, v in zip(params, old):
+                p._set_value(v)
+
+    def _abstracts(dynamic: bool):
+        from jax import export as jexport
+
+        out = []
+        for si, s in enumerate(input_spec):
+            if isinstance(s, InputSpec):
+                dims = [None if (d is None or d == -1) else int(d) for d in s.shape]
+                if dynamic and any(d is None for d in dims):
+                    shape = jexport.symbolic_shape(
+                        ",".join(f"b{si}_{i}" if d is None else str(d)
+                                 for i, d in enumerate(dims)))
+                else:
+                    shape = tuple(1 if d is None else d for d in dims)
+                out.append(jax.ShapeDtypeStruct(shape, to_jax_dtype(s.dtype)))
+            else:
+                v = s._value if isinstance(s, Tensor) else np.asarray(s)
+                out.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
+        return out
+
+    p_abs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in param_vals]
+    jit_pure = jax.jit(pure)
+    try:  # dynamic dims export as jax symbolic shapes when the program allows
+        abstract = _abstracts(dynamic=True)
+        exported = _export(jit_pure, p_abs, abstract)
+    except Exception:
+        abstract = _abstracts(dynamic=False)
+        exported = _export(jit_pure, p_abs, abstract)
+    blob = {
+        "stablehlo": exported.serialize(),
+        "params": param_vals,
+        "class": cls,
+        # symbolic dims stringified: jax _DimExpr objects don't unpickle
+        "in_shapes": [(tuple(d if isinstance(d, int) else str(d) for d in a.shape),
+                       str(a.dtype)) for a in abstract],
+    }
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(blob, f)
+
+
+class TranslatedLayer:
+    """A loaded deployment artifact: executes the exported StableHLO program
+    with the saved parameters — no source class needed (reference
+    jit/translated_layer.py TranslatedLayer)."""
+
+    def __init__(self, blob):
+        from jax import export as jexport
+
+        self._exported = jexport.deserialize(bytearray(blob["stablehlo"]))
+        self._params = [jnp.asarray(v) for v in blob["params"]]
+        self._state = blob.get("state_dict")
+        self.class_name = blob.get("class", "")
+        self.in_shapes = blob.get("in_shapes", [])
+
+    def __call__(self, *args):
+        xs = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        out = self._exported.call(self._params, xs)
+        return jax.tree_util.tree_map(
+            lambda v: Tensor(v) if _is_arr(v) else v, out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def parameters(self):
+        return [Tensor(v) for v in self._params]
+
+    def state_dict(self):
+        return self._state or {}
 
 
 def load(path, **configs):
+    """Load a jit.save artifact. Returns a runnable TranslatedLayer when the
+    exported program exists; otherwise the legacy params dict."""
+    import pickle
+
     from paddle_tpu.framework.io_ import load as _load
 
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel", "rb") as f:
+            blob = pickle.load(f)
+        try:
+            blob.setdefault("state_dict", _load(path + ".pdparams").get("state_dict"))
+        except Exception:
+            pass
+        return TranslatedLayer(blob)
     return _load(path + ".pdparams")

@@ -147,26 +147,40 @@ def cumprod(x, dim=None, dtype=None):
     return apply_op(f, x, name="cumprod")
 
 
-def cummax(x, axis=None):
+def _cum_compare(x, axis, cmp, name, dtype="int64"):
+    """Running max/min with indices via one associative scan over
+    (value, index) pairs — (values, indices) like the reference
+    (python/paddle/tensor/math.py cummax/cummin). axis=None flattens."""
     import jax.lax as lax
+
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    idx_dtype = to_jax_dtype(dtype)
 
     def f(v):
-        a = axis if axis is not None else 0
-        vals = lax.associative_scan(jnp.maximum, v, axis=a)
-        return vals
+        vv = v.reshape(-1) if axis is None else v
+        a = 0 if axis is None else axis
+        idx = lax.broadcasted_iota(idx_dtype, vv.shape, a % vv.ndim)
 
-    vals = apply_op(f, x, name="cummax")
-    return vals
+        def comb(l, r):
+            lv, li = l
+            rv, ri = r
+            # ties keep the later index; NaN wins and then propagates —
+            # both keep the combiner associative and match the reference
+            take = jnp.isnan(rv) | (~jnp.isnan(lv) & cmp(rv, lv))
+            return jnp.where(take, rv, lv), jnp.where(take, ri, li)
+
+        return lax.associative_scan(comb, (vv, idx), axis=a)
+
+    return apply_op(f, x, name=name)
 
 
-def cummin(x, axis=None):
-    import jax.lax as lax
+def cummax(x, axis=None, dtype="int64"):
+    return _cum_compare(x, axis, lambda r, l: r >= l, "cummax", dtype)
 
-    return apply_op(
-        lambda v: lax.associative_scan(jnp.minimum, v, axis=axis if axis is not None else 0),
-        x,
-        name="cummin",
-    )
+
+def cummin(x, axis=None, dtype="int64"):
+    return _cum_compare(x, axis, lambda r, l: r <= l, "cummin", dtype)
 
 
 def kthvalue(x, k, axis=-1, keepdim=False):

@@ -1,0 +1,82 @@
+"""DistModel / auto_parallel.to_static (VERDICT r2 #7): the semi-auto
+pattern — shard a model with placements, to_static(layer, loader, loss,
+optimizer), train — compiles the FULL train step over the mesh.
+Reference: distributed/auto_parallel/api.py:1864 DistModel, :2345 to_static,
+static/engine.py:68 Engine.fit."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+
+
+def _data(n=8, seq=16, vocab=256):
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (n, seq)).astype(np.int64)
+    labels = rng.randint(0, vocab, (n, seq)).astype(np.int64)
+    return paddle.to_tensor(ids), paddle.to_tensor(labels)
+
+
+class TestDistModel:
+    def test_to_static_trains_llama_on_mesh(self):
+        from paddle_tpu.models.llama import (
+            LlamaForCausalLM, LlamaPretrainingCriterion, llama_tiny_config,
+        )
+
+        build_mesh({"dp": 2, "mp": 2})
+        paddle.seed(0)
+        cfg = llama_tiny_config(num_hidden_layers=2)
+        model = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        dist_model = dist.to_static(model, None, lambda o, l: crit(o, l), opt)
+        assert dist_model.mode == "train"
+        ids, labels = _data()
+        losses = [float(dist_model(ids, labels)) for _ in range(3)]
+        assert losses[-1] < losses[0]
+
+        dist_model.eval()
+        ev = float(dist_model(ids, labels))
+        assert np.isfinite(ev)
+
+        dist_model.predict()
+        logits = dist_model(ids)
+        assert logits.shape[0] == 8
+
+        # params synced back for checkpointing
+        sd = dist_model.state_dict()
+        assert len(sd) == len(model.state_dict())
+        set_mesh(None)
+
+    def test_to_static_zero_sharding_from_strategy(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.models import BertForMaskedLM, bert_tiny_config
+
+        build_mesh({"sharding": 8})
+        paddle.seed(0)
+        model = BertForMaskedLM(bert_tiny_config())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 8,
+                                   "sep_degree": 1}
+
+        import paddle_tpu.nn.functional as F
+
+        def loss_fn(out, lab):
+            return F.cross_entropy(out.reshape([-1, out.shape[-1]]),
+                                   lab.reshape([-1]))
+
+        dm = dist.to_static(model, None, loss_fn, opt, strategy)
+        ids, labels = _data(n=8, seq=16)
+        l0 = float(dm(ids, labels))
+        assert np.isfinite(l0)
+        # optimizer state must actually be sharded over the axis
+        st = dm._step._opt_states[0]
+        sharded = any(
+            "sharding" in (tuple(v.sharding.spec) if hasattr(v.sharding, "spec") else ())
+            for v in st.values() if hasattr(v, "sharding"))
+        assert sharded
+        set_mesh(None)

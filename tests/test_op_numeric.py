@@ -1,0 +1,785 @@
+"""OpTest-analog numeric verification harness.
+
+Reference: test/legacy_test/op_test.py — `check_output` (:418) compares each
+op against a numpy reference; `check_grad` (:2964) compares analytic
+gradients against numeric differentiation, with per-dtype tolerance tiers.
+
+TPU-native analog: every registered case checks
+  1. forward: the op on float32 Tensors vs an independent float64
+     numpy/scipy reference, and
+  2. gradient: the tape's analytic gradient of sum(op(x) * w) vs a central
+     -difference numeric gradient of the float64 REFERENCE (the numeric
+     side is computed entirely in f64 numpy, so f32 noise never enters the
+     finite differences).
+Tolerance tiers per dtype: float32 (tight) and bfloat16 (loose,
+forward-only) — the TPU compute dtypes.
+
+A planted-wrong-vjp canary proves the harness catches bad gradients.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RS = np.random.RandomState
+
+# ---------------------------------------------------------------------------
+# tolerance tiers (reference op_test.py dtype-dependent thresholds)
+TIERS = {
+    "float32": dict(rtol=2e-5, atol=2e-5),
+    "bfloat16": dict(rtol=3e-2, atol=3e-2),
+}
+GRAD_RTOL, GRAD_ATOL = 1e-2, 1e-3
+EPS = 1e-4  # central-difference step (f64 reference => error ~ EPS^2)
+
+
+@dataclass
+class OpCase:
+    name: str
+    fn: object                 # (*Tensors) -> Tensor (or first-output wrapper)
+    ref: object                # (*f64 arrays) -> f64 array
+    inputs: tuple              # numpy arrays (cast per tier)
+    grad: bool = True
+    wrt: tuple | None = None   # indices of inputs to differentiate (default: all floats)
+    rtol: float | None = None
+    atol: float | None = None
+    gtol: tuple = (GRAD_RTOL, GRAD_ATOL)
+
+
+CASES: list[OpCase] = []
+_seen: dict = {}
+
+
+def case(name, fn, ref, *inputs, **kw):
+    n = name
+    if name in _seen:
+        _seen[name] += 1
+        n = f"{name}#{_seen[name]}"
+    else:
+        _seen[name] = 1
+    CASES.append(OpCase(n, fn, ref, tuple(np.asarray(a) for a in inputs), **kw))
+
+
+def _is_float(a):
+    return np.issubdtype(np.asarray(a).dtype, np.floating)
+
+
+def _tensors(inputs, dtype):
+    ts = []
+    for a in inputs:
+        if _is_float(a):
+            ts.append(paddle.to_tensor(a.astype(dtype), stop_gradient=False))
+        else:
+            ts.append(paddle.to_tensor(a))
+    return ts
+
+
+def _run_forward(c: OpCase, dtype="float32"):
+    ts = _tensors(c.inputs, dtype)
+    out = c.fn(*ts)
+    y = np.asarray(out._value, np.float64)
+    refv = np.asarray(c.ref(*[np.asarray(a, np.float64) if _is_float(a) else a
+                              for a in c.inputs]), np.float64)
+    tier = TIERS[dtype]
+    rtol = c.rtol if c.rtol is not None else tier["rtol"]
+    atol = c.atol if c.atol is not None else tier["atol"]
+    np.testing.assert_allclose(y, refv, rtol=rtol, atol=atol,
+                               err_msg=f"forward mismatch: {c.name}")
+    return ts, out, refv
+
+
+def _run_grad(c: OpCase):
+    ts, out, refv = _run_forward(c, "float32")
+    w = RS(99).uniform(0.5, 1.5, refv.shape)
+    wt = paddle.to_tensor(w.astype(np.float32))
+    (out * wt).sum().backward()
+
+    f64 = [np.asarray(a, np.float64) if _is_float(a) else a for a in c.inputs]
+    wrt = c.wrt if c.wrt is not None else tuple(
+        i for i, a in enumerate(c.inputs) if _is_float(a))
+
+    def L(args):
+        return float(np.sum(np.asarray(c.ref(*args), np.float64) * w))
+
+    rtol, atol = c.gtol
+    for i in wrt:
+        analytic = np.asarray(ts[i].grad._value, np.float64)
+        num = np.zeros_like(f64[i])
+        it = np.nditer(f64[i], flags=["multi_index"])
+        while not it.finished:
+            j = it.multi_index
+            args_p = [a.copy() if k == i else a for k, a in enumerate(f64)]
+            args_m = [a.copy() if k == i else a for k, a in enumerate(f64)]
+            args_p[i][j] += EPS
+            args_m[i][j] -= EPS
+            num[j] = (L(args_p) - L(args_m)) / (2 * EPS)
+            it.iternext()
+        np.testing.assert_allclose(
+            analytic, num, rtol=rtol, atol=atol,
+            err_msg=f"gradient mismatch: {c.name} wrt input {i}")
+
+
+# ---------------------------------------------------------------------------
+# case registry. Shapes stay tiny so the numeric loop is ~dozens of evals.
+r = RS(0)
+A = r.uniform(-1.0, 1.0, (3, 4))
+B = r.uniform(-1.0, 1.0, (3, 4))
+POS = r.uniform(0.5, 2.0, (3, 4))
+SAFE = r.uniform(0.2, 0.8, (3, 4)) * np.where(r.rand(3, 4) > 0.5, 1.0, -1.0)
+M33 = r.uniform(-1.0, 1.0, (3, 3))
+SPD = M33 @ M33.T + 3.0 * np.eye(3)
+VEC = r.uniform(-1.0, 1.0, (4,))
+IDX = np.array([2, 0, 1], np.int64)
+
+
+def U(name, ref, x=A, fn=None, **kw):
+    case(name, fn or getattr(paddle, name), ref, x, **kw)
+
+
+def BIN(name, ref, x=A, y=B, fn=None, **kw):
+    case(name, fn or getattr(paddle, name), ref, x, y, **kw)
+
+
+# ---- unary math -----------------------------------------------------------
+U("abs", np.abs, SAFE)
+U("acos", np.arccos, A * 0.9)
+U("acosh", np.arccosh, POS + 1.0)
+U("asin", np.arcsin, A * 0.9)
+U("asinh", np.arcsinh)
+U("atan", np.arctan)
+U("atanh", np.arctanh, A * 0.9)
+U("ceil", np.ceil, grad=False)
+U("cos", np.cos)
+U("cosh", np.cosh)
+U("deg2rad", np.deg2rad)
+U("erf", sps.erf)
+U("erfinv", sps.erfinv, A * 0.9)
+U("exp", np.exp)
+U("expm1", np.expm1)
+U("floor", np.floor, grad=False)
+U("frac", lambda x: x - np.trunc(x), SAFE, grad=False)
+U("log", np.log, POS)
+U("log10", np.log10, POS)
+U("log1p", np.log1p, POS)
+U("log2", np.log2, POS)
+U("logit", sps.logit, (A * 0.4 + 0.5))
+U("neg", np.negative)
+U("rad2deg", np.rad2deg)
+U("reciprocal", np.reciprocal, POS)
+U("round", np.round, grad=False)
+U("rsqrt", lambda x: 1.0 / np.sqrt(x), POS)
+U("sign", np.sign, SAFE, grad=False)
+U("sin", np.sin)
+U("sinh", np.sinh)
+U("sqrt", np.sqrt, POS)
+U("square", np.square)
+U("tan", np.tan, A * 0.9)
+U("tanh", np.tanh)
+U("trunc", np.trunc, SAFE, grad=False)
+case("stanh", lambda x: paddle.stanh(x, scale_a=0.67, scale_b=1.7159),
+     lambda x: 1.7159 * np.tanh(0.67 * x), A)
+case("scale", lambda x: paddle.scale(x, scale=2.5, bias=0.5),
+     lambda x: 2.5 * x + 0.5, A)
+case("clip", lambda x: paddle.clip(x, -0.5, 0.5),
+     lambda x: np.clip(x, -0.5, 0.5), SAFE)
+case("pow", lambda x: paddle.pow(x, 2.5), lambda x: np.power(x, 2.5), POS)
+case("cast", lambda x: paddle.cast(x, "float32"),
+     lambda x: x.astype(np.float64), A, grad=False)
+case("nan_to_num", paddle.nan_to_num,
+     lambda x: np.nan_to_num(x, posinf=np.finfo(np.float32).max,
+                             neginf=np.finfo(np.float32).min),
+     np.array([[1.0, np.nan], [np.inf, -np.inf]]), grad=False)
+
+# ---- binary math ----------------------------------------------------------
+BIN("add", np.add)
+BIN("atan2", np.arctan2, POS, POS.T.reshape(3, 4) + 0.1)
+BIN("divide", np.divide, A, POS)
+BIN("fmax", np.fmax)
+BIN("fmin", np.fmin)
+BIN("hypot", np.hypot, POS, POS * 1.3)
+BIN("logaddexp", np.logaddexp)
+BIN("maximum", np.maximum)
+BIN("minimum", np.minimum)
+BIN("multiply", np.multiply)
+BIN("subtract", np.subtract)
+BIN("mod", np.mod, POS * 4, POS.T.reshape(3, 4), grad=False)
+BIN("remainder", np.remainder, POS * 4, POS.T.reshape(3, 4), grad=False)
+BIN("floor_divide", np.floor_divide, POS * 4, POS.T.reshape(3, 4), grad=False)
+case("pow2", paddle.pow, np.power, POS, B)
+case("lerp", paddle.lerp, lambda x, y, w: x + w * (y - x), A, B,
+     r.uniform(0.2, 0.8, (3, 4)))
+
+# ---- linalg ---------------------------------------------------------------
+BIN("matmul", np.matmul, A, B.T)
+BIN("mm", np.matmul, A, B.T, fn=paddle.mm)
+case("bmm", paddle.bmm, np.matmul, r.randn(2, 3, 4), r.randn(2, 4, 3))
+case("dot", paddle.dot, np.dot, VEC, VEC * 1.3)
+case("mv", paddle.mv, np.dot, A, VEC)
+case("inner", paddle.inner, np.inner, A, B)
+case("outer", paddle.outer, np.outer, VEC, VEC * 0.7)
+case("kron", paddle.kron, np.kron, M33, np.eye(2))
+# (4, 3): paddle's default "first axis of size 3" == numpy's last axis
+case("cross", paddle.cross, lambda a, b: np.cross(a, b), r.randn(4, 3), r.randn(4, 3))
+case("t", paddle.t, np.transpose, A)
+case("det", paddle.det, np.linalg.det, SPD)
+case("slogdet", lambda x: paddle.slogdet(x)[1],
+     lambda x: np.linalg.slogdet(x)[1], SPD)
+case("inv", paddle.inv, np.linalg.inv, SPD)
+# symmetrize inside the ref: np.linalg.cholesky reads only the lower
+# triangle, while the analytic vjp distributes the symmetric gradient
+case("cholesky", paddle.cholesky,
+     lambda x: np.linalg.cholesky((x + x.T) / 2), SPD)
+case("solve", paddle.solve, np.linalg.solve, SPD, VEC[:3])
+case("triangular_solve",
+     lambda a, b: paddle.triangular_solve(a, b, upper=False),
+     lambda a, b: np.linalg.solve(np.tril(a), b),
+     np.tril(SPD), r.randn(3, 2))
+case("matrix_power", lambda x: paddle.matrix_power(x, 3),
+     lambda x: np.linalg.matrix_power(x, 3), M33)
+case("multi_dot", lambda a, b, c: paddle.multi_dot([a, b, c]),
+     lambda a, b, c: a @ b @ c, r.randn(2, 3), r.randn(3, 4), r.randn(4, 2))
+case("pinv", paddle.pinv, np.linalg.pinv, SPD, grad=False)
+case("matrix_rank", paddle.matrix_rank, np.linalg.matrix_rank, SPD, grad=False)
+case("svd_vals", lambda x: paddle.svd(x)[1],
+     lambda x: np.linalg.svd(x)[1], M33 + 2 * np.eye(3), grad=False)
+case("qr_r", lambda x: paddle.qr(x)[1].abs(),
+     lambda x: np.abs(np.linalg.qr(x)[1]), SPD, grad=False)
+case("eigvalsh", paddle.eigvalsh, np.linalg.eigvalsh, SPD, grad=False)
+case("eigh_vals", lambda x: paddle.eigh(x)[0],
+     lambda x: np.linalg.eigvalsh(x), SPD, grad=False)
+case("norm_fro", lambda x: paddle.norm(x), np.linalg.norm, A)
+case("norm_1", lambda x: paddle.norm(x, p=1, axis=1),
+     lambda x: np.sum(np.abs(x), 1), SAFE)
+case("dist", lambda a, b: paddle.dist(a, b, p=2),
+     lambda a, b: np.linalg.norm((a - b).ravel()), A, B)
+case("tensordot", lambda a, b: paddle.tensordot(a, b, axes=1),
+     lambda a, b: np.tensordot(a, b, axes=1), A, B.T)
+case("einsum", lambda a, b: paddle.einsum("ij,jk->ik", a, b),
+     lambda a, b: np.einsum("ij,jk->ik", a, b), A, B.T)
+case("cond_2", lambda x: paddle.cond(x, p=2),
+     lambda x: np.linalg.cond(x, 2), SPD, grad=False, rtol=1e-4, atol=1e-4)
+
+# ---- reductions -----------------------------------------------------------
+U("mean", np.mean)
+U("sum", np.sum)
+U("prod", np.prod, POS)
+U("max", np.max, SAFE)
+U("min", np.min, SAFE)
+U("amax", np.amax, SAFE)
+U("amin", np.amin, SAFE)
+case("logsumexp", paddle.logsumexp, sps.logsumexp, A)
+case("std", lambda x: paddle.std(x), lambda x: np.std(x, ddof=1), A)
+case("var", lambda x: paddle.var(x), lambda x: np.var(x, ddof=1), A)
+case("mean_axis", lambda x: paddle.mean(x, axis=1), lambda x: np.mean(x, 1), A)
+case("sum_axis", lambda x: paddle.sum(x, axis=0), lambda x: np.sum(x, 0), A)
+case("cumsum", lambda x: paddle.cumsum(x, axis=1), lambda x: np.cumsum(x, 1), A)
+case("cumprod", lambda x: paddle.cumprod(x, dim=1), lambda x: np.cumprod(x, 1), POS)
+case("cummax", lambda x: paddle.cummax(x, axis=1)[0],
+     lambda x: np.maximum.accumulate(x, 1), SAFE, grad=False)
+case("cummin", lambda x: paddle.cummin(x, axis=1)[0],
+     lambda x: np.minimum.accumulate(x, 1), SAFE, grad=False)
+case("argmax", paddle.argmax, np.argmax, SAFE, grad=False)
+case("argmin", paddle.argmin, np.argmin, SAFE, grad=False)
+case("count_nonzero", paddle.count_nonzero, np.count_nonzero, SAFE, grad=False)
+case("median", paddle.median, np.median, r.randn(3, 5), grad=False)
+case("nanmean", paddle.nanmean, np.nanmean,
+     np.where(r.rand(3, 4) > 0.8, np.nan, A), grad=False)
+case("nansum", paddle.nansum, np.nansum,
+     np.where(r.rand(3, 4) > 0.8, np.nan, A), grad=False)
+case("all", paddle.all, np.all, A > 0, grad=False)
+case("any", paddle.any, np.any, A > 0, grad=False)
+case("kthvalue", lambda x: paddle.kthvalue(x, 2)[0],
+     lambda x: np.sort(x, -1)[..., 1], SAFE, grad=False)
+case("numel", lambda x: paddle.numel(x), lambda x: np.asarray(x.size), A, grad=False)
+
+# ---- comparison / logical (forward only) ----------------------------------
+for nm, rf in [("equal", np.equal), ("not_equal", np.not_equal),
+               ("greater_than", np.greater), ("greater_equal", np.greater_equal),
+               ("less_than", np.less), ("less_equal", np.less_equal)]:
+    case(nm, getattr(paddle, nm), rf, A, np.round(A, 1), grad=False)
+case("logical_and", paddle.logical_and, np.logical_and, A > 0, B > 0, grad=False)
+case("logical_or", paddle.logical_or, np.logical_or, A > 0, B > 0, grad=False)
+case("logical_xor", paddle.logical_xor, np.logical_xor, A > 0, B > 0, grad=False)
+case("logical_not", paddle.logical_not, np.logical_not, A > 0, grad=False)
+iA = r.randint(0, 8, (3, 4))
+iB = r.randint(0, 8, (3, 4))
+case("bitwise_and", paddle.bitwise_and, np.bitwise_and, iA, iB, grad=False)
+case("bitwise_or", paddle.bitwise_or, np.bitwise_or, iA, iB, grad=False)
+case("bitwise_xor", paddle.bitwise_xor, np.bitwise_xor, iA, iB, grad=False)
+case("bitwise_not", paddle.bitwise_not, np.invert, iA, grad=False)
+case("isfinite", paddle.isfinite, np.isfinite,
+     np.array([[1.0, np.inf], [np.nan, -2.0]]), grad=False)
+case("isinf", paddle.isinf, np.isinf,
+     np.array([[1.0, np.inf], [np.nan, -2.0]]), grad=False)
+case("isnan", paddle.isnan, np.isnan,
+     np.array([[1.0, np.inf], [np.nan, -2.0]]), grad=False)
+case("isclose", paddle.isclose, np.isclose, A, A + 1e-9, grad=False)
+case("equal_all", paddle.equal_all, lambda a, b: np.asarray(np.array_equal(a, b)),
+     A, A, grad=False)
+case("allclose", paddle.allclose, lambda a, b: np.asarray(np.allclose(a, b)),
+     A, A + 1e-9, grad=False)
+
+# ---- manipulation ---------------------------------------------------------
+case("reshape", lambda x: paddle.reshape(x, [4, 3]), lambda x: x.reshape(4, 3), A)
+case("transpose", lambda x: paddle.transpose(x, [1, 0]), lambda x: x.T, A)
+case("swapaxes", lambda x: paddle.swapaxes(x, 0, 1), lambda x: np.swapaxes(x, 0, 1), A)
+case("moveaxis", lambda x: paddle.moveaxis(x, 0, 1), lambda x: np.moveaxis(x, 0, 1), A)
+case("flatten", paddle.flatten, np.ravel, A)
+case("squeeze", paddle.squeeze, np.squeeze, A.reshape(3, 1, 4))
+case("unsqueeze", lambda x: paddle.unsqueeze(x, 1),
+     lambda x: np.expand_dims(x, 1), A)
+case("flip", lambda x: paddle.flip(x, axis=1), lambda x: np.flip(x, 1), A)
+case("roll", lambda x: paddle.roll(x, 1, axis=1), lambda x: np.roll(x, 1, 1), A)
+case("rot90", paddle.rot90, np.rot90, A)
+case("tile", lambda x: paddle.tile(x, [2, 1]), lambda x: np.tile(x, (2, 1)), A)
+case("broadcast_to", lambda x: paddle.broadcast_to(x, [3, 4]),
+     lambda x: np.broadcast_to(x, (3, 4)), VEC)
+case("expand", lambda x: paddle.expand(x, [3, 4]),
+     lambda x: np.broadcast_to(x, (3, 4)), VEC)
+case("expand_as", lambda x, y: paddle.expand_as(x, y),
+     lambda x, y: np.broadcast_to(x, y.shape), VEC, A, wrt=(0,))
+case("concat", lambda a, b: paddle.concat([a, b], axis=0),
+     lambda a, b: np.concatenate([a, b], 0), A, B)
+case("stack", lambda a, b: paddle.stack([a, b], axis=0),
+     lambda a, b: np.stack([a, b], 0), A, B)
+case("split0", lambda x: paddle.split(x, 2, axis=1)[0],
+     lambda x: np.split(x, 2, 1)[0], A)
+case("chunk0", lambda x: paddle.chunk(x, 2, axis=1)[1],
+     lambda x: np.split(x, 2, 1)[1], A)
+case("tensor_split0", lambda x: paddle.tensor_split(x, 2, axis=0)[0],
+     lambda x: np.array_split(x, 2, 0)[0], r.randn(4, 3))
+case("unbind0", lambda x: paddle.unbind(x, axis=0)[1], lambda x: x[1], A)
+case("unstack0", lambda x: paddle.unstack(x, axis=0)[0], lambda x: x[0], A)
+case("slice", lambda x: paddle.slice(x, [0, 1], [0, 1], [2, 3]),
+     lambda x: x[0:2, 1:3], A)
+case("strided_slice", lambda x: paddle.strided_slice(x, [1], [0], [4], [2]),
+     lambda x: x[:, 0:4:2], A)
+case("gather", lambda x, i: paddle.gather(x, i, axis=0),
+     lambda x, i: x[i], A, IDX)
+case("index_select", lambda x, i: paddle.index_select(x, i, axis=0),
+     lambda x, i: x[i], A, IDX)
+case("index_sample", paddle.index_sample,
+     lambda x, i: np.take_along_axis(x, i, 1), A, r.randint(0, 4, (3, 2)))
+case("take_along_axis", lambda x, i: paddle.take_along_axis(x, i, axis=1),
+     lambda x, i: np.take_along_axis(x, i, 1), A, r.randint(0, 4, (3, 2)))
+case("gather_nd", paddle.gather_nd,
+     lambda x, i: x[tuple(i.T)], A, np.array([[0, 1], [2, 3]], np.int64))
+case("masked_select", paddle.masked_select,
+     lambda x, m: x[m], A, A > 0, grad=False)
+case("masked_fill", lambda x, m: paddle.masked_fill(x, m, 0.0),
+     lambda x, m: np.where(m, 0.0, x), A, A > 0, wrt=(0,))
+case("where", lambda c, x, y: paddle.where(c, x, y),
+     lambda c, x, y: np.where(c, x, y), A > 0, A, B, wrt=(1, 2))
+case("tril", paddle.tril, np.tril, A)
+case("triu", paddle.triu, np.triu, A)
+case("diag", paddle.diag, np.diag, VEC)
+case("diagflat", paddle.diagflat, np.diagflat, VEC)
+# paddle: len(pad) == 2*ndim pads from the FIRST dimension (unlike torch)
+case("pad", lambda x: paddle.pad(x, [1, 1, 0, 2]),
+     lambda x: np.pad(x, ((1, 1), (0, 2))), A)
+case("repeat_interleave", lambda x: paddle.repeat_interleave(x, 2, axis=0),
+     lambda x: np.repeat(x, 2, 0), A)
+case("sort", lambda x: paddle.sort(x, axis=1), lambda x: np.sort(x, 1), SAFE)
+case("argsort", lambda x: paddle.argsort(x, axis=1),
+     lambda x: np.argsort(x, 1, kind="stable"), SAFE, grad=False)
+case("topk_v", lambda x: paddle.topk(x, 2, axis=1)[0],
+     lambda x: np.sort(x, 1)[:, ::-1][:, :2], SAFE, grad=False)
+case("one_hot", lambda i: paddle.one_hot(i, 4),
+     lambda i: np.eye(4)[i], IDX, grad=False)
+case("searchsorted", paddle.searchsorted, np.searchsorted,
+     np.sort(VEC), np.array([0.0, 0.3]), grad=False)
+case("bincount", paddle.bincount, np.bincount, iA.ravel(), grad=False)
+case("nonzero", lambda x: paddle.nonzero(x),
+     lambda x: np.stack(np.nonzero(x), -1), A > 0.3, grad=False)
+case("unique", lambda x: paddle.unique(x), np.unique, iA.ravel(), grad=False)
+case("scatter", lambda x, i, u: paddle.scatter(x, i, u),
+     lambda x, i, u: _scatter_ref(x, i, u), A, IDX, B, wrt=(0, 2))
+case("scatter_nd_add", paddle.scatter_nd_add, None, A,
+     np.array([[0, 1], [2, 2]], np.int64), np.array([1.0, 2.0]), wrt=(0, 2))
+CASES[-1].ref = lambda x, i, u: _scatter_nd_add_ref(x, i, u)
+case("put_along_axis", lambda x, i, v: paddle.put_along_axis(x, i, v, axis=1),
+     lambda x, i, v: _put_along_ref(x, i, v), A, r.randint(0, 4, (3, 1)),
+     np.float64(7.0).reshape(()) * np.ones((3, 1)), wrt=(0, 2))
+case("crop", lambda x: paddle.crop(x, shape=[2, 2], offsets=[0, 1]),
+     lambda x: x[0:2, 1:3], A)
+case("as_complex_abs", lambda x: paddle.as_complex(x).abs(),
+     lambda x: np.abs(x[..., 0] + 1j * x[..., 1]), r.randn(3, 2), grad=False)
+case("real", lambda x: paddle.real(paddle.as_complex(x)),
+     lambda x: x[..., 0], r.randn(3, 2), grad=False)
+case("imag", lambda x: paddle.imag(paddle.as_complex(x)),
+     lambda x: x[..., 1], r.randn(3, 2), grad=False)
+case("increment", lambda x: paddle.increment(x),
+     lambda x: x + 1.0, A, grad=False)
+case("histogram", lambda x: paddle.histogram(x, bins=4, min=-1, max=1),
+     lambda x: np.histogram(x, 4, (-1, 1))[0], A, grad=False)
+
+
+def _scatter_ref(x, i, u):
+    out = x.copy()
+    for k, idx in enumerate(i):
+        out[idx] = u[k]
+    return out
+
+
+def _scatter_nd_add_ref(x, i, u):
+    out = x.copy()
+    for k in range(len(i)):
+        out[tuple(i[k])] += u[k]
+    return out
+
+
+def _put_along_ref(x, i, v):
+    out = x.copy()
+    np.put_along_axis(out, i, v, 1)
+    return out
+
+
+# ---- creation (forward only) ----------------------------------------------
+case("arange", lambda: paddle.arange(0, 10, 2), lambda: np.arange(0, 10, 2), grad=False)
+case("eye", lambda: paddle.eye(3, 4), lambda: np.eye(3, 4), grad=False)
+case("full", lambda: paddle.full([2, 3], 1.5), lambda: np.full((2, 3), 1.5), grad=False)
+case("linspace", lambda: paddle.linspace(0, 1, 5), lambda: np.linspace(0, 1, 5), grad=False)
+case("ones", lambda: paddle.ones([2, 2]), lambda: np.ones((2, 2)), grad=False)
+case("zeros", lambda: paddle.zeros([2, 2]), lambda: np.zeros((2, 2)), grad=False)
+case("ones_like", paddle.ones_like, np.ones_like, A, grad=False)
+case("zeros_like", paddle.zeros_like, np.zeros_like, A, grad=False)
+case("full_like", lambda x: paddle.full_like(x, 2.0),
+     lambda x: np.full_like(x, 2.0), A, grad=False)
+case("tril_indices", lambda: paddle.tril_indices(3, 3, 0),
+     lambda: np.stack(np.tril_indices(3, 0, 3)), grad=False)
+case("triu_indices", lambda: paddle.triu_indices(3, 3, 0),
+     lambda: np.stack(np.triu_indices(3, 0, 3)), grad=False)
+case("meshgrid0", lambda a, b: paddle.meshgrid(a, b)[0],
+     lambda a, b: np.meshgrid(a, b, indexing="ij")[0], VEC, VEC[:3], grad=False)
+
+# ---- activations (nn.functional) ------------------------------------------
+SH = SAFE  # bounded away from kinks at 0
+
+
+def NF(name, ref, x=SH, fn=None, **kw):
+    case(name, fn or getattr(F, name), ref, x, **kw)
+
+
+NF("relu", lambda x: np.maximum(x, 0))
+NF("relu6", lambda x: np.clip(x, 0, 6), SH * 8)
+NF("leaky_relu", lambda x: np.where(x > 0, x, 0.01 * x))
+NF("elu", lambda x: np.where(x > 0, x, np.exp(x) - 1))
+NF("celu", lambda x: np.maximum(x, 0) + np.minimum(0, np.expm1(x)))
+NF("selu", lambda x: 1.0507009873554805 * np.where(
+    x > 0, x, 1.6732632423543772 * (np.exp(x) - 1)))
+NF("gelu", lambda x: 0.5 * x * (1 + sps.erf(x / np.sqrt(2.0))))
+NF("silu", lambda x: x / (1 + np.exp(-x)))
+NF("swish", lambda x: x / (1 + np.exp(-x)))
+NF("mish", lambda x: x * np.tanh(np.log1p(np.exp(x))))
+NF("softplus", lambda x: np.log1p(np.exp(x)))
+NF("softsign", lambda x: x / (1 + np.abs(x)))
+NF("hardtanh", lambda x: np.clip(x, -1, 1), SH * 2)
+NF("hardsigmoid", lambda x: np.clip(x / 6 + 0.5, 0, 1), SH * 8)
+NF("hardswish", lambda x: x * np.clip(x + 3, 0, 6) / 6, SH * 8)
+NF("hardshrink", lambda x: np.where(np.abs(x) > 0.5, x, 0), SH * 2)
+NF("softshrink", lambda x: np.where(x > 0.5, x - 0.5,
+                                    np.where(x < -0.5, x + 0.5, 0)), SH * 2)
+NF("tanhshrink", lambda x: x - np.tanh(x))
+NF("thresholded_relu", lambda x: np.where(x > 1.0, x, 0), SH * 3)
+NF("log_sigmoid", lambda x: -np.log1p(np.exp(-x)))
+NF("sigmoid", lambda x: 1 / (1 + np.exp(-x)))
+NF("tanh", np.tanh, fn=F.tanh)
+NF("softmax", lambda x: np.exp(x - sps.logsumexp(x, -1, keepdims=True)), A)
+NF("log_softmax", lambda x: x - sps.logsumexp(x, -1, keepdims=True), A)
+case("glu", F.glu, lambda x: x[:, :2] / (1 + np.exp(-x[:, 2:])), A)
+case("prelu", F.prelu, lambda x, w: np.where(x > 0, x, w * x), SH, np.array([0.25]))
+case("temperature_scaled_softmax",
+     lambda x: F.temperature_scaled_softmax(x, temperature=2.0),
+     lambda x: np.exp(x / 2 - sps.logsumexp(x / 2, -1, keepdims=True)), A)
+
+# ---- nn layers / losses ----------------------------------------------------
+W45 = r.uniform(-0.5, 0.5, (4, 5))
+case("linear", F.linear, lambda x, w: x @ w, A, W45)
+case("linear_bias", lambda x, w, b: F.linear(x, w, b),
+     lambda x, w, b: x @ w + b, A, W45, r.randn(5))
+EMB_W = r.uniform(-0.5, 0.5, (6, 4))
+case("embedding", lambda i, w: F.embedding(i, w),
+     lambda i, w: w[i], np.array([1, 3, 5], np.int64), EMB_W)
+case("one_hot_f", lambda i: F.one_hot(i, 5), lambda i: np.eye(5)[i],
+     np.array([0, 2, 4], np.int64), grad=False)
+
+
+def _layer_norm_ref(x, w, b):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + 1e-5) * w + b
+
+
+case("layer_norm", lambda x, w, b: F.layer_norm(x, [4], weight=w, bias=b),
+     _layer_norm_ref, A, np.ones(4) * 1.1, np.zeros(4) + 0.1)
+case("rms_norm", lambda x, w: F.rms_norm(x, w),
+     lambda x, w: x / np.sqrt(np.mean(x * x, -1, keepdims=True) + 1e-6) * w,
+     A, np.ones(4) * 1.2, rtol=1e-4, atol=1e-4)
+
+
+def _group_norm_ref(x, w, b):
+    n, c, h = x.shape
+    g = 2
+    xg = x.reshape(n, g, c // g, h)
+    mu = xg.mean((2, 3), keepdims=True)
+    var = xg.var((2, 3), keepdims=True)
+    y = ((xg - mu) / np.sqrt(var + 1e-5)).reshape(n, c, h)
+    return y * w[None, :, None] + b[None, :, None]
+
+
+case("group_norm", lambda x, w, b: F.group_norm(x, 2, weight=w, bias=b),
+     _group_norm_ref, r.randn(2, 4, 3), np.ones(4), np.zeros(4))
+
+
+def _instance_norm_ref(x):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + 1e-5)
+
+
+case("instance_norm", lambda x: F.instance_norm(x),
+     _instance_norm_ref, r.randn(2, 3, 5))
+case("batch_norm_eval",
+     lambda x, m, v: F.batch_norm(x, m, v, training=False),
+     lambda x, m, v: (x - m[None, :, None]) / np.sqrt(v[None, :, None] + 1e-5),
+     r.randn(2, 3, 4), r.randn(3) * 0.1, POS[0, :3], wrt=(0,))
+case("normalize", lambda x: F.normalize(x, axis=-1),
+     lambda x: x / np.maximum(np.linalg.norm(x, axis=-1, keepdims=True), 1e-12), A)
+case("cosine_similarity", F.cosine_similarity,
+     lambda a, b: np.sum(a * b, -1) / (np.linalg.norm(a, axis=-1) *
+                                       np.linalg.norm(b, axis=-1)), A, B)
+case("mse_loss", F.mse_loss, lambda x, y: np.mean((x - y) ** 2), A, B)
+case("l1_loss", F.l1_loss, lambda x, y: np.mean(np.abs(x - y)), A, B)
+case("smooth_l1_loss", F.smooth_l1_loss,
+     lambda x, y: np.mean(np.where(np.abs(x - y) < 1,
+                                   0.5 * (x - y) ** 2, np.abs(x - y) - 0.5)),
+     A * 3, B, rtol=1e-4, atol=1e-4)
+case("kl_div", lambda p, q: F.kl_div(p, q, reduction="mean"),
+     lambda lp, t: np.mean(t * (np.log(t) - lp)),
+     np.log(POS / POS.sum()), POS / POS.sum(), wrt=(0,))
+LOGITS = r.randn(3, 5)
+LBL = np.array([1, 0, 4], np.int64)
+
+
+def _ce_ref(z, t):
+    ls = z - sps.logsumexp(z, -1, keepdims=True)
+    return -np.mean(ls[np.arange(len(t)), t])
+
+
+case("cross_entropy", F.cross_entropy, _ce_ref, LOGITS, LBL)
+case("softmax_with_cross_entropy",
+     lambda z, t: F.softmax_with_cross_entropy(z, t.unsqueeze(-1)),
+     lambda z, t: -(z - sps.logsumexp(z, -1, keepdims=True))[
+         np.arange(len(t)), t][:, None], LOGITS, LBL)
+case("nll_loss", F.nll_loss,
+     lambda lp, t: -np.mean(lp[np.arange(len(t)), t]),
+     np.log(sps.softmax(LOGITS, -1)), LBL)
+PROB = r.uniform(0.1, 0.9, (3, 4))
+TGT01 = (r.rand(3, 4) > 0.5).astype(np.float64)
+case("binary_cross_entropy", F.binary_cross_entropy,
+     lambda p, t: np.mean(-(t * np.log(p) + (1 - t) * np.log(1 - p))),
+     PROB, TGT01, wrt=(0,))
+case("binary_cross_entropy_with_logits", F.binary_cross_entropy_with_logits,
+     lambda z, t: np.mean(np.maximum(z, 0) - z * t + np.log1p(np.exp(-np.abs(z)))),
+     A * 2, TGT01, wrt=(0,))
+case("square_error_cost", F.square_error_cost,
+     lambda x, y: (x - y) ** 2, A, B)
+case("label_smooth", lambda x: F.label_smooth(x, epsilon=0.1),
+     lambda x: x * 0.9 + 0.1 / x.shape[-1], np.eye(4)[IDX])
+case("sigmoid_focal_loss",
+     lambda z, t: F.sigmoid_focal_loss(z, t, reduction="mean"),
+     None, A * 2, TGT01, grad=False)
+CASES[-1].ref = lambda z, t: np.mean(
+    -(t * np.log(1 / (1 + np.exp(-z))) * ((1 - 1 / (1 + np.exp(-z))) ** 2) * 0.25
+      + (1 - t) * np.log(1 - 1 / (1 + np.exp(-z))) * ((1 / (1 + np.exp(-z))) ** 2) * 0.75))
+case("hinge_embedding_loss", F.hinge_embedding_loss,
+     lambda x, y: np.mean(np.where(y == 1, x, np.maximum(0, 1.0 - x))),
+     POS, np.where(r.rand(3, 4) > 0.5, 1.0, -1.0), grad=False)
+case("margin_ranking_loss", F.margin_ranking_loss,
+     lambda a, b, y: np.mean(np.maximum(0, -y * (a - b))),
+     A, B, np.where(r.rand(3, 4) > 0.5, 1.0, -1.0), grad=False)
+case("cosine_embedding_loss",
+     lambda a, b, y: F.cosine_embedding_loss(a, b, y),
+     None, A, B, np.array([1.0, -1.0, 1.0]), grad=False)
+CASES[-1].ref = lambda a, b, y: np.mean(np.where(
+    y == 1,
+    1 - np.sum(a * b, -1) / (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)),
+    np.maximum(0, np.sum(a * b, -1) / (np.linalg.norm(a, axis=-1) *
+                                       np.linalg.norm(b, axis=-1)))))
+
+# ---- convs / pools ---------------------------------------------------------
+X14 = r.randn(1, 2, 6)          # [N, C, L]
+K13 = r.randn(3, 2, 3)          # [O, C, K]
+X24 = r.randn(1, 2, 5, 5)       # [N, C, H, W]
+K23 = r.randn(3, 2, 3, 3)
+
+
+def _conv1d_ref(x, k):
+    n, c, l = x.shape
+    o, _, kk = k.shape
+    out = np.zeros((n, o, l - kk + 1))
+    for i in range(l - kk + 1):
+        out[:, :, i] = np.einsum("nck,ock->no", x[:, :, i:i + kk], k)
+    return out
+
+
+def _conv2d_ref(x, k):
+    n, c, h, w = x.shape
+    o, _, kh, kw = k.shape
+    out = np.zeros((n, o, h - kh + 1, w - kw + 1))
+    for i in range(h - kh + 1):
+        for j in range(w - kw + 1):
+            out[:, :, i, j] = np.einsum("nchw,ochw->no",
+                                        x[:, :, i:i + kh, j:j + kw], k)
+    return out
+
+
+case("conv1d", lambda x, k: F.conv1d(x, k), _conv1d_ref, X14, K13,
+     rtol=1e-4, atol=1e-4)
+case("conv2d", lambda x, k: F.conv2d(x, k), _conv2d_ref, X24, K23,
+     rtol=1e-4, atol=1e-4)
+
+
+def _conv3d_ref(x, k):
+    n, c, d, h, w = x.shape
+    o, _, kd, kh, kw = k.shape
+    out = np.zeros((n, o, d - kd + 1, h - kh + 1, w - kw + 1))
+    for a in range(d - kd + 1):
+        for i in range(h - kh + 1):
+            for j in range(w - kw + 1):
+                out[:, :, a, i, j] = np.einsum(
+                    "ncdhw,ocdhw->no",
+                    x[:, :, a:a + kd, i:i + kh, j:j + kw], k)
+    return out
+
+
+case("conv3d", lambda x, k: F.conv3d(x, k), _conv3d_ref,
+     r.randn(1, 2, 4, 4, 4), r.randn(2, 2, 2, 2, 2), rtol=1e-4, atol=1e-4)
+
+
+def _maxpool2d_ref(x):
+    n, c, h, w = x.shape
+    out = np.zeros((n, c, h // 2, w // 2))
+    for i in range(h // 2):
+        for j in range(w // 2):
+            out[:, :, i, j] = x[:, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2].max((2, 3))
+    return out
+
+
+def _avgpool2d_ref(x):
+    n, c, h, w = x.shape
+    out = np.zeros((n, c, h // 2, w // 2))
+    for i in range(h // 2):
+        for j in range(w // 2):
+            out[:, :, i, j] = x[:, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2].mean((2, 3))
+    return out
+
+
+case("max_pool2d", lambda x: F.max_pool2d(x, 2, stride=2), _maxpool2d_ref,
+     r.randn(1, 2, 4, 4))
+case("avg_pool2d", lambda x: F.avg_pool2d(x, 2, stride=2), _avgpool2d_ref,
+     r.randn(1, 2, 4, 4))
+case("max_pool1d", lambda x: F.max_pool1d(x, 2, stride=2),
+     lambda x: x.reshape(1, 2, 3, 2).max(-1), r.randn(1, 2, 6))
+case("avg_pool1d", lambda x: F.avg_pool1d(x, 2, stride=2),
+     lambda x: x.reshape(1, 2, 3, 2).mean(-1), r.randn(1, 2, 6))
+case("adaptive_avg_pool2d", lambda x: F.adaptive_avg_pool2d(x, 1),
+     lambda x: x.mean((2, 3), keepdims=True), r.randn(1, 2, 4, 4))
+case("adaptive_max_pool2d", lambda x: F.adaptive_max_pool2d(x, 1),
+     lambda x: x.max((2, 3), keepdims=True), r.randn(1, 2, 4, 4))
+case("adaptive_avg_pool1d", lambda x: F.adaptive_avg_pool1d(x, 1),
+     lambda x: x.mean(-1, keepdims=True), r.randn(1, 2, 6))
+case("pad_nn", lambda x: F.pad(x, [1, 1]),
+     lambda x: np.pad(x, ((0, 0), (0, 0), (1, 1))), r.randn(1, 2, 4))
+case("cummax_idx", lambda x: paddle.cummax(x, axis=1)[1].cast("float32"),
+     lambda x: _cummax_idx_ref(x), SAFE, grad=False)
+
+
+def _cummax_idx_ref(x):
+    out = np.zeros_like(x)
+    for i in range(x.shape[0]):
+        best, bi = -np.inf, 0
+        for j in range(x.shape[1]):
+            if x[i, j] >= best:
+                best, bi = x[i, j], j
+            out[i, j] = bi
+    return out
+case("pixel_shuffle", lambda x: F.pixel_shuffle(x, 2),
+     lambda x: x.reshape(1, 1, 2, 2, 3, 3).transpose(0, 1, 4, 2, 5, 3)
+     .reshape(1, 1, 6, 6), r.randn(1, 4, 3, 3), grad=False)
+case("interpolate_nearest",
+     lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+     lambda x: x.repeat(2, 2).repeat(2, 3), r.randn(1, 2, 3, 3), grad=False)
+case("dropout_eval", lambda x: F.dropout(x, 0.5, training=False),
+     lambda x: x, A)
+case("sequence_mask", lambda x: F.sequence_mask(x, maxlen=5),
+     lambda x: (np.arange(5)[None, :] < x[:, None]),
+     np.array([2, 4, 1], np.int64), grad=False)
+
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("c", CASES, ids=[c.name for c in CASES])
+def test_forward_f32(c):
+    _run_forward(c, "float32")
+
+
+GRAD_CASES = [c for c in CASES if c.grad]
+
+
+@pytest.mark.parametrize("c", GRAD_CASES, ids=[c.name for c in GRAD_CASES])
+def test_grad_numeric(c):
+    _run_grad(c)
+
+
+BF16_SAMPLE = ["add", "matmul", "exp", "tanh", "softmax", "gelu", "layer_norm",
+               "mean", "linear", "sigmoid", "relu", "cross_entropy"]
+
+
+@pytest.mark.parametrize(
+    "c", [c for c in CASES if c.name in BF16_SAMPLE],
+    ids=[c.name for c in CASES if c.name in BF16_SAMPLE])
+def test_forward_bf16_tier(c):
+    _run_forward(c, "bfloat16")
+
+
+def test_coverage_count():
+    """SURVEY/VERDICT bar: >=150 distinct ops under numeric verification."""
+    distinct = {c.name.split("#")[0] for c in CASES}
+    assert len(distinct) >= 150, len(distinct)
+    assert len(GRAD_CASES) >= 90, len(GRAD_CASES)
+
+
+def test_harness_catches_wrong_vjp():
+    """Plant a custom_vjp with a wrong backward: the grad check must fail."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import apply_op
+
+    @jax.custom_vjp
+    def bad_tanh(x):
+        return jnp.tanh(x)
+
+    def fwd(x):
+        return jnp.tanh(x), x
+
+    def bwd(x, g):
+        return (g * (1.0 + jnp.tanh(x) ** 2),)  # wrong: sign flipped inside
+
+    bad_tanh.defvjp(fwd, bwd)
+    planted = OpCase("bad_tanh", lambda t: apply_op(bad_tanh, t, name="bad_tanh"),
+                     np.tanh, (A,))
+    with pytest.raises(AssertionError):
+        _run_grad(planted)
+
+
+def test_harness_catches_wrong_forward():
+    planted = OpCase("bad_exp", paddle.exp, lambda x: np.exp(x) + 0.01, (A,))
+    with pytest.raises(AssertionError):
+        _run_forward(planted)
