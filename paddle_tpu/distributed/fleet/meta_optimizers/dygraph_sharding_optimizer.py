@@ -46,8 +46,17 @@ class DygraphShardingOptimizer:
 
     def reduce_gradients(self, parameter_list, hcg):
         """reference :316 — grads reduce-scattered to owners. Under compiled
-        SPMD the reduce-scatter is emitted by XLA; eager is a no-op on the
-        global view."""
+        SPMD the reduce-scatter is emitted by XLA; eagerly, place each grad
+        sharded over the axis so per-device grad bytes shrink to 1/axis."""
+        from paddle_tpu.distributed.fleet.meta_parallel.sharding.group_sharded import (
+            pick_shard_axis,
+        )
+
+        axis = pick_shard_axis()
+        for p in parameter_list:
+            g = getattr(p, "grad", None)
+            if g is not None:
+                g._set_value(shard_array_over(g._value, axis))
 
     def state_dict(self):
         return self._inner_opt.state_dict()

@@ -28,6 +28,19 @@ __all__ = ["GroupShardedStage2", "GroupShardedStage3", "GroupShardedOptimizerSta
            "group_sharded_parallel", "shard_array_over"]
 
 
+def pick_shard_axis() -> str:
+    """The ZeRO axis: 'sharding' when the mesh has one, else 'dp'."""
+    return "sharding" if mesh_axis_size("sharding") > 1 else "dp"
+
+
+def _replicate(val, mesh):
+    """Best-effort replicated placement on the mesh (no-op on failure)."""
+    try:
+        return jax.device_put(val, NamedSharding(mesh, PartitionSpec()))
+    except (ValueError, RuntimeError):
+        return val
+
+
 def shard_array_over(val, axis_name: str, mesh=None, offload=False):
     """Place `val` sharded on dim-0 over `axis_name` (pad-free only when
     divisible; else keep replicated — correctness first). offload=True
@@ -60,7 +73,7 @@ class GroupShardedOptimizerStage2:
     def __init__(self, params, optim, group=None, offload=False, device="tpu",
                  dp_group=None, **kwargs):
         self._optim = optim
-        self._axis = "sharding" if mesh_axis_size("sharding") > 1 else "dp"
+        self._axis = pick_shard_axis()
         self._offload = offload
         # intercept state creation to shard (and optionally host-offload) it
         orig_init_state = optim._init_state
@@ -116,6 +129,15 @@ class _ShardedModelBase:
         self._layers = layer
         self._optim = optimizer
 
+    def _sync_buffers(self):
+        """Replicate non-parameter buffers across the group (the global-SPMD
+        view holds one logical copy; replicated placement IS the sync)."""
+        mesh = get_mesh()
+        if mesh is None or not hasattr(self._layers, "named_buffers"):
+            return
+        for _, b in self._layers.named_buffers():
+            b._set_value(_replicate(b._value, mesh))
+
     def __getattr__(self, name):
         return getattr(self.__dict__["_layers"], name)
 
@@ -145,13 +167,25 @@ class _ShardedModelBase:
 
 class GroupShardedStage2(_ShardedModelBase):
     """ZeRO-2: grads + optimizer state sharded (reference group_sharded_stage2.py:46).
-    Grad reduce-scatter is fused into the compiled step by GSPMD when the
-    optimizer state carries the sharding axis."""
+
+    Eager path: a grad hook on every trainable parameter places the incoming
+    gradient SHARDED over the sharding/dp axis the moment it materializes —
+    the eager analog of reduce-scatter-to-owner — so grad memory is
+    1/axis_size per device even outside the compiled step (where GSPMD does
+    the same via the state shardings)."""
 
     def __init__(self, layer, sharding_optimizer, group=None, sync_buffers=False,
                  buffer_max_size=2 ** 23, auto_refresh_trainable=True, device="tpu",
                  dp_group=None, **kwargs):
         super().__init__(layer, sharding_optimizer, group)
+        self._axis = pick_shard_axis()
+        self._buffer_max_size = buffer_max_size  # XLA fuses grad comms itself
+        self._hook_handles = [
+            p.register_hook(lambda g, _a=self._axis: shard_array_over(g, _a))
+            for p in layer.parameters() if not p.stop_gradient
+        ]
+        if sync_buffers:
+            self._sync_buffers()
 
     def to(self, *a, **k):
         return self
@@ -165,9 +199,32 @@ class GroupShardedStage3(_ShardedModelBase):
                  device="tpu", segment_size=2 ** 20, pretrain_sync_models=True,
                  offload=False, sync_comm=False, dp_group=None, **kwargs):
         super().__init__(layer, optimizer, group)
-        axis = "sharding" if mesh_axis_size("sharding") > 1 else "dp"
+        axis = pick_shard_axis()
         for p in layer.parameters():
             p._set_value(shard_array_over(p._value, axis))
+        if sync_buffers:
+            self._sync_buffers()
+
+    def _place_input(self, a):
+        """Inputs must join the params' mesh for eager ops to mix them.
+        Placement mutates the SAME Tensor (autograd linkage and
+        stop_gradient stay intact) and leaves inputs that already live on
+        this mesh — e.g. deliberately dp-sharded batches — untouched."""
+        mesh = get_mesh()
+        if mesh is None or not isinstance(a, Tensor):
+            return a
+        sh = getattr(a._value, "sharding", None)
+        if getattr(sh, "mesh", None) is not None and sh.mesh.shape == mesh.shape:
+            return a
+        a._set_value(_replicate(a._value, mesh))
+        return a
+
+    def __call__(self, *args, **kwargs):
+        args = tuple(self._place_input(a) for a in args)
+        kwargs = {k: self._place_input(v) for k, v in kwargs.items()}
+        return self._layers(*args, **kwargs)
+
+    forward = __call__
 
     def get_all_parameters(self, convert2cpu=False):
         """reference stage3 API: materialize full params."""

@@ -255,6 +255,161 @@ class _PrefetchIter:
         return item
 
 
+def _collate_np(batch):
+    """Worker-side collate to plain numpy (no jax in child processes; the
+    parent converts to Tensors). Mirrors default_collate_fn's structure."""
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return tuple(_collate_np([b[i] for b in batch]) for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: _collate_np([b[k] for b in batch]) for k in sample}
+    arr = np.stack([np.asarray(s) for s in batch])
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def _np_to_tensor_tree(x):
+    if isinstance(x, tuple):
+        return tuple(_np_to_tensor_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _np_to_tensor_tree(v) for k, v in x.items()}
+    if isinstance(x, np.ndarray):
+        return Tensor(jnp.asarray(x))
+    return x
+
+
+def _fork_workers_safe() -> bool:
+    """Forking is only safe before the XLA backend initializes or when the
+    backend is CPU-only: a forked child inheriting an initialized TPU client
+    can hang (same restriction as the reference's CUDA-tensor-in-worker
+    rule). Unsafe configs degrade to the thread prefetcher with a warning."""
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:
+            return True
+        import jax as _jax
+
+        return all(d.platform == "cpu" for d in _jax.devices())
+    except Exception:
+        return True
+
+
+def _worker_loop(dataset, index_q, result_q, collate, worker_init_fn, wid):
+    """Child process: fetch+transform+collate — the Python-heavy work that
+    would serialize on the parent's GIL (reference io/dataloader/worker.py)."""
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    while True:
+        item = index_q.get()
+        if item is None:
+            break
+        bid, idxs = item
+        try:
+            batch = collate([dataset[i] for i in idxs])
+            result_q.put((bid, batch, None))
+        except Exception:
+            import traceback
+
+            result_q.put((bid, None, traceback.format_exc()))
+
+
+class _MultiprocessIter:
+    """Process-worker iterator (reference reader.py:216 + worker.py): batch
+    index lists fan out to `num_workers` forked children; collated numpy
+    batches come back over a result queue and are yielded IN ORDER (out-of-
+    order arrivals buffered), converted to Tensors in the parent."""
+
+    def __init__(self, loader):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        self._collate_user = loader.collate_fn is not default_collate_fn
+        collate = loader.collate_fn if self._collate_user else _collate_np
+        self._index_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self._timeout = loader.timeout or None
+        self._workers = []
+        for wid in range(loader.num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self._index_q, self._result_q, collate,
+                      loader.worker_init_fn, wid),
+                daemon=True)
+            w.start()
+            self._workers.append(w)
+
+        self._batches = list(loader.batch_sampler)
+        self._next_dispatch = 0
+        self._next_yield = 0
+        self._pending = {}
+        self._inflight_max = loader.num_workers * loader.prefetch_factor
+        self._dispatch()
+
+    def _dispatch(self):
+        while (self._next_dispatch < len(self._batches)
+               and self._next_dispatch - self._next_yield < self._inflight_max):
+            self._index_q.put((self._next_dispatch, self._batches[self._next_dispatch]))
+            self._next_dispatch += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import queue as _q
+        import time as _time
+
+        if self._next_yield >= len(self._batches):
+            self._shutdown()
+            raise StopIteration
+        deadline = _time.time() + self._timeout if self._timeout else None
+        while self._next_yield not in self._pending:
+            try:
+                bid, batch, err = self._result_q.get(timeout=1.0)
+            except _q.Empty:
+                dead = [w for w in self._workers if not w.is_alive()]
+                if dead:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker died (exitcode "
+                        f"{dead[0].exitcode}) before returning a batch")
+                if deadline is not None and _time.time() > deadline:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self._timeout}s waiting "
+                        f"for batch {self._next_yield}")
+                continue
+            if err is not None:
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            self._pending[bid] = batch
+        batch = self._pending.pop(self._next_yield)
+        self._next_yield += 1
+        self._dispatch()
+        if self._collate_user:
+            return batch
+        return _np_to_tensor_tree(batch)
+
+    def _shutdown(self):
+        for _ in self._workers:
+            try:
+                self._index_q.put(None)
+            except Exception:
+                pass
+        for w in self._workers:
+            w.join(timeout=5)
+            if w.is_alive():
+                w.terminate()
+        self._workers = []
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
 class DataLoader:
     """reference: python/paddle/io/reader.py:216."""
 
@@ -267,6 +422,8 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
             self.batch_size = batch_size
@@ -294,6 +451,17 @@ class DataLoader:
             yield self.collate_fn([self.dataset[i] for i in idxs])
 
     def __iter__(self):
+        if self.num_workers > 0 and self.batch_sampler is not None:
+            # map-style + workers: true worker PROCESSES (fetch/transform/
+            # collate off the parent's GIL). Iterable datasets keep the
+            # thread prefetcher (stream order can't be index-dispatched).
+            if _fork_workers_safe():
+                return _MultiprocessIter(self)
+            import warnings
+
+            warnings.warn(
+                "num_workers > 0 with an initialized non-CPU XLA backend: "
+                "fork is unsafe, using the thread prefetcher instead")
         it = self._iter_batches()
         if self.num_workers > 0:
             return _PrefetchIter(it, self.num_workers * self.prefetch_factor)

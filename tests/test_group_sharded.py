@@ -1,0 +1,87 @@
+"""Eager ZeRO wrappers must MEASURABLY shard (VERDICT r2 #10): with
+group_sharded_parallel, per-device bytes of grads / optimizer state / params
+shrink to 1/axis without the user touching CompiledTrainStep.
+Reference: distributed/sharding/group_sharded.py group_sharded_parallel,
+fleet/meta_parallel/sharding/group_sharded_stage{2,3}.py."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+
+def _frac(arr):
+    """Fraction of the global array resident on one device."""
+    sh = arr.addressable_shards
+    return sh[0].data.size / arr.size
+
+
+def _mk():
+    from paddle_tpu.models import BertForMaskedLM, bert_tiny_config
+
+    paddle.seed(0)
+    model = BertForMaskedLM(bert_tiny_config())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 256, (8, 16)).astype(np.int64))
+    labels = paddle.to_tensor(rng.randint(0, 256, (8, 16)).astype(np.int64))
+    return model, opt, ids, labels
+
+
+class TestGroupSharded:
+    def test_os_g_shards_grads_and_state(self):
+        build_mesh({"dp": 8})
+        model, opt, ids, labels = _mk()
+        m2, o2, _ = group_sharded_parallel(model, opt, "os_g")
+        loss = m2(ids, labels)
+        loss.backward()
+
+        checked_grad = 0
+        for p in model.parameters():
+            g = p.grad
+            if (g is not None and g._value.ndim >= 1
+                    and g._value.shape[0] % 8 == 0 and g._value.size >= 64):
+                assert _frac(g._value) == 1 / 8, p.name if hasattr(p, "name") else ""
+                checked_grad += 1
+        assert checked_grad >= 3
+
+        o2.step()
+        state_map = o2._optim._state if hasattr(o2._optim, "_state") else {}
+        checked_state = 0
+        for st in state_map.values():
+            for v in st.values():
+                if hasattr(v, "addressable_shards") and v.ndim >= 1 \
+                        and v.shape and v.shape[0] % 8 == 0 and v.size >= 64:
+                    assert _frac(v) == 1 / 8
+                    checked_state += 1
+        assert checked_state >= 3
+        o2.clear_grad()
+        set_mesh(None)
+
+    def test_p_g_os_shards_params_and_trains(self):
+        build_mesh({"dp": 8})
+        model, opt, ids, labels = _mk()
+        m3, o3, _ = group_sharded_parallel(model, opt, "p_g_os")
+
+        checked = 0
+        for p in model.parameters():
+            if p._value.ndim >= 1 and p._value.shape[0] % 8 == 0 and p._value.size >= 64:
+                assert _frac(p._value) == 1 / 8
+                checked += 1
+        assert checked >= 3
+
+        losses = []
+        for _ in range(2):
+            loss = m3(ids, labels)
+            loss.backward()
+            o3.step()
+            o3.clear_grad()
+            losses.append(float(loss))
+        assert all(np.isfinite(l) for l in losses)
+
+        # gather-back API
+        m3.get_all_parameters()
+        for p in model.parameters():
+            if p._value.ndim >= 1:
+                assert _frac(p._value) == 1.0
+        set_mesh(None)

@@ -1,0 +1,83 @@
+"""Multiprocess DataLoader workers (VERDICT r2 #9): num_workers spawns
+worker PROCESSES that fetch/transform/collate off the parent's GIL.
+Reference: python/paddle/io/reader.py:216, io/dataloader/worker.py."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class GilHeavyDataset(Dataset):
+    """A deliberately slow per-item transform. The thread prefetcher runs the
+    whole batch stream on ONE thread, so per-item latency serializes; the
+    worker pool overlaps it across processes. (The CI sandbox is pinned to a
+    single CPU, so the latency is a sleep — on real multi-core hosts the same
+    mechanics offload GIL-bound CPU transforms.)"""
+
+    def __init__(self, n=32, delay=0.05):
+        self.n = n
+        self.delay = delay
+
+    def __getitem__(self, i):
+        time.sleep(self.delay)
+        return np.full((4,), float(i), np.float32), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+def _consume(loader):
+    out = []
+    for x, y in loader:
+        out.append(np.asarray(x._value)[:, 0])
+    return np.concatenate(out)
+
+
+class TestWorkers:
+    def test_scales_with_processes_and_preserves_order(self):
+        ds = GilHeavyDataset()
+        serial = DataLoader(ds, batch_size=4, num_workers=0, shuffle=False)
+        t0 = time.time()
+        got_serial = _consume(serial)
+        t_serial = time.time() - t0
+
+        par = DataLoader(ds, batch_size=4, num_workers=4, shuffle=False)
+        t0 = time.time()
+        got_par = _consume(par)
+        t_par = time.time() - t0
+
+        np.testing.assert_array_equal(got_par, got_serial)
+        np.testing.assert_array_equal(got_serial, np.arange(32, dtype=np.float32))
+        speedup = t_serial / t_par
+        assert speedup > 1.8, f"speedup {speedup:.2f} (serial {t_serial:.2f}s, 4w {t_par:.2f}s)"
+
+    def test_worker_error_propagates(self):
+        class Bad(Dataset):
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom at 5")
+                return np.zeros(2, np.float32)
+
+            def __len__(self):
+                return 8
+
+        loader = DataLoader(Bad(), batch_size=2, num_workers=2, shuffle=False)
+        with pytest.raises(RuntimeError, match="boom at 5"):
+            list(loader)
+
+    def test_worker_init_fn_runs_in_child(self, tmp_path):
+        marker = str(tmp_path / "w{}.txt")
+
+        def init(wid):
+            open(marker.format(wid), "w").write(str(wid))
+
+        ds = GilHeavyDataset(n=8, delay=0.001)
+        loader = DataLoader(ds, batch_size=2, num_workers=2, shuffle=False,
+                            worker_init_fn=init)
+        list(loader)
+        import os
+
+        assert os.path.exists(marker.format(0)) and os.path.exists(marker.format(1))
