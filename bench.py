@@ -1,22 +1,126 @@
 """Benchmark: LLaMA causal-LM training throughput on the local chip(s).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
 Baseline framing (BASELINE.md): the north star is LLaMA-2-7B at >=50% of
 H100+NCCL tokens/sec/device. A single v5e (16GB) chip can't hold 7B, so the
-bench trains the largest LLaMA that fits with full AdamW state (~440M,
-bf16 compute + fp32 master/m/v) and reports tokens/sec/chip; `vs_baseline` is
-model-FLOPs-utilization (MFU, against the 197 TFLOP/s v5e bf16 peak) divided
-by 0.20 — i.e. 1.0 == the efficiency a 7B H100 run at 40% MFU delivers when
-halved per the >=50% target. MFU is the hardware-portable proxy for "would
-match the reference's per-device rate at equal scale".
+bench trains the largest LLaMA that fits with full AdamW state (~645M,
+bf16 compute + fp32 master/m/v) at seq 2048 THROUGH THE PALLAS FLASH PATH
+(verified: the lowered program must contain tpu_custom_call) and reports
+tokens/sec/chip; `vs_baseline` is model-FLOPs-utilization (MFU, against the
+197 TFLOP/s v5e bf16 peak) divided by 0.20 — i.e. 1.0 == the efficiency a 7B
+H100 run at 40% MFU delivers when halved per the >=50% target. MFU is the
+hardware-portable proxy for "would match the reference's per-device rate at
+equal scale".
+
+detail.pipeline: compiled-1F1B schedule overhead measured on the virtual
+8-device CPU mesh — step time across microbatch counts must scale like the
+(M + S - 1) tick theory, so the recorded ratio vs theory exposes any
+schedule bubble beyond fill+drain.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+PIPELINE_PROBE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+import json, time
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.parallel.pipeline import PipelinedTrainStep
+
+S, D, V = 4, 384, 512
+
+
+class Emb(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.e = nn.Embedding(V, D)
+
+    def forward(self, ids):
+        return self.e(ids)
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(D, 4 * D)
+        self.fc2 = nn.Linear(4 * D, D)
+
+    def forward(self, x):
+        return x + self.fc2(paddle.tanh(self.fc1(x)))
+
+
+class Head(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.h = nn.Linear(D, V)
+
+    def forward(self, x):
+        return self.h(x)
+
+
+def loss_fn(logits, labels):
+    import paddle_tpu.nn.functional as F
+
+    return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))
+
+
+build_mesh({"pp": S})
+paddle.seed(0)
+times = {}
+for M in (4, 16):
+    blocks = [Block() for _ in range(S)]
+    step = PipelinedTrainStep(Emb(), blocks, Head(), loss_fn, optimizer=None,
+                              num_micro=M, remat=False)
+    mb = 8
+    ids = np.random.RandomState(0).randint(0, V, (M * mb, 32)).astype(np.int64)
+    step(ids, ids)  # compile
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        loss = step(ids, ids)
+        float(loss)
+        ts.append(time.perf_counter() - t0)
+    times[M] = min(ts)
+ratio = times[16] / times[4]
+theory = (16 + S - 1) / (4 + S - 1)
+print("PIPE_JSON " + json.dumps({
+    "S": S, "t_m4_ms": round(times[4] * 1e3, 2), "t_m16_ms": round(times[16] * 1e3, 2),
+    "tick_ratio_measured": round(ratio, 3), "tick_ratio_theory": round(theory, 3),
+    "overhead_vs_theory": round(ratio / theory - 1, 3),
+    "bubble_frac_m4": round((S - 1) / (4 + S - 1), 3)}))
+"""
+
+
+def _pipeline_overhead():
+    """Run the compiled-pipeline bubble probe on a virtual CPU mesh."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        res = subprocess.run([sys.executable, "-c", PIPELINE_PROBE],
+                             capture_output=True, text=True, timeout=240, env=env)
+        for line in res.stdout.splitlines():
+            if line.startswith("PIPE_JSON "):
+                return json.loads(line[len("PIPE_JSON "):])
+        print(f"pipeline probe produced no result; stderr tail:\n"
+              f"{res.stderr[-800:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"pipeline probe failed: {e!r}", file=sys.stderr)
+    return None
 
 
 def main():
@@ -30,11 +134,13 @@ def main():
     on_tpu = jax.devices()[0].platform != "cpu"
 
     if on_tpu:
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536, intermediate_size=4096,
-                          num_hidden_layers=12, num_attention_heads=12,
-                          num_key_value_heads=12, max_position_embeddings=2048,
+        # largest LLaMA fitting 16GB with full AdamW state at the best-MFU
+        # batch (bs4 x seq2048, swept in round 3): 645M params
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                          num_hidden_layers=10, num_attention_heads=16,
+                          num_key_value_heads=16, max_position_embeddings=2048,
                           use_parallel_cross_entropy=False)
-        batch, seq, iters = 8, 1024, 20
+        batch, seq, iters = 4, 2048, 20
     else:  # CPU smoke (CI)
         cfg = LlamaConfig(vocab_size=1024, hidden_size=128, intermediate_size=256,
                           num_hidden_layers=2, num_attention_heads=4,
@@ -69,6 +175,16 @@ def main():
 
     step._build()
     iv, lv = ids._value, labels._value
+
+    # prove the Pallas flash kernel is on the hot path: the lowered step
+    # program must contain a tpu_custom_call (cheap: no XLA compile needed)
+    flash_on_hot_path = False
+    if on_tpu:
+        lowered = jax.jit(step._step_fn).lower(
+            step._param_vals, step._opt_states, (iv, lv, lv),
+            jax.random.key(0), jnp.asarray(1e-4, jnp.float32),
+            jnp.asarray(1, jnp.int32))
+        flash_on_hot_path = "tpu_custom_call" in lowered.as_text()
 
     def run_n(n):
         def body(i, carry):
@@ -108,7 +224,6 @@ def main():
     eff_iters = big_n - small_n
     tokens_per_sec = batch * seq * eff_iters / dt
     loss = paddle.to_tensor(loss_val)
-    iters = eff_iters
 
     # MFU: 6 * n_params * tokens/sec / peak_flops (bf16)
     n_params = sum(p.size for p in model.parameters())
@@ -118,6 +233,8 @@ def main():
     mfu = tokens_per_sec * flops_per_token / (peak * max(ndev, 1))
     vs_baseline = mfu / 0.20  # 1.0 == 50%-of-H100@40%MFU efficiency bar
 
+    pipe = _pipeline_overhead()
+
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec / max(ndev, 1), 2),
@@ -125,9 +242,72 @@ def main():
         "vs_baseline": round(vs_baseline, 4),
         "detail": {"params": int(n_params), "mfu": round(mfu, 4), "batch": batch,
                    "seq": seq, "loss": float(loss), "devices": ndev,
-                   "platform": jax.devices()[0].platform},
+                   "platform": jax.devices()[0].platform,
+                   "flash_on_hot_path": flash_on_hot_path,
+                   "pipeline": pipe},
+    }))
+
+
+def main_full():
+    """--full: the largest-LLaMA-that-FITS demo — ZeRO optimizer-state
+    OFFLOAD to pinned host memory + rematerialization + flash, seq 2048.
+    The fp32 master/m/v (12 bytes/param) live in host RAM and stream through
+    HBM per step, so params are bounded by bf16 weights + activations only:
+    ~1.6B on one 16GB v5e vs ~650M without offload. Throughput is NOT the
+    point here (the state transfer dominates); fitting is."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import CompiledTrainStep
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2560, intermediate_size=6912,
+                      num_hidden_layers=18, num_attention_heads=20,
+                      num_key_value_heads=20, max_position_embeddings=2048,
+                      use_parallel_cross_entropy=False)
+    batch, seq = 1, 2048
+    build_mesh({"dp": 1})
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.train()
+
+    class _Wrap:
+        def parameters(self):
+            return model.parameters()
+
+        def __call__(self, ids, labels):
+            return model(ids, labels)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                                 multi_precision=True)
+    step = CompiledTrainStep(_Wrap(), lambda out, lab: out, optimizer=opt,
+                             offload_optimizer=True, remat=True)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    n_params = sum(p.size for p in model.parameters())
+    t0 = time.perf_counter()
+    l0 = float(step(ids, ids, ids))
+    t_compile = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    l1 = float(step(ids, ids, ids))
+    t_step = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "llama_offload_largest_fit",
+        "value": int(n_params),
+        "unit": "params",
+        "detail": {"params": int(n_params), "batch": batch, "seq": seq,
+                   "offload_optimizer": bool(step._offload), "remat": True,
+                   "step_s": round(t_step, 2), "compile_s": round(t_compile, 1),
+                   "tokens_per_sec": round(batch * seq / t_step, 1),
+                   "losses": [l0, l1]},
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--full" in sys.argv:
+        main_full()
+    else:
+        main()

@@ -180,12 +180,25 @@ class GroupShardedStage2(_ShardedModelBase):
         super().__init__(layer, sharding_optimizer, group)
         self._axis = pick_shard_axis()
         self._buffer_max_size = buffer_max_size  # XLA fuses grad comms itself
-        self._hook_handles = [
-            p.register_hook(lambda g, _a=self._axis: shard_array_over(g, _a))
-            for p in layer.parameters() if not p.stop_gradient
-        ]
+        self._hook_handles = []
+        for p in layer.parameters():
+            if p.stop_gradient or getattr(p, "_zero2_grad_hook", False):
+                continue  # re-wrapping must not stack duplicate hooks
+            self._hook_handles.append(p.register_hook(
+                lambda g, _a=self._axis: shard_array_over(g, _a)))
+            p._zero2_grad_hook = True
+        self._hooked_params = [p for p in layer.parameters()
+                               if getattr(p, "_zero2_grad_hook", False)]
         if sync_buffers:
             self._sync_buffers()
+
+    def remove_hooks(self):
+        """Detach the grad-sharding hooks (restores the unwrapped model)."""
+        for h in self._hook_handles:
+            h.remove()
+        self._hook_handles = []
+        for p in self._hooked_params:
+            p._zero2_grad_hook = False
 
     def to(self, *a, **k):
         return self
@@ -247,10 +260,14 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     group_sharded_parallel — assemble model/optimizer/scaler by level 'os'|'os_g'|'p_g_os'."""
     if level in ("os", "os_g"):
         opt = GroupShardedOptimizerStage2(model.parameters(), optimizer, group, offload=offload)
-        mdl = GroupShardedStage2(model, opt, group) if level == "os_g" else model
+        mdl = (GroupShardedStage2(model, opt, group, sync_buffers=sync_buffers,
+                                  buffer_max_size=buffer_max_size, dp_group=dp_group)
+               if level == "os_g" else model)
         return mdl, opt, scaler
     if level == "p_g_os":
         opt = GroupShardedOptimizerStage2(model.parameters(), optimizer, group, offload=offload)
-        mdl = GroupShardedStage3(model, opt, group, offload=offload)
+        mdl = GroupShardedStage3(model, opt, group, sync_buffers=sync_buffers,
+                                 segment_size=segment_size, offload=offload,
+                                 sync_comm=sync_comm, dp_group=dp_group)
         return mdl, opt, scaler
     raise ValueError(f"unknown group_sharded level {level}")

@@ -293,7 +293,7 @@ def _fork_workers_safe() -> bool:
 
         return all(d.platform == "cpu" for d in _jax.devices())
     except Exception:
-        return True
+        return False  # fail closed: introspection failure -> thread prefetcher
 
 
 def _worker_loop(dataset, index_q, result_q, collate, worker_init_fn, wid):
