@@ -22,7 +22,8 @@ from paddle_tpu.optimizer import lr as lr_mod
 from paddle_tpu.optimizer.lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
-           "Adadelta", "RMSProp", "Lamb", "lr"]
+           "Adadelta", "RMSProp", "Lamb", "Lars", "ASGD", "NAdam", "RAdam",
+           "Rprop", "LBFGS", "lr"]
 
 lr = lr_mod
 
@@ -390,3 +391,354 @@ class Lamb(Optimizer):
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return (p32 - lr * trust * r).astype(pv.dtype), {"m": m, "v": v}
+
+
+class Lars(Momentum):
+    """LARS momentum: layer-wise trust-ratio scaled learning rate
+    (reference: paddle lars_momentum op, incubate LarsMomentumOptimizer)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, epsilon=1e-8,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip, name, multi_precision)
+
+    def _update(self, pv, gv, state, lr, step):
+        p32 = pv.astype(jnp.float32)
+        g32 = gv.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self._lars_coeff * p_norm / (g_norm + self._lars_wd * p_norm + self._eps),
+            1.0)
+        upd = g32 + self._lars_wd * p32
+        v = self._momentum * state["velocity"].astype(jnp.float32) + lr * local_lr * upd
+        return (p32 - v).astype(pv.dtype), {"velocity": v}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: python/paddle/optimizer/asgd.py): plain SGD
+    steps plus a running average of the iterates; `averaged_value(p)` exposes
+    the Polyak average for evaluation."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, t0=0, name=None,
+                 multi_precision=False):
+        self._t0 = t0
+        self._batch_num = max(1, int(batch_num))
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _init_state(self, p):
+        # fresh buffer: astype of an f32 param would ALIAS it, and the jitted
+        # update donates both the param and the state
+        n = self._batch_num
+        return {"ax": jnp.array(p._value, jnp.float32, copy=True),
+                "d": jnp.zeros(p._value.shape, jnp.float32),
+                "ys": jnp.zeros((n,) + tuple(p._value.shape), jnp.float32)}
+
+    def _update(self, pv, gv, state, lr, step):
+        g32 = gv.astype(jnp.float32)
+        p32 = pv.astype(jnp.float32)
+        if self._weight_decay:
+            g32 = g32 + self._weight_decay * p32
+        # reference asgd op: update with the average of the last batch_num
+        # grads (circular window d = d - oldest + g)
+        n = self._batch_num
+        pos = (step.astype(jnp.int32) - 1) % n
+        old = jax.lax.dynamic_index_in_dim(state["ys"], pos, 0, keepdims=False)
+        d = state["d"] - old + g32
+        ys = jax.lax.dynamic_update_index_in_dim(state["ys"], g32, pos, 0)
+        denom = jnp.minimum(step.astype(jnp.float32), float(n))
+        new_p = p32 - lr * d / denom
+        t = step.astype(jnp.float32)
+        mu = 1.0 / jnp.maximum(1.0, t - self._t0)
+        ax = state["ax"] + mu * (new_p - state["ax"])
+        return new_p.astype(pv.dtype), {"ax": ax, "d": d, "ys": ys}
+
+    def averaged_value(self, p):
+        """Polyak-averaged iterate — a COPY (the live state buffer is donated
+        to the next step's jitted update)."""
+        st = self._state.get(id(p))
+        return Tensor(jnp.array(st["ax"], copy=True)) if st else p
+
+
+class NAdam(Optimizer):
+    """Nesterov-momentum Adam (reference: python/paddle/optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._value, jnp.float32),
+                "v": jnp.zeros_like(p._value, jnp.float32),
+                "mu_prod": jnp.ones((), jnp.float32)}
+
+    def _update(self, pv, gv, state, lr, step):
+        g32 = gv.astype(jnp.float32)
+        p32 = pv.astype(jnp.float32)
+        if self._weight_decay:
+            g32 = g32 + self._weight_decay * p32
+        t = step.astype(jnp.float32)
+        mu_t = self._b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = state["mu_prod"] * mu_t
+        m = self._b1 * state["m"] + (1 - self._b1) * g32
+        v = self._b2 * state["v"] + (1 - self._b2) * jnp.square(g32)
+        mhat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                + (1 - mu_t) * g32 / (1 - mu_prod))
+        vhat = v / (1 - self._b2 ** t)
+        new_p = p32 - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return new_p.astype(pv.dtype), {"m": m, "v": v, "mu_prod": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: python/paddle/optimizer/radam.py): variance
+    rectification switches between adaptive and plain momentum updates."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._value, jnp.float32),
+                "v": jnp.zeros_like(p._value, jnp.float32)}
+
+    def _update(self, pv, gv, state, lr, step):
+        g32 = gv.astype(jnp.float32)
+        p32 = pv.astype(jnp.float32)
+        if self._weight_decay:
+            g32 = g32 + self._weight_decay * p32
+        t = step.astype(jnp.float32)
+        m = self._b1 * state["m"] + (1 - self._b1) * g32
+        v = self._b2 * state["v"] + (1 - self._b2) * jnp.square(g32)
+        mhat = m / (1 - self._b1 ** t)
+        rho_inf = 2.0 / (1 - self._b2) - 1.0
+        b2t = self._b2 ** t
+        rho_t = rho_inf - 2.0 * t * b2t / (1 - b2t)
+        r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+        r_den = (rho_inf - 4) * (rho_inf - 2) * jnp.maximum(rho_t, self._eps)
+        r_t = jnp.sqrt(jnp.maximum(r_num / r_den, 0.0))
+        vhat = jnp.sqrt(v / (1 - b2t)) + self._eps
+        adaptive = r_t * mhat / vhat
+        new_p = jnp.where(rho_t > 5.0, p32 - lr * adaptive, p32 - lr * mhat)
+        return new_p.astype(pv.dtype), {"m": m, "v": v}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: python/paddle/optimizer/rprop.py):
+    per-weight step sizes grown on sign agreement, shrunk on disagreement
+    (full-batch regime)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None, name=None,
+                 multi_precision=False):
+        self._eta_minus, self._eta_plus = etas
+        self._lr_min, self._lr_max = learning_rate_range
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+
+    def _init_state(self, p):
+        return {"prev_grad": jnp.zeros_like(p._value, jnp.float32),
+                "step_size": jnp.full(p._value.shape, float(self.get_lr()),
+                                      jnp.float32)}
+
+    def _update(self, pv, gv, state, lr, step):
+        g32 = gv.astype(jnp.float32)
+        p32 = pv.astype(jnp.float32)
+        sign = jnp.sign(g32 * state["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_plus,
+                           jnp.where(sign < 0, self._eta_minus, 1.0))
+        step_size = jnp.clip(state["step_size"] * factor, self._lr_min, self._lr_max)
+        # on sign flip: revert-style zeroed gradient (iRprop-)
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        new_p = p32 - step_size * jnp.sign(g_eff)
+        return new_p.astype(pv.dtype), {"prev_grad": g_eff, "step_size": step_size}
+
+
+class LBFGS(Optimizer):
+    """L-BFGS quasi-Newton optimizer (reference: python/paddle/optimizer/
+    lbfgs.py). `step(closure)` re-evaluates loss+grads up to `max_iter` times
+    per call, maintaining a `history_size` window of (s, y) pairs and the
+    two-loop-recursion direction; optional backtracking line search."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None else int(max_iter * 1.25)
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._hist_size = history_size
+        self._line_search = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._rho_hist: list = []
+        self._prev_flat = None
+        self._prev_grad = None
+        self._n_eval = 0
+
+    # flat-vector helpers over the whole parameter list
+    def _flat_params(self):
+        return jnp.concatenate([p._value.reshape(-1).astype(jnp.float32)
+                                for p in self._params])
+
+    def _flat_grads(self):
+        params_grads = [(p, p.grad) for p in self._params]
+        if self._grad_clip is not None:
+            clipped = dict((id(p), g) for p, g in self._grad_clip(
+                [(p, g) for p, g in params_grads if g is not None]))
+            params_grads = [(p, clipped.get(id(p), g)) for p, g in params_grads]
+        out = []
+        for p, g in params_grads:
+            gv = g._value if g is not None else jnp.zeros_like(p._value)
+            out.append(gv.reshape(-1).astype(jnp.float32))
+        return jnp.concatenate(out)
+
+    def _assign_flat(self, flat):
+        ofs = 0
+        for p in self._params:
+            n = p._value.size
+            p._set_value(flat[ofs:ofs + n].reshape(p._value.shape).astype(p._value.dtype))
+            ofs += n
+
+    def _direction(self, g):
+        """Two-loop recursion: H·g with implicit inverse-Hessian history."""
+        q = g
+        alphas = []
+        for s, y, rho in zip(reversed(self._s_hist), reversed(self._y_hist),
+                             reversed(self._rho_hist)):
+            a = rho * jnp.dot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if self._y_hist:
+            y_last, s_last = self._y_hist[-1], self._s_hist[-1]
+            gamma = jnp.dot(s_last, y_last) / jnp.maximum(jnp.dot(y_last, y_last), 1e-10)
+            q = gamma * q
+        for (s, y, rho), a in zip(zip(self._s_hist, self._y_hist, self._rho_hist),
+                                  reversed(alphas)):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    def step(self, closure=None):
+        self._step_count += 1
+        if closure is None:
+            # grads already populated by a prior backward: one qN update
+            return self._one_iteration(None)
+        loss = None
+        self._n_eval = 0
+        for _ in range(self._max_iter):
+            loss = self._one_iteration(closure)
+            if loss is None or self._n_eval >= self._max_eval:
+                break
+            g = self._flat_grads()
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+        return loss
+
+    def _eval_closure(self, closure):
+        self._n_eval += 1
+        self.clear_grad()
+        loss = closure()
+        if hasattr(loss, "backward") and all(
+                p.grad is None for p in self._params):
+            loss.backward()
+        return loss
+
+    def _one_iteration(self, closure):
+        if closure is not None:
+            loss = self._eval_closure(closure)
+        else:
+            loss = None
+        x = self._flat_params()
+        g = self._flat_grads()
+        if self._weight_decay:
+            g = g + self._weight_decay * x
+        if self._prev_flat is not None:
+            s = x - self._prev_flat
+            y = g - self._prev_grad
+            sy = float(jnp.dot(s, y))
+            if sy > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                self._rho_hist.append(1.0 / sy)
+                if len(self._s_hist) > self._hist_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+                    self._rho_hist.pop(0)
+        d = self._direction(g)
+        lr = self.get_lr()
+        if not self._s_hist:  # first step: conservative scaled descent
+            lr = min(1.0, 1.0 / max(float(jnp.sum(jnp.abs(g))), 1e-10)) * lr
+        if self._line_search == "strong_wolfe" and closure is not None:
+            lr = self._backtrack(closure, x, g, d, lr)
+        self._prev_flat = x
+        self._prev_grad = g
+        self._assign_flat(x + lr * d)
+        if float(jnp.max(jnp.abs(lr * d))) <= self._tol_change:
+            return None
+        return loss
+
+    def state_dict(self):
+        out = super().state_dict()
+        out["lbfgs"] = {
+            "s": [np.asarray(s) for s in self._s_hist],
+            "y": [np.asarray(y) for y in self._y_hist],
+            "rho": list(self._rho_hist),
+            "prev_flat": None if self._prev_flat is None else np.asarray(self._prev_flat),
+            "prev_grad": None if self._prev_grad is None else np.asarray(self._prev_grad),
+        }
+        return out
+
+    def set_state_dict(self, state):
+        super().set_state_dict(state)
+        lb = state.get("lbfgs")
+        if lb:
+            self._s_hist = [jnp.asarray(s) for s in lb["s"]]
+            self._y_hist = [jnp.asarray(y) for y in lb["y"]]
+            self._rho_hist = list(lb["rho"])
+            self._prev_flat = (None if lb["prev_flat"] is None
+                               else jnp.asarray(lb["prev_flat"]))
+            self._prev_grad = (None if lb["prev_grad"] is None
+                               else jnp.asarray(lb["prev_grad"]))
+
+    def _backtrack(self, closure, x, g, d, lr, c1=1e-4, shrink=0.5, tries=10):
+        """Armijo backtracking (stand-in for the reference's strong-wolfe).
+        The closure runs normally (it does its own backward); only the loss
+        value is consumed here, and params are restored afterwards. With
+        weight_decay, the wd penalty 0.5*wd*||x||^2 is added to the observed
+        losses so the sufficient-decrease test matches the wd-augmented
+        gradient used for `g` and `d`."""
+        def f_at(flat):
+            self._assign_flat(flat)
+            val = float(self._eval_closure(closure))
+            if self._weight_decay:
+                val += 0.5 * self._weight_decay * float(jnp.dot(flat, flat))
+            return val
+
+        gtd = float(jnp.dot(g, d))
+        f0 = f_at(x)
+        for _ in range(tries):
+            if self._n_eval >= self._max_eval:
+                break
+            f1 = f_at(x + lr * d)
+            if f1 <= f0 + c1 * lr * gtd:
+                break
+            lr *= shrink
+        self._assign_flat(x)
+        return lr

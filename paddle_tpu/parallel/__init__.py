@@ -5,3 +5,4 @@ distributed passes stack (SURVEY §3.5, §2.3): parallelism is expressed as
 shardings on ONE compiled XLA program instead of per-rank programs + NCCL.
 """
 from paddle_tpu.parallel.train_step import CompiledTrainStep, functional_call  # noqa: F401
+from paddle_tpu.parallel import pipeline_schedules  # noqa: F401

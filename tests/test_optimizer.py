@@ -196,3 +196,96 @@ class TestAmp:
         m = nn.Linear(2, 2)
         m2 = paddle.amp.decorate(m, level="O2")
         assert m2.weight.dtype == paddle.bfloat16
+
+
+class TestOptimizerTail:
+    """Round-3 additions (reference python/paddle/optimizer: lbfgs.py,
+    asgd.py, nadam.py, radam.py, rprop.py, lars momentum op)."""
+
+    @pytest.mark.parametrize("cls,kw,steps,atol", [
+        ("NAdam", dict(learning_rate=0.1), 200, 5e-2),
+        ("RAdam", dict(learning_rate=0.1), 200, 5e-2),
+        ("ASGD", dict(learning_rate=0.1), 200, 5e-2),
+        ("Rprop", dict(learning_rate=0.01), 200, 5e-2),
+        # LARS takes ||p||-normalized steps: it hovers near the optimum on a
+        # toy quadratic (it exists for large-batch conv nets), so looser bar
+        ("Lars", dict(learning_rate=0.1, lars_coeff=1.0,
+                      lars_weight_decay=0.0), 400, 0.15),
+    ])
+    def test_tail_converges(self, cls, kw, steps, atol):
+        opt_cls = getattr(paddle.optimizer, cls)
+        w = quad_problem(opt_cls, steps=steps, **kw)
+        np.testing.assert_allclose(w, [1.0, 2.0], atol=atol)
+
+    def test_asgd_average_tracks(self):
+        w = nn.Parameter(paddle.to_tensor(np.array([5.0, -3.0], np.float32))._value)
+        opt = paddle.optimizer.ASGD(learning_rate=0.1, parameters=[w])
+        for _ in range(100):
+            loss = ((w - paddle.to_tensor(np.array([1.0, 2.0], np.float32))) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        avg = opt.averaged_value(w).numpy()
+        np.testing.assert_allclose(avg, [1.0, 2.0], atol=0.2)
+
+    def test_lbfgs_quadratic_fast(self):
+        """LBFGS with closure should crush a quadratic in a few steps."""
+        paddle.seed(0)
+        w = nn.Parameter(paddle.to_tensor(np.array([5.0, -3.0], np.float32))._value)
+        opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=10,
+                                     parameters=[w])
+        target = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+
+        def closure():
+            opt.clear_grad()
+            loss = ((w - target) ** 2).sum()
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            opt.step(closure)
+        np.testing.assert_allclose(w.numpy(), [1.0, 2.0], atol=1e-3)
+
+    def test_lbfgs_beats_sgd_on_rosenbrock(self):
+        def rosen_problem(opt_cls, outer, **kw):
+            paddle.seed(0)
+            w = nn.Parameter(paddle.to_tensor(np.array([-1.2, 1.0], np.float32))._value)
+            opt = opt_cls(parameters=[w], **kw)
+
+            def loss_fn():
+                a = w[1] - w[0] ** 2
+                b = 1.0 - w[0]
+                return 100.0 * a * a + b * b
+
+            if opt_cls is paddle.optimizer.LBFGS:
+                def closure():
+                    opt.clear_grad()
+                    loss = loss_fn()
+                    loss.backward()
+                    return loss
+                for _ in range(outer):
+                    opt.step(closure)
+            else:
+                for _ in range(outer):
+                    loss = loss_fn()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+            return float(loss_fn())
+
+        f_lbfgs = rosen_problem(paddle.optimizer.LBFGS, 20, learning_rate=0.5,
+                                max_iter=10, line_search_fn="strong_wolfe")
+        f_sgd = rosen_problem(paddle.optimizer.SGD, 200, learning_rate=1e-3)
+        assert f_lbfgs < f_sgd * 0.5, (f_lbfgs, f_sgd)
+
+    def test_rprop_step_size_adapts(self):
+        w = nn.Parameter(paddle.to_tensor(np.array([5.0], np.float32))._value)
+        opt = paddle.optimizer.Rprop(learning_rate=0.1, parameters=[w])
+        for _ in range(3):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        st = opt._state[id(w)]
+        # same-sign grads grow the per-weight step
+        assert float(st["step_size"][0]) > 0.1
