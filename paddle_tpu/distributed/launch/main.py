@@ -30,6 +30,8 @@ def _parse_args(argv):
     p.add_argument("--rank", type=int, default=int(os.getenv("PADDLE_NODE_RANK", "0")))
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--rdzv_timeout", type=float, default=300.0,
+                   help="seconds to wait for all nodes at the master")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -45,6 +47,28 @@ def _free_port() -> int:
     return port
 
 
+def _rendezvous(args):
+    """Multi-node master rendezvous (reference launch/controllers/master.py):
+    the node-0 LAUNCHER hosts the job's TCPStore for its whole lifetime
+    (trainer rank 0 then degrades to a store client); every node registers
+    its hostname and blocks until all --nnodes are present, and the shared
+    store doubles as the cross-node abort channel for the watcher."""
+    import socket
+
+    from paddle_tpu.distributed.store import TCPStore
+
+    host, port = args.master.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(args.rank == 0),
+                     world_size=args.nnodes, timeout=args.rdzv_timeout)
+    pre = f"launch/{args.job_id}"
+    store.set(f"{pre}/node/{args.rank}", socket.gethostname().encode())
+    peers = []
+    for r in range(args.nnodes):
+        peers.append(store.wait(f"{pre}/node/{r}").decode())
+    print(f"rendezvous complete: {args.nnodes} nodes {peers}", file=sys.stderr)
+    return store, pre, peers
+
+
 def launch(argv=None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     os.makedirs(args.log_dir, exist_ok=True)
@@ -55,7 +79,13 @@ def launch(argv=None):
     # single-node multi-process: auto-assign rendezvous ports (TCPStore on
     # PADDLE_MASTER; jax.distributed coordination service on PADDLE_COORDINATOR)
     coordinator = os.getenv("PADDLE_COORDINATOR", "")
-    if world > 1 and args.nnodes == 1:
+    rdzv_store, rdzv_pre, peers = None, None, None
+    if args.nnodes > 1:
+        if not args.master:
+            print("--master host:port is required when --nnodes > 1", file=sys.stderr)
+            return 2
+        rdzv_store, rdzv_pre, peers = _rendezvous(args)
+    elif world > 1:
         # ports may only be auto-picked when a single launcher spawns every
         # rank; multi-node launchers must agree, so they derive the
         # coordinator deterministically from --master (port+1) in
@@ -85,12 +115,33 @@ def launch(argv=None):
             env["PADDLE_MASTER"] = args.master
         if coordinator:
             env["PADDLE_COORDINATOR"] = coordinator
+        if peers is not None:
+            # one endpoint PER TRAINER (host from its node; deterministic
+            # port labels derived from the master port — trainers don't run
+            # listening services in the SPMD design, the identity matters)
+            mport = int(args.master.rsplit(":", 1)[1])
+            eps = [f"{peers[r // nproc]}:{mport + 10 + r}" for r in range(world)]
+            env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(eps)
+            env["PADDLE_NODE_RANK"] = str(args.rank)
         log = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
         cmd = [sys.executable, args.training_script] + args.training_script_args
         procs.append((subprocess.Popen(cmd, env=env, stdout=log, stderr=subprocess.STDOUT), log, rank))
 
-    # watcher loop (reference launch/controllers/watcher.py): any failure kills the group
+    # watcher loop (reference launch/controllers/watcher.py): any failure
+    # kills the local group AND — multi-node — broadcasts the abort through
+    # the rendezvous store so every node's launcher tears down too
     exit_code = 0
+
+    def _abort_group(code):
+        for q, _, _ in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        if rdzv_store is not None:
+            try:
+                rdzv_store.set(f"{rdzv_pre}/abort", str(code).encode())
+            except Exception:
+                pass
+
     try:
         while procs:
             alive = []
@@ -102,12 +153,24 @@ def launch(argv=None):
                     print(f"rank {rank} failed with exit code {ret}; terminating group",
                           file=sys.stderr)
                     exit_code = ret
-                    for q, _, _ in procs:
-                        if q.poll() is None:
-                            q.send_signal(signal.SIGTERM)
+                    _abort_group(ret)
                     alive = []
                     break
             procs = alive
+            if procs and rdzv_store is not None:
+                try:
+                    remote = rdzv_store.get(f"{rdzv_pre}/abort")
+                except Exception:
+                    # the node-0 store died: the job is over one way or the
+                    # other — tear down rather than crash with a traceback
+                    remote = b"1"
+                if remote:
+                    exit_code = int(remote.decode() or 1)
+                    print(f"remote node aborted (exit {exit_code}); terminating",
+                          file=sys.stderr)
+                    _abort_group(exit_code)
+                    procs = []
+                    break
             if procs:
                 time.sleep(1)
     finally:
@@ -115,6 +178,31 @@ def launch(argv=None):
             if p.poll() is None:
                 p.terminate()
             log.close()
+    if rdzv_store is not None:
+        try:
+            if exit_code != 0:
+                # node 0 hosts the store: give the other nodes a grace window
+                # to observe the abort key before the server dies with us
+                if args.rank == 0:
+                    time.sleep(min(10.0, args.rdzv_timeout))
+            else:
+                # keep the store alive until every node reports done, or the
+                # whole job's store dies under the stragglers
+                rdzv_store.add(f"{rdzv_pre}/done", 1)
+                if args.rank == 0:
+                    deadline = time.time() + args.rdzv_timeout
+                    while time.time() < deadline:
+                        if rdzv_store.add(f"{rdzv_pre}/done", 0) >= args.nnodes:
+                            break
+                        remote = rdzv_store.get(f"{rdzv_pre}/abort")
+                        if remote:
+                            # a straggler failed after our clean finish: the
+                            # JOB failed — report it, don't mask it
+                            exit_code = int(remote.decode() or 1)
+                            break
+                        time.sleep(0.5)
+        except Exception:
+            pass
     return exit_code
 
 

@@ -30,7 +30,11 @@ class _PyStoreServer:
         self.cv = threading.Condition()
         self.sock = socket.socket()
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind(("0.0.0.0", port))
+        try:
+            self.sock.bind(("0.0.0.0", port))
+        except OSError:
+            self.sock.close()  # don't leak the fd on a failed bind
+            raise
         self.port = self.sock.getsockname()[1]
         self.sock.listen(64)
         self._running = True
@@ -119,11 +123,19 @@ class TCPStore:
                 self._server = self._native.pt_store_server_start(port)
                 if self._server:
                     port = self._native.pt_store_server_port(self._server)
-                else:
-                    self._native = None
             if self._server is None:
-                self._srv_py = _PyStoreServer(port)
-                port = self._srv_py.port
+                try:
+                    self._srv_py = _PyStoreServer(port)
+                    port = self._srv_py.port
+                    self._native = None  # py server => py client wire pairing
+                except OSError as e:
+                    import errno
+
+                    if e.errno != errno.EADDRINUSE:
+                        raise  # EACCES/EADDRNOTAVAIL etc are real errors
+                    # port already hosted (e.g. the multi-node launcher runs
+                    # the server for the whole job): degrade to client-only
+                    self._srv_py = None
         self.host = host
         self.port = port
         if self._native is not None:

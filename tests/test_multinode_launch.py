@@ -1,0 +1,95 @@
+"""Multi-node launch rendezvous + cross-node watcher (VERDICT r2 missing #6).
+Two launcher invocations on one host simulate two nodes sharing a --master:
+they rendezvous at the node-0 launcher's TCPStore, the trainers span both
+"nodes" via jax.distributed, and a failure on one node tears the other down.
+Reference: python/paddle/distributed/launch/controllers/master.py, watcher.py."""
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_node(rank, master, nnodes, script, log_dir, job_id):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", str(nnodes), "--rank", str(rank), "--master", master,
+         "--nproc_per_node", "1", "--log_dir", log_dir,
+         "--job_id", job_id, "--rdzv_timeout", "90", script],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+class TestMultiNodeLaunch:
+    def test_two_node_rendezvous_and_training(self, tmp_path):
+        master = f"127.0.0.1:{_free_port()}"
+        script = os.path.join(REPO, "tests", "workers", "mp_worker.py")
+        logs = [str(tmp_path / "n0"), str(tmp_path / "n1")]
+        p0 = _launch_node(0, master, 2, script, logs[0], "job_rdzv")
+        time.sleep(0.5)
+        p1 = _launch_node(1, master, 2, script, logs[1], "job_rdzv")
+        out0, _ = p0.communicate(timeout=240)
+        out1, _ = p1.communicate(timeout=240)
+        assert p0.returncode == 0, out0[-2000:]
+        assert p1.returncode == 0, out1[-2000:]
+        assert "rendezvous complete: 2 nodes" in out0
+        ok0 = open(os.path.join(logs[0], "workerlog.0")).read()
+        ok1 = open(os.path.join(logs[1], "workerlog.1")).read()
+        assert "MP_WORKER_OK" in ok0 and "MP_WORKER_OK" in ok1
+
+    def test_remote_failure_tears_down_group(self, tmp_path):
+        """Node 1's worker exits nonzero; node 0's launcher must notice via
+        the abort channel and terminate with nonzero exit."""
+        fail_script = str(tmp_path / "failer.py")
+        open(fail_script, "w").write(
+            "import os, sys, time\n"
+            "if int(os.environ.get('PADDLE_TRAINER_ID', '0')) == 1:\n"
+            "    sys.exit(7)\n"
+            "time.sleep(60)\n")
+        master = f"127.0.0.1:{_free_port()}"
+        logs = [str(tmp_path / "n0"), str(tmp_path / "n1")]
+        p0 = _launch_node(0, master, 2, fail_script, logs[0], "job_fail")
+        time.sleep(0.5)
+        p1 = _launch_node(1, master, 2, fail_script, logs[1], "job_fail")
+        out1, _ = p1.communicate(timeout=120)
+        assert p1.returncode == 7, out1[-2000:]
+        out0, _ = p0.communicate(timeout=120)
+        assert p0.returncode != 0, out0[-2000:]
+        assert "remote node aborted" in out0
+
+    def test_master_node_failure_tears_down_remote(self, tmp_path):
+        """The store-HOSTING node's worker fails: the remote launcher must
+        still tear down (via the abort key during node 0's grace window, or
+        the store's death) instead of hanging or crashing."""
+        fail_script = str(tmp_path / "failer0.py")
+        open(fail_script, "w").write(
+            "import os, sys, time\n"
+            "if int(os.environ.get('PADDLE_TRAINER_ID', '0')) == 0:\n"
+            "    time.sleep(2)\n"
+            "    sys.exit(5)\n"
+            "time.sleep(60)\n")
+        master = f"127.0.0.1:{_free_port()}"
+        logs = [str(tmp_path / "n0"), str(tmp_path / "n1")]
+        p0 = _launch_node(0, master, 2, fail_script, logs[0], "job_mfail")
+        time.sleep(0.5)
+        p1 = _launch_node(1, master, 2, fail_script, logs[1], "job_mfail")
+        out0, _ = p0.communicate(timeout=120)
+        assert p0.returncode == 5, out0[-2000:]
+        out1, _ = p1.communicate(timeout=120)
+        assert p1.returncode != 0, out1[-2000:]
+        assert "remote node aborted" in out1
