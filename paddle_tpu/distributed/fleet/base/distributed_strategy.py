@@ -36,9 +36,13 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.sharding = False
-        self.sharding_configs = {"stage": 1, "degree": 1}
+        self.sharding_configs = {"stage": 1, "degree": 1, "offload": False,
+                                 "comm_overlap": True}
         self.pipeline = False
-        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1,
+                                 "compile": True, "schedule_mode": "1F1B",
+                                 "p2p_cache_shape": True,
+                                 "enable_partial_send_recv": True}
         self.tensor_parallel = False
         self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
         self.heter_ccl_mode = False
@@ -51,6 +55,31 @@ class DistributedStrategy:
     @property
     def hybrid_configs_dict(self):
         return dict(self.hybrid_configs)
+
+    # -- serialization (reference strategy proto save/load parity) -----------
+    def to_dict(self) -> dict:
+        out = {}
+        for k, v in self.__dict__.items():
+            out[k] = dict(v) if isinstance(v, dict) else v
+        return out
+
+    def from_dict(self, d: dict):
+        for k, v in d.items():
+            setattr(self, k, v)
+        return self
+
+    def save_to_prototxt(self, path):
+        """reference save_to_prototxt: persisted as JSON (no proto dep)."""
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, default=str)
+
+    def load_from_prototxt(self, path):
+        import json
+
+        with open(path) as f:
+            return self.from_dict(json.load(f))
 
     def __setattr__(self, k, v):
         if k == "hybrid_configs" and isinstance(v, dict) and not isinstance(v, _HybridConfig):

@@ -186,21 +186,20 @@ def launch(argv=None):
                 if args.rank == 0:
                     time.sleep(min(10.0, args.rdzv_timeout))
             else:
-                # keep the store alive until every node reports done, or the
-                # whole job's store dies under the stragglers
+                # every node drains until all report done (rank 0 must also
+                # keep the store it hosts alive for the stragglers); a
+                # straggler failing after our clean finish means the JOB
+                # failed — report it, don't mask it
                 rdzv_store.add(f"{rdzv_pre}/done", 1)
-                if args.rank == 0:
-                    deadline = time.time() + args.rdzv_timeout
-                    while time.time() < deadline:
-                        if rdzv_store.add(f"{rdzv_pre}/done", 0) >= args.nnodes:
-                            break
-                        remote = rdzv_store.get(f"{rdzv_pre}/abort")
-                        if remote:
-                            # a straggler failed after our clean finish: the
-                            # JOB failed — report it, don't mask it
-                            exit_code = int(remote.decode() or 1)
-                            break
-                        time.sleep(0.5)
+                deadline = time.time() + args.rdzv_timeout
+                while time.time() < deadline:
+                    if rdzv_store.add(f"{rdzv_pre}/done", 0) >= args.nnodes:
+                        break
+                    remote = rdzv_store.get(f"{rdzv_pre}/abort")
+                    if remote:
+                        exit_code = int(remote.decode() or 1)
+                        break
+                    time.sleep(0.5)
         except Exception:
             pass
     return exit_code

@@ -14,7 +14,9 @@ import paddle_tpu.nn as nn
 from paddle_tpu.core.tensor import Tensor, apply_op
 from paddle_tpu.nn.layer.layers import Layer
 
-__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "FakeQuantLayer",
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
+           "MovingAverageAbsmaxObserver", "HistObserver",
+           "AbsmaxChannelWiseObserver", "FakeQuantLayer", "QuantedLinear",
            "quanted_linear"]
 
 
@@ -49,16 +51,116 @@ class AbsmaxObserver:
         return self.absmax / (2 ** (self.quant_bits - 1) - 1) or 1e-8
 
 
+class MovingAverageAbsmaxObserver:
+    """EMA absmax (reference: observers/ema.py /
+    fake_quantize_moving_average_abs_max)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        self.quant_bits = quant_bits
+        self.rate = moving_rate
+        self.absmax = None
+
+    def observe(self, x: Tensor):
+        cur = float(jnp.abs(x._value).max())
+        self.absmax = cur if self.absmax is None else (
+            self.rate * self.absmax + (1 - self.rate) * cur)
+
+    def scale(self) -> float:
+        return (self.absmax or 0.0) / (2 ** (self.quant_bits - 1) - 1) or 1e-8
+
+
+class HistObserver:
+    """Percentile-of-histogram calibration (reference: observers/hist.py):
+    clip scale at the `percent` mass point instead of the raw absmax —
+    robust to activation outliers in PTQ."""
+
+    def __init__(self, quant_bits=8, bins_count=2048, percent=0.999):
+        self.quant_bits = quant_bits
+        self.bins = bins_count
+        self.percent = percent
+        self._hist = np.zeros(bins_count)
+        self._max = 1e-8
+
+    def observe(self, x: Tensor):
+        a = np.abs(np.asarray(x._value, np.float32)).ravel()
+        m = float(a.max()) if a.size else 0.0
+        if m > self._max:
+            # rescale existing mass into the new range
+            old_edges = np.linspace(0, self._max, self.bins + 1)
+            new_edges = np.linspace(0, m, self.bins + 1)
+            centers = (old_edges[:-1] + old_edges[1:]) / 2
+            moved, _ = np.histogram(centers, new_edges, weights=self._hist)
+            self._hist = moved
+            self._max = m
+        h, _ = np.histogram(a, np.linspace(0, self._max, self.bins + 1))
+        self._hist += h
+
+    def scale(self) -> float:
+        total = self._hist.sum()
+        if total == 0:
+            return 1e-8
+        cdf = np.cumsum(self._hist) / total
+        cut = int(np.searchsorted(cdf, self.percent))
+        clip = (cut + 1) / self.bins * self._max
+        return clip / (2 ** (self.quant_bits - 1) - 1) or 1e-8
+
+
+class AbsmaxChannelWiseObserver:
+    """Per-output-channel weight absmax (reference:
+    observers/abs_max_weight.py channel_wise quanter)."""
+
+    def __init__(self, quant_bits=8, quant_axis=-1):
+        self.quant_bits = quant_bits
+        self.axis = quant_axis
+        self._absmax = None
+
+    def observe(self, x: Tensor):
+        v = jnp.abs(x._value)
+        axes = tuple(i for i in range(v.ndim) if i != self.axis % v.ndim)
+        cur = jnp.max(v, axis=axes)
+        self._absmax = cur if self._absmax is None else jnp.maximum(self._absmax, cur)
+
+    def scale(self):
+        denom = 2 ** (self.quant_bits - 1) - 1
+        return jnp.maximum(self._absmax / denom, 1e-8)
+
+
 class QuantConfig:
-    """reference: quantization/config.py."""
+    """reference: quantization/config.py — global observer defaults with
+    per-layer and per-type overrides."""
 
     def __init__(self, activation=None, weight=None):
         self.activation = activation or AbsmaxObserver
         self.weight = weight or AbsmaxObserver
         self._types = (nn.Linear, nn.Conv2D)
+        self._layer_overrides: dict[int, tuple] = {}
+        self._type_overrides: dict[type, tuple] = {}
 
     def add_layer_config(self, layers, activation=None, weight=None):
-        pass
+        """Override observers for specific layer INSTANCES (reference
+        config.py add_layer_config)."""
+        if not isinstance(layers, (list, tuple)):
+            layers = [layers]
+        for l in layers:
+            self._layer_overrides[id(l)] = (activation or self.activation,
+                                            weight or self.weight)
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for t in layer_types:
+            self._type_overrides[t] = (activation or self.activation,
+                                       weight or self.weight)
+            if t not in self._types:
+                self._types = self._types + (t,)
+
+    def observers_for(self, layer):
+        if id(layer) in self._layer_overrides:
+            return self._layer_overrides[id(layer)]
+        for t, pair in self._type_overrides.items():
+            if isinstance(layer, t):
+                return pair
+        return (self.activation, self.weight)
 
     def quantable(self, layer):
         return isinstance(layer, self._types)
@@ -68,8 +170,9 @@ class FakeQuantLayer(Layer):
     def __init__(self, inner, config: QuantConfig):
         super().__init__()
         self.inner = inner
-        self.w_observer = config.weight()
-        self.a_observer = config.activation()
+        act_cls, w_cls = config.observers_for(inner)
+        self.w_observer = w_cls()
+        self.a_observer = act_cls()
         self.w_observer.observe(inner.weight)
 
     def forward(self, x):
@@ -108,9 +211,39 @@ class QAT:
         return model
 
 
+class QuantedLinear(Layer):
+    """Deploy-form linear: int8 weights + folded scale (reference
+    nn/quant/qat/linear QuantedLinear / onnx-format conversion)."""
+
+    def __init__(self, weight_i8, w_scale, bias=None):
+        super().__init__()
+        self.register_buffer("weight_quant", Tensor(weight_i8))
+        self._w_scale = w_scale
+        self._bias = bias
+
+    def forward(self, x):
+        return quanted_linear(x, self.weight_quant, self._w_scale, self._bias)
+
+
+def _convert(model):
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, FakeQuantLayer) and isinstance(sub.inner, nn.Linear):
+            scale = sub.w_observer.scale()
+            w = sub.inner.weight._value
+            sv = scale if np.ndim(scale) == 0 else jnp.asarray(scale)
+            q = jnp.clip(jnp.round(w / sv), -127, 127).astype(jnp.int8)
+            model._sub_layers[name] = QuantedLinear(
+                q, sv, getattr(sub.inner, "bias", None))
+        elif isinstance(sub, FakeQuantLayer):
+            model._sub_layers[name] = sub.inner  # conv stays fake-quant-free
+        else:
+            _convert(sub)
+    return model
+
+
 class PTQ:
-    """reference: quantization/ptq.py — observe calibration batches, then fold
-    scales."""
+    """reference: quantization/ptq.py — observe calibration batches, then
+    `convert` folds scales into int8 deploy weights."""
 
     def __init__(self, config: QuantConfig | None = None):
         self.config = config or QuantConfig()
@@ -119,7 +252,11 @@ class PTQ:
         return _swap(model, self.config)
 
     def convert(self, model, inplace=False):
-        return model
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return _convert(model)
 
 
 def quanted_linear(x, weight, w_scale, bias=None):

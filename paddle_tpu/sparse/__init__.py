@@ -116,3 +116,134 @@ def relu(x: SparseCooTensor) -> SparseCooTensor:
     from paddle_tpu.nn.functional import relu as dense_relu
 
     return SparseCooTensor(x.indices, dense_relu(x.values), x.shape)
+
+
+class SparseCsrTensor:
+    """CSR layout (reference: phi/core/sparse_csr_tensor.h): crows [nrows+1],
+    cols [nnz], values [nnz]. Kept as dense index arrays for static shapes."""
+
+    def __init__(self, crows: Tensor, cols: Tensor, values: Tensor, shape):
+        self.crows = crows
+        self.cols = cols
+        self.values = values
+        self.shape = list(shape)
+
+    @property
+    def nnz(self):
+        return self.values.shape[0]
+
+    def to_coo(self) -> SparseCooTensor:
+        return sparse_csr_tensor(self.crows, self.cols, self.values, self.shape)
+
+    def to_dense(self) -> Tensor:
+        return self.to_coo().to_dense()
+
+    def crows_tensor(self):
+        return self.crows
+
+    def cols_tensor(self):
+        return self.cols
+
+    def values_tensor(self):
+        return self.values
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+def to_sparse_csr(x) -> SparseCsrTensor:
+    """Dense or COO -> CSR (reference Tensor.to_sparse_csr)."""
+    from paddle_tpu.core.tensor import to_tensor
+
+    if isinstance(x, SparseCooTensor):
+        idx = np.asarray(x.indices._value)
+        order = np.lexsort((idx[1], idx[0]))
+        rows, cols = idx[0][order], idx[1][order]
+        vals_np = np.asarray(x.values._value)[order]
+        shape = x.shape
+    else:
+        arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+        rows, cols = np.nonzero(arr)
+        vals_np = arr[rows, cols]
+        shape = arr.shape
+    crows = np.zeros(shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return SparseCsrTensor(to_tensor(crows), to_tensor(cols.astype(np.int64)),
+                           to_tensor(vals_np), shape)
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    """Merge duplicate coordinates (sums values) — reference coalesce op."""
+    from paddle_tpu.core.tensor import to_tensor
+
+    idx = np.asarray(x.indices._value)
+    vals = np.asarray(x.values._value)
+    flat = np.ravel_multi_index(tuple(idx), tuple(x.shape[: idx.shape[0]]))
+    uniq, inv = np.unique(flat, return_inverse=True)
+    out_vals = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+    np.add.at(out_vals, inv, vals)
+    out_idx = np.stack(np.unravel_index(uniq, tuple(x.shape[: idx.shape[0]])))
+    return SparseCooTensor(to_tensor(out_idx.astype(np.int64)),
+                           to_tensor(out_vals), x.shape)
+
+
+def _values_op(fn_name, jnp_fn):
+    """Elementwise-on-values op working for COO and CSR (reference
+    python/paddle/sparse/unary.py — zero-preserving unary suite)."""
+
+    def op(x, *a, **k):
+        vals = apply_op(lambda v: jnp_fn(v, *a, **k), x.values, name=f"sparse_{fn_name}")
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x.crows, x.cols, vals, x.shape)
+        return SparseCooTensor(x.indices, vals, x.shape)
+
+    op.__name__ = fn_name
+    return op
+
+
+# suite restricted to ZERO-PRESERVING fns (f(0)=0), like the reference's
+# sparse/unary.py — cos etc. would be wrong at every implicit zero
+sin = _values_op("sin", jnp.sin)
+tan = _values_op("tan", jnp.tan)
+asin = _values_op("asin", jnp.arcsin)
+atan = _values_op("atan", jnp.arctan)
+sinh = _values_op("sinh", jnp.sinh)
+tanh = _values_op("tanh", jnp.tanh)
+asinh = _values_op("asinh", jnp.arcsinh)
+atanh = _values_op("atanh", jnp.arctanh)
+sqrt = _values_op("sqrt", jnp.sqrt)
+square = _values_op("square", jnp.square)
+log1p = _values_op("log1p", jnp.log1p)
+abs = _values_op("abs", jnp.abs)  # noqa: A001
+expm1 = _values_op("expm1", jnp.expm1)
+neg = _values_op("neg", jnp.negative)
+pow = _values_op("pow", lambda v, e: jnp.power(v, e))  # noqa: A001
+scale = _values_op("scale", lambda v, s=1.0, bias=0.0, bias_after_scale=True:
+                   v * s + bias if bias_after_scale else (v + bias) * s)
+def _cast_values(v, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return v.astype(to_jax_dtype(dtype))
+
+
+cast = _values_op("cast", _cast_values)
+deg2rad = _values_op("deg2rad", jnp.deg2rad)
+rad2deg = _values_op("rad2deg", jnp.rad2deg)
+expand_like = None  # not in reference sparse surface
+del expand_like
+
+
+def transpose(x: SparseCooTensor, perm) -> SparseCooTensor:
+    def f(idx):
+        return idx[jnp.asarray(list(perm))]
+
+    new_idx = apply_op(f, x.indices, name="sparse_transpose")
+    new_shape = [x.shape[p] for p in perm]
+    return SparseCooTensor(new_idx, x.values, new_shape)
+
+
+__all__ += ["SparseCsrTensor", "to_sparse_csr", "coalesce", "transpose",
+            "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
+            "atanh", "sqrt", "square", "log1p", "abs", "expm1", "neg", "pow",
+            "scale", "cast", "deg2rad", "rad2deg"]

@@ -42,3 +42,8 @@ class _UniqueNameGenerator:
 
 
 unique_name = _UniqueNameGenerator()
+
+
+from paddle_tpu.utils.log_writer import LogReader, LogWriter, VisualDLCallback  # noqa: F401,E402
+
+__all__ += ["LogWriter", "LogReader", "VisualDLCallback"]

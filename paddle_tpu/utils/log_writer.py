@@ -1,0 +1,141 @@
+"""Scalar/histogram experiment logging — the VisualDL analog.
+
+Reference context: paddle ships VisualDL (`visualdl.LogWriter`) as its
+observability surface (SURVEY §5 metrics/logging). Zero-dependency
+TPU-native stand-in: an append-only JSONL event log per run directory with
+the same add_scalar/add_histogram/add_text writer API, a reader for
+programmatic analysis, and a hapi/Engine callback that streams training
+metrics into it. Files are plain JSONL — greppable, diffable, and loadable
+into any dashboard.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+__all__ = ["LogWriter", "LogReader", "VisualDLCallback"]
+
+
+class LogWriter:
+    """visualdl.LogWriter API over JSONL (one event per line)."""
+
+    def __init__(self, logdir="./runs", max_queue=100, flush_secs=10,
+                 file_name=""):
+        os.makedirs(logdir, exist_ok=True)
+        self.logdir = logdir
+        name = file_name or f"events.{int(time.time())}.jsonl"
+        self._path = os.path.join(logdir, name)
+        self._f = open(self._path, "a")
+        self._since_flush = 0
+        self._max_queue = max_queue
+
+    def _emit(self, record: dict):
+        record["wall_time"] = time.time()
+        self._f.write(json.dumps(record) + "\n")
+        self._since_flush += 1
+        if self._since_flush >= self._max_queue:
+            self.flush()
+
+    def add_scalar(self, tag: str, value, step: int = 0):
+        self._emit({"kind": "scalar", "tag": tag, "value": float(value),
+                    "step": int(step)})
+
+    def add_scalars(self, main_tag: str, tag_value_dict: dict, step: int = 0):
+        for k, v in tag_value_dict.items():
+            self.add_scalar(f"{main_tag}/{k}", v, step)
+
+    def add_histogram(self, tag: str, values, step: int = 0, buckets: int = 10):
+        arr = np.asarray(values, np.float64).ravel()
+        hist, edges = np.histogram(arr, bins=buckets)
+        self._emit({"kind": "histogram", "tag": tag, "step": int(step),
+                    "hist": hist.tolist(), "edges": edges.tolist(),
+                    "min": float(arr.min()) if arr.size else 0.0,
+                    "max": float(arr.max()) if arr.size else 0.0,
+                    "mean": float(arr.mean()) if arr.size else 0.0})
+
+    def add_text(self, tag: str, text: str, step: int = 0):
+        self._emit({"kind": "text", "tag": tag, "text": str(text),
+                    "step": int(step)})
+
+    def flush(self):
+        self._f.flush()
+        self._since_flush = 0
+
+    def close(self):
+        self.flush()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class LogReader:
+    """Read back a run directory's events for analysis/regression checks."""
+
+    def __init__(self, logdir):
+        self.logdir = logdir
+
+    def _events(self):
+        for name in sorted(os.listdir(self.logdir)):
+            if not name.endswith(".jsonl"):
+                continue
+            with open(os.path.join(self.logdir, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield json.loads(line)
+
+    def tags(self):
+        return sorted({e["tag"] for e in self._events()})
+
+    def scalars(self, tag: str):
+        """[(step, value)] for a scalar tag, step-ordered."""
+        out = [(e["step"], e["value"]) for e in self._events()
+               if e["kind"] == "scalar" and e["tag"] == tag]
+        return sorted(out)
+
+
+def _hapi_callback_base():
+    from paddle_tpu.hapi.model import Callback
+
+    return Callback
+
+
+class VisualDLCallback(_hapi_callback_base()):
+    """hapi callback streaming per-step loss + per-epoch metrics into a
+    LogWriter (the visualdl callback analog). Subclasses hapi Callback so
+    every hook (incl. eval) exists."""
+
+    def __init__(self, logdir="./runs", tag_prefix="train"):
+        self.writer = LogWriter(logdir)
+        self.prefix = tag_prefix
+        self._step = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        if "loss" in logs:
+            v = logs["loss"]
+            v = v[0] if isinstance(v, (list, tuple)) else v
+            self.writer.add_scalar(f"{self.prefix}/loss", float(v), self._step)
+        self._step += 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                vv = v[0] if isinstance(v, (list, tuple)) else v
+                self.writer.add_scalar(f"{self.prefix}/{k}", float(vv), epoch)
+            except (TypeError, ValueError):
+                pass
+        self.writer.flush()
+
+    def on_train_end(self, logs=None):
+        self.writer.close()

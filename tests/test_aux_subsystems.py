@@ -243,3 +243,127 @@ class TestTextAudio:
         x = paddle.to_tensor(np.random.randn(1, 4000).astype(np.float32))
         mel = features.MelSpectrogram(sr=8000, n_fft=256, n_mels=16)(x)
         assert mel.shape[1] == 16
+
+
+class TestSparseCsrAndUnary:
+    """Round-3 sparse widening: CSR layout + zero-preserving unary suite +
+    coalesce (reference python/paddle/sparse/unary.py, sparse_csr_tensor.h)."""
+
+    def test_csr_roundtrip_and_spmm(self):
+        import paddle_tpu.sparse as sparse
+
+        d = np.array([[0, 2.0, 0], [1.0, 0, 3.0]], np.float32)
+        csr = sparse.to_sparse_csr(paddle.to_tensor(d))
+        np.testing.assert_array_equal(np.asarray(csr.to_dense()._value), d)
+        assert csr.nnz == 3
+        coo = csr.to_coo()
+        b = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        out = sparse.matmul(coo, paddle.to_tensor(b))
+        np.testing.assert_allclose(np.asarray(out._value), d @ b, rtol=1e-5)
+
+    def test_unary_suite_zero_preserving(self):
+        import paddle_tpu.sparse as sparse
+
+        d = np.array([[0, 0.5, 0], [-0.25, 0, 1.0]], np.float32)
+        coo = sparse.to_sparse_coo(paddle.to_tensor(d))
+        np_names = {"asinh": "arcsinh", "neg": "negative"}
+        for name in ("sin", "tanh", "sqrt", "square", "abs", "neg", "expm1",
+                     "log1p", "asinh"):
+            fn = getattr(sparse, name)
+            ref = getattr(np, np_names.get(name, name))
+            arg = sparse.abs(coo) if name in ("sqrt", "log1p") else coo
+            got = np.asarray(fn(arg).to_dense()._value)
+            want_in = np.abs(d) if name in ("sqrt", "log1p") else d
+            want = np.where(want_in != 0, ref(want_in), 0.0)
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_coalesce_merges_duplicates(self):
+        import paddle_tpu.sparse as sparse
+
+        coo = sparse.sparse_coo_tensor([[0, 0, 1], [1, 1, 2]], [1.0, 2.0, 3.0],
+                                       shape=[2, 3])
+        merged = sparse.coalesce(coo)
+        assert merged.nnz == 2
+        want = np.zeros((2, 3), np.float32)
+        want[0, 1] = 3.0
+        want[1, 2] = 3.0
+        np.testing.assert_array_equal(np.asarray(merged.to_dense()._value), want)
+
+
+class TestQuantObservers:
+    """Round-3 quantization widening: observer zoo + per-layer config +
+    PTQ convert to int8 deploy weights (reference quantization/observers,
+    config.py, ptq.py)."""
+
+    def test_moving_average_and_hist_observers(self):
+        from paddle_tpu.quantization import HistObserver, MovingAverageAbsmaxObserver
+
+        ema = MovingAverageAbsmaxObserver(moving_rate=0.5)
+        ema.observe(paddle.to_tensor(np.array([1.0], np.float32)))
+        ema.observe(paddle.to_tensor(np.array([3.0], np.float32)))
+        assert abs(ema.absmax - 2.0) < 1e-6  # 0.5*1 + 0.5*3
+
+        rng = np.random.RandomState(0)
+        hist = HistObserver(percent=0.99)
+        data = rng.randn(10000).astype(np.float32)
+        data[0] = 100.0  # outlier the percentile must clip away
+        hist.observe(paddle.to_tensor(data))
+        absmax_scale = 100.0 / 127
+        assert hist.scale() < absmax_scale / 10
+
+    def test_channel_wise_observer(self):
+        from paddle_tpu.quantization import AbsmaxChannelWiseObserver
+
+        obs = AbsmaxChannelWiseObserver(quant_axis=-1)
+        w = np.array([[1.0, -8.0], [2.0, 4.0]], np.float32)
+        obs.observe(paddle.to_tensor(w))
+        s = np.asarray(obs.scale())
+        np.testing.assert_allclose(s, [2.0 / 127, 8.0 / 127], rtol=1e-5)
+
+    def test_ptq_convert_produces_int8_linear(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import PTQ, QuantConfig, QuantedLinear
+
+        paddle.seed(0)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        m = M()
+        ptq = PTQ(QuantConfig())
+        mq = ptq.quantize(m)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        ref = np.asarray(mq(x)._value)  # calibration pass
+        converted = ptq.convert(mq)
+        assert isinstance(converted._sub_layers["fc"], QuantedLinear)
+        wq = converted._sub_layers["fc"].weight_quant
+        assert str(wq._value.dtype) == "int8"
+        got = np.asarray(converted(x)._value)
+        np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.1)
+
+    def test_per_layer_config_override(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import (
+            MovingAverageAbsmaxObserver, QAT, QuantConfig,
+        )
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(4, 4)
+                self.b = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.b(self.a(x))
+
+        m = M()
+        cfg = QuantConfig()
+        cfg.add_layer_config([m.a], activation=MovingAverageAbsmaxObserver)
+        mq = QAT(cfg).quantize(m)
+        assert isinstance(mq._sub_layers["a"].a_observer, MovingAverageAbsmaxObserver)
+        assert not isinstance(mq._sub_layers["b"].a_observer, MovingAverageAbsmaxObserver)
