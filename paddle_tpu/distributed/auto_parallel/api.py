@@ -329,6 +329,7 @@ class DistModel:
     def _sync(self):
         if self._step is not None:
             self._step.sync_params_to_model()
+            self._step.sync_states_to_optimizer()
 
     def _place(self, t):
         """Replicate an input over the mesh so eager eval/predict ops can mix

@@ -104,7 +104,11 @@ class Engine:
             return data
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size or 1)
-        return data  # already an iterable of batches
+        if iter(data) is data:
+            # one-shot iterator: materialize so every epoch sees the batches
+            # (a silently-empty epoch 2 is worse than the memory)
+            return list(data)
+        return data  # re-iterable of batches
 
     @staticmethod
     def _split_batch(batch):

@@ -105,3 +105,61 @@ class AutoTuner:
         if best is None:
             raise RuntimeError("auto-tuner: every candidate failed")
         return best
+
+
+def compiled_trial_fn(model_fn, batch_fn, optimizer_fn, warmup=1, iters=3):
+    """A REAL trial runner (reference auto_tuner launches short training
+    jobs): builds the candidate's mesh, compiles the actual train step
+    (PipelinedTrainStep when pp > 1, CompiledTrainStep otherwise), times
+    `iters` steps, and restores the previous mesh.
+
+    model_fn() -> (model, loss_fn) for CompiledTrainStep, or
+                  (embed, blocks, head, loss_fn) for the pipelined path;
+    batch_fn(config) -> tuple of input arrays (last = labels);
+    optimizer_fn(params) -> optimizer.
+    """
+    import time as _time
+
+    from paddle_tpu.distributed.mesh import build_mesh, get_mesh, set_mesh
+
+    def run_trial(cfg: TunerConfig) -> float:
+        prev = get_mesh()
+        try:
+            axes = {k: v for k, v in cfg.as_axes().items()}
+            build_mesh(axes)
+            parts = model_fn()
+            batch = batch_fn(cfg)
+            if cfg.pp > 1:
+                from paddle_tpu.parallel.pipeline import PipelinedTrainStep
+
+                embed, blocks, head, loss_fn = parts
+                params = (embed.parameters() + [p for b in blocks
+                                                for p in b.parameters()]
+                          + head.parameters())
+                step = PipelinedTrainStep(
+                    embed, blocks, head, loss_fn,
+                    optimizer=optimizer_fn(params),
+                    num_micro=cfg.micro_batches, remat=False)
+                ids, labels = batch
+                for _ in range(warmup):
+                    float(step(ids, labels))
+                t0 = _time.perf_counter()
+                for _ in range(iters):
+                    float(step(ids, labels))
+                return (_time.perf_counter() - t0) / iters
+            from paddle_tpu.parallel.train_step import CompiledTrainStep
+
+            model, loss_fn = parts
+            step = CompiledTrainStep(model, loss_fn,
+                                     optimizer_fn(model.parameters()),
+                                     zero_axis="sharding" if cfg.sharding > 1 else None)
+            for _ in range(warmup):
+                float(step(*batch))
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                float(step(*batch))
+            return (_time.perf_counter() - t0) / iters
+        finally:
+            set_mesh(prev)
+
+    return run_trial

@@ -33,7 +33,8 @@ class ElasticStatus:
 class ElasticManager:
     def __init__(self, args=None, store: TCPStore | None = None, rank: int | None = None,
                  world_size: int | None = None, lease_ttl: float = 10.0,
-                 job_id: str | None = None):
+                 job_id: str | None = None, policy: str = "relaunch",
+                 on_scale=None):
         self.rank = rank if rank is not None else int(os.getenv("PADDLE_TRAINER_ID", "0"))
         self.world = world_size if world_size is not None else int(
             os.getenv("PADDLE_TRAINERS_NUM", "1"))
@@ -41,6 +42,12 @@ class ElasticManager:
         self.lease_ttl = lease_ttl
         self.store = store or TCPStore(is_master=(self.rank == 0))
         self.enable = True
+        # 'relaunch': membership change -> RESTART exit code (reference
+        # default); 'rebuild': shrink the expected world IN PLACE and rebuild
+        # the device mesh over the survivors, continuing without a restart
+        self.policy = policy
+        self.on_scale = on_scale  # callback(old_world, new_world)
+        self.members = list(range(self.world))  # surviving rank ids
         self._stop = threading.Event()
         self._heartbeat_thread = None
         self._status = ElasticStatus.HOLD
@@ -76,7 +83,9 @@ class ElasticManager:
 
         now = time.time()
         alive = []
-        for r in range(self.world):
+        # scan the surviving MEMBER ids, not range(world): after a rebuild
+        # shrink, ranks above the new world must stay visible
+        for r in self.members:
             v = self.store.get(self._key(r))
             if v is not None and len(v) == 8:
                 ts = struct.unpack("<d", v)[0]
@@ -87,13 +96,65 @@ class ElasticManager:
     def watch(self) -> str:
         """One watch tick (reference manager.py watch:120): returns an
         ElasticStatus; RESTART signals the launcher to relaunch with the new
-        world size (exit code ELASTIC_EXIT_CODE)."""
+        world size (exit code ELASTIC_EXIT_CODE). Under policy='rebuild' a
+        shrink instead rebuilds the mesh over survivors and HOLDs."""
         if self.store.get(f"/elastic/{self.job_id}/exit/{self.rank}") is not None:
             return ElasticStatus.COMPLETED
         alive = self.alive_ranks()
-        if len(alive) < self.world:
+        if len(alive) < len(self.members):
+            if self.policy == "rebuild":
+                import jax
+
+                try:
+                    multi = jax.process_count() > 1
+                except Exception:
+                    multi = False
+                if multi:
+                    # a mesh over survivors can't be rebuilt without
+                    # re-initializing the jax runtime across hosts: the
+                    # restart-free path is single-controller only
+                    import warnings
+
+                    warnings.warn("elastic policy='rebuild' requires a "
+                                  "single-process runtime; falling back to "
+                                  "relaunch")
+                    return ElasticStatus.RESTART
+                self.rebuild(alive)
+                return ElasticStatus.HOLD
             return ElasticStatus.RESTART
         return ElasticStatus.HOLD
+
+    def rebuild(self, alive=None):
+        """Shrink the expected world to the surviving member set and rebuild
+        the device mesh over it (the restart-free scale-down path;
+        scale-UP still needs a relaunch to attach new hosts). The data axis
+        shrinks; model/pipeline axes are preserved when they still divide."""
+        import jax
+
+        from paddle_tpu.distributed.mesh import build_mesh, get_mesh
+
+        alive = alive if alive is not None else self.alive_ranks()
+        old_world = self.world
+        self.members = list(alive)
+        self.world = max(1, len(alive))
+        mesh = get_mesh()
+        ndev = len(jax.local_devices())
+        if mesh is not None:
+            axes = {a: int(s) for a, s in mesh.shape.items()}
+            keep = {a: s for a, s in axes.items() if a != "dp" and s > 1}
+            prod = 1
+            for s in keep.values():
+                prod *= s
+            if ndev % max(prod, 1) == 0:
+                keep["dp"] = ndev // max(prod, 1)
+                build_mesh(keep)
+            else:
+                build_mesh({"dp": ndev})
+        else:
+            build_mesh({"dp": ndev})
+        if self.on_scale is not None:
+            self.on_scale(old_world, self.world)
+        return self.world
 
     def should_restart(self) -> bool:
         return self.watch() == ElasticStatus.RESTART

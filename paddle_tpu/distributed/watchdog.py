@@ -122,3 +122,54 @@ def watch_step(arrays, name: str = "train_step", timeout_s: float = 600.0,
 
     threading.Thread(target=waiter, daemon=True).start()
     return task
+
+
+def _dump_path():
+    import os
+
+    return os.path.join(os.getenv("PADDLE_LOG_DIR", "."),
+                        f"comm_task_dump_{os.getpid()}.json")
+
+
+def dump_state(manager: CommTaskManager | None = None) -> dict:
+    """Per-collective state dump (reference CommTaskManager async debug
+    report, comm_task_manager.h:37): every in-flight task with name/elapsed,
+    the last completed task, and recorded hangs. Written as JSON next to the
+    logs on hang so a dead job leaves a diagnosable artifact."""
+    import json
+
+    mgr = manager or _manager
+    with mgr._lock:
+        in_flight = [
+            {"id": t.task_id, "name": t.name, "elapsed_s": round(t.elapsed(), 2),
+             "timeout_s": t.timeout_s, "done": t.done.is_set()}
+            for t in mgr._tasks.values()
+        ]
+    state = {
+        "pid": __import__("os").getpid(),
+        "in_flight": in_flight,
+        "last_completed": ({"id": mgr.last_completed.task_id,
+                            "name": mgr.last_completed.name}
+                           if mgr.last_completed else None),
+        "hangs": [{"id": t.task_id, "name": t.name,
+                   "elapsed_s": round(t.elapsed(), 2)} for t in mgr.hangs],
+    }
+    try:
+        with open(_dump_path(), "w") as f:
+            json.dump(state, f, indent=2)
+    except OSError:
+        pass
+    return state
+
+
+def _on_hang_with_dump(task: CommTask):
+    CommTaskManager._default_on_hang(task)
+    state = dump_state()
+    import sys
+
+    print(f"[paddle_tpu watchdog] state dump ({len(state['in_flight'])} "
+          f"in-flight) written to {_dump_path()}", file=sys.stderr)
+
+
+_manager.on_hang = _on_hang_with_dump
+__all__ += ["dump_state"]

@@ -164,7 +164,10 @@ class CompiledTrainStep:
             self._state_shardings = []
             for p, pv, spec in zip(self._params, self._param_vals, self._param_specs):
                 p._set_value(pv)
-                st = optimizer._init_state(p)
+                # resume from existing optimizer state (a loaded checkpoint)
+                # instead of zeroing the moments
+                st = getattr(optimizer, "_state", {}).get(id(p)) or optimizer._init_state(p)
+                st = dict(st)
                 st_sh = {}
                 for k, v in st.items():
                     sp = _state_pspec(spec, v, zero_axis, self.mesh)
@@ -290,6 +293,15 @@ class CompiledTrainStep:
         (checkpointing / eval interop)."""
         for p, v in zip(self._params, self._param_vals):
             p._set_value(v)
+
+    def sync_states_to_optimizer(self):
+        """Write the in-program optimizer state back into optimizer._state so
+        optimizer.state_dict() reflects trained moments (checkpoint parity)."""
+        if self.optimizer is None or self._opt_states is None:
+            return
+        for p, st in zip(self._params, self._opt_states):
+            self.optimizer._state[id(p)] = dict(st)
+        self.optimizer._step_count = self._step_i
 
     @property
     def step_count(self):

@@ -213,16 +213,21 @@ class QAT:
 
 class QuantedLinear(Layer):
     """Deploy-form linear: int8 weights + folded scale (reference
-    nn/quant/qat/linear QuantedLinear / onnx-format conversion)."""
+    nn/quant/qat/linear QuantedLinear / onnx-format conversion). Scale and
+    bias are registered buffers so the converted model checkpoints whole."""
 
     def __init__(self, weight_i8, w_scale, bias=None):
         super().__init__()
         self.register_buffer("weight_quant", Tensor(weight_i8))
-        self._w_scale = w_scale
-        self._bias = bias
+        self.register_buffer("w_scale", Tensor(jnp.asarray(w_scale, jnp.float32)))
+        if bias is not None:
+            b = bias._value if isinstance(bias, Tensor) else jnp.asarray(bias)
+            self.register_buffer("bias", Tensor(b))
+        else:
+            self.bias = None
 
     def forward(self, x):
-        return quanted_linear(x, self.weight_quant, self._w_scale, self._bias)
+        return quanted_linear(x, self.weight_quant, self.w_scale._value, self.bias)
 
 
 def _convert(model):
@@ -235,7 +240,12 @@ def _convert(model):
             model._sub_layers[name] = QuantedLinear(
                 q, sv, getattr(sub.inner, "bias", None))
         elif isinstance(sub, FakeQuantLayer):
-            model._sub_layers[name] = sub.inner  # conv stays fake-quant-free
+            import warnings
+
+            warnings.warn(
+                f"PTQ.convert: no int8 deploy form for "
+                f"{type(sub.inner).__name__}; keeping the fake-quant wrapper "
+                f"(calibration preserved)")
         else:
             _convert(sub)
     return model

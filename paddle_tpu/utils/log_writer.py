@@ -31,12 +31,15 @@ class LogWriter:
         self._f = open(self._path, "a")
         self._since_flush = 0
         self._max_queue = max_queue
+        self._flush_secs = flush_secs
+        self._last_flush = time.time()
 
     def _emit(self, record: dict):
         record["wall_time"] = time.time()
         self._f.write(json.dumps(record) + "\n")
         self._since_flush += 1
-        if self._since_flush >= self._max_queue:
+        if (self._since_flush >= self._max_queue
+                or time.time() - self._last_flush >= self._flush_secs):
             self.flush()
 
     def add_scalar(self, tag: str, value, step: int = 0):
@@ -63,6 +66,7 @@ class LogWriter:
     def flush(self):
         self._f.flush()
         self._since_flush = 0
+        self._last_flush = time.time()
 
     def close(self):
         self.flush()

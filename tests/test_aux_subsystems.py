@@ -367,3 +367,106 @@ class TestQuantObservers:
         mq = QAT(cfg).quantize(m)
         assert isinstance(mq._sub_layers["a"].a_observer, MovingAverageAbsmaxObserver)
         assert not isinstance(mq._sub_layers["b"].a_observer, MovingAverageAbsmaxObserver)
+
+
+class TestElasticRebuild:
+    def test_rebuild_policy_shrinks_world_and_mesh(self):
+        """policy='rebuild': a lost member shrinks the expected world and
+        rebuilds the mesh over survivors without a restart."""
+        import struct
+        import time as _t
+
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+        from paddle_tpu.distributed.mesh import build_mesh, get_mesh, set_mesh
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore(is_master=True)
+        scales = []
+        mgr = ElasticManager(store=store, rank=0, world_size=3, lease_ttl=0.5,
+                             job_id="reb", policy="rebuild",
+                             on_scale=lambda o, n: scales.append((o, n)))
+        now = _t.time()
+        for r in range(3):
+            store.set(f"/elastic/reb/lease/{r}", struct.pack("<d", now))
+        build_mesh({"mp": 2, "dp": 4})
+        assert mgr.watch() == ElasticStatus.HOLD
+
+        # a MIDDLE rank's lease expires: survivor rank 2 must stay visible
+        store.set("/elastic/reb/lease/1", struct.pack("<d", now - 10))
+        assert mgr.watch() == ElasticStatus.HOLD  # rebuilt, not restarted
+        assert mgr.world == 2
+        assert mgr.members == [0, 2]
+        assert scales == [(3, 2)]
+        m = get_mesh()
+        assert int(m.shape["mp"]) == 2  # model axis preserved
+        # rank 2 keeps heartbeating: no further spurious shrink
+        store.set("/elastic/reb/lease/0", struct.pack("<d", _t.time()))
+        store.set("/elastic/reb/lease/2", struct.pack("<d", _t.time()))
+        assert mgr.watch() == ElasticStatus.HOLD
+        assert mgr.world == 2 and len(scales) == 1
+        set_mesh(None)
+
+
+class TestAutoTunerRealTrials:
+    def test_compiled_trial_fn_times_real_steps(self):
+        """The trial runner must build the candidate mesh, compile the real
+        train step, and return a measured per-step time."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.distributed.auto_tuner import AutoTuner
+        from paddle_tpu.distributed.auto_tuner.tuner import compiled_trial_fn
+        from paddle_tpu.distributed.mesh import get_mesh, set_mesh
+
+        set_mesh(None)
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(16, 32)
+                self.fc2 = nn.Linear(32, 4)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        def model_fn():
+            return Net(), lambda o, l: F.cross_entropy(o, l)
+
+        rng = np.random.RandomState(0)
+
+        def batch_fn(cfg):
+            return (paddle.to_tensor(rng.randn(8, 16).astype(np.float32)),
+                    paddle.to_tensor(rng.randint(0, 4, (8,)).astype(np.int64)))
+
+        def opt_fn(params):
+            return paddle.optimizer.SGD(learning_rate=0.01, parameters=params)
+
+        trial = compiled_trial_fn(model_fn, batch_fn, opt_fn, warmup=1, iters=2)
+        tuner = AutoTuner(8, trial, prune_kwargs={"n_heads": 4},
+                          max_trials=3)
+        best = tuner.search()
+        assert best.time_s is not None and best.time_s > 0
+        timed = [c for c in tuner.history if c.time_s is not None]
+        assert len(timed) >= 2  # real measurements, not a heuristic score
+        assert get_mesh() is None  # previous mesh restored
+
+
+class TestWatchdogDump:
+    def test_hang_writes_state_dump(self, tmp_path, monkeypatch):
+        import json
+        import time as _t
+
+        from paddle_tpu.distributed import watchdog
+
+        monkeypatch.setenv("PADDLE_LOG_DIR", str(tmp_path))
+        mgr = watchdog.CommTaskManager(default_timeout_s=0.3,
+                                       poll_interval_s=0.1)
+        mgr.on_hang = lambda t: watchdog.dump_state(mgr)
+        mgr.start()
+        mgr.begin("stuck_allreduce")
+        _t.sleep(1.0)
+        mgr.stop()
+        dump_file = tmp_path / f"comm_task_dump_{os.getpid()}.json"
+        assert dump_file.exists()
+        state = json.loads(dump_file.read_text())
+        assert state["hangs"] and state["hangs"][0]["name"] == "stuck_allreduce"

@@ -96,6 +96,20 @@ class TestEngine:
         for k in sd1:
             np.testing.assert_allclose(sd2[k], sd1[k])
 
+        # Adam moments must survive the round-trip (not restart at zero)
+        os1 = opt.state_dict()
+        moment_keys = [k for k in os1 if k.startswith("param_")]
+        assert moment_keys, "trained optimizer state was never synced back"
+        assert any(np.abs(v).sum() > 0
+                   for k in moment_keys for v in os1[k].values())
+        os2 = opt2.state_dict()
+        for k in moment_keys:
+            for sk in os1[k]:
+                np.testing.assert_allclose(os2[k][sk], os1[k][sk])
+        # resumed training continues from the loaded moments
+        engine2.fit(DataLoader(ToyDs(), batch_size=8), epochs=1, verbose=0)
+        assert np.isfinite(engine2.history["loss"][-1])
+
 
 class TestLogWriter:
     def test_scalar_roundtrip(self, tmp_path):
