@@ -26,6 +26,10 @@ from paddle_tpu.core.device import (  # noqa: F401
 )
 from paddle_tpu.core.flags import get_flags, set_flags  # noqa: F401
 from paddle_tpu.core.tensor import Tensor, is_tensor, to_tensor  # noqa: F401
+from paddle_tpu.core.containers import (  # noqa: F401
+    SelectedRows, TensorArray, array_length, array_pop, array_read,
+    array_write, create_array,
+)
 from paddle_tpu.autograd.tape import enable_grad, no_grad, set_grad_enabled  # noqa: F401
 
 # ops (also installs Tensor methods)

@@ -1,0 +1,140 @@
+"""Tensor containers: TensorArray + SelectedRows.
+
+Reference parity: phi TensorArray (phi/core/tensor_array.h — the dynamic
+tensor list behind while_loop/array_write) and SelectedRows
+(phi/core/selected_rows.h — sparse row-set gradients from embedding-style
+lookups).
+
+TPU-native: Python-level containers over jax arrays. TensorArray backs the
+eager `paddle.tensor.array_*` API (under jit, `lax.scan`'s stacked carries
+are the compiled replacement — SURVEY control-flow mapping). SelectedRows
+keeps (rows, values) unsummed until `merge` / `to_dense`, mirroring how the
+reference defers duplicate-row reduction.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["TensorArray", "SelectedRows", "create_array", "array_write",
+           "array_read", "array_length", "array_pop"]
+
+
+class TensorArray:
+    """Dynamic tensor list (reference phi/core/tensor_array.h)."""
+
+    def __init__(self, values=None):
+        self._items: list[Tensor] = list(values or [])
+
+    def append(self, t: Tensor):
+        self._items.append(t)
+        return self
+
+    def write(self, i: int, t: Tensor):
+        i = int(i)
+        if i > len(self._items):
+            raise IndexError(
+                f"TensorArray.write index {i} would leave a gap "
+                f"(len={len(self._items)}); write contiguously")
+        if i == len(self._items):
+            self._items.append(t)
+        else:
+            self._items[i] = t
+        return self
+
+    def read(self, i: int) -> Tensor:
+        i = int(i)
+        if not -len(self._items) <= i < len(self._items):
+            raise IndexError(
+                f"TensorArray.read index {i} out of range (len={len(self._items)})")
+        return self._items[i]
+
+    def pop(self, i: int = -1) -> Tensor:
+        return self._items.pop(int(i))
+
+    def stack(self, axis: int = 0) -> Tensor:
+        from paddle_tpu.ops.manipulation import stack as _stack
+
+        return _stack(self._items, axis=axis)
+
+    def concat(self, axis: int = 0) -> Tensor:
+        from paddle_tpu.ops.manipulation import concat as _concat
+
+        return _concat(self._items, axis=axis)
+
+    def __len__(self):
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __repr__(self):
+        return f"TensorArray(len={len(self._items)})"
+
+
+class SelectedRows:
+    """Row-sparse value set (reference phi/core/selected_rows.h): `rows[i]`
+    is the dense-dim-0 index of `values[i]`; duplicates are legal and sum."""
+
+    def __init__(self, rows, values: Tensor, height: int):
+        self.rows = np.asarray(rows, np.int64)
+        self.values = values
+        self.height = int(height)
+
+    @property
+    def nnz(self):
+        return len(self.rows)
+
+    def merge(self) -> "SelectedRows":
+        """Sum duplicate rows (reference MergeAdd functor)."""
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+
+        def f(v):
+            out = jnp.zeros((len(uniq),) + v.shape[1:], v.dtype)
+            return out.at[jnp.asarray(inv)].add(v)
+
+        return SelectedRows(uniq, apply_op(f, self.values, name="sr_merge"),
+                            self.height)
+
+    def to_dense(self) -> Tensor:
+        rows = jnp.asarray(self.rows)
+
+        def f(v):
+            out = jnp.zeros((self.height,) + v.shape[1:], v.dtype)
+            return out.at[rows].add(v)
+
+        return apply_op(f, self.values, name="sr_to_dense")
+
+    def __repr__(self):
+        return f"SelectedRows(height={self.height}, nnz={self.nnz})"
+
+
+# -- paddle.tensor array_* API (reference python/paddle/tensor/array.py) -----
+
+def create_array(dtype="float32", initialized_list=None):
+    return TensorArray(initialized_list)
+
+
+def array_write(x: Tensor, i, array: TensorArray | None = None) -> TensorArray:
+    if array is None:
+        array = TensorArray()
+    idx = int(i) if not isinstance(i, Tensor) else int(np.asarray(i._value))
+    array.write(idx, x)
+    return array
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    idx = int(i) if not isinstance(i, Tensor) else int(np.asarray(i._value))
+    return array.read(idx)
+
+
+def array_length(array: TensorArray):
+    from paddle_tpu.core.tensor import to_tensor
+
+    return to_tensor(np.asarray(len(array), np.int64))
+
+
+def array_pop(array: TensorArray, i=-1) -> Tensor:
+    return array.pop(i)

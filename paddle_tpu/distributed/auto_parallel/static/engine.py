@@ -35,7 +35,7 @@ class Engine:
             steps_per_epoch=None, log_freq=10, verbose=1, callbacks=None,
             **kwargs):
         """reference engine.py:68 Engine.fit."""
-        loader = self._as_loader(train_data, batch_size)
+        loader = self._as_loader(train_data, batch_size, epochs=epochs)
         for epoch in range(epochs):
             self._dist.train()  # per epoch: evaluate() flips the mode
             losses = []
@@ -97,16 +97,21 @@ class Engine:
 
     # -- helpers ---------------------------------------------------------------
     @staticmethod
-    def _as_loader(data, batch_size):
+    def _as_loader(data, batch_size, epochs=1):
         from paddle_tpu.io import DataLoader, Dataset
 
         if isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size or 1)
-        if iter(data) is data:
-            # one-shot iterator: materialize so every epoch sees the batches
-            # (a silently-empty epoch 2 is worse than the memory)
+        if epochs > 1 and iter(data) is data:
+            # one-shot iterator + multiple epochs: materialize so later
+            # epochs see the batches (a silently-empty epoch 2 is worse than
+            # the memory); single-epoch streams stay lazy
+            import warnings
+
+            warnings.warn("Engine.fit: materializing a one-shot iterator to "
+                          "re-iterate it across epochs")
             return list(data)
         return data  # re-iterable of batches
 

@@ -125,8 +125,7 @@ def compiled_trial_fn(model_fn, batch_fn, optimizer_fn, warmup=1, iters=3):
     def run_trial(cfg: TunerConfig) -> float:
         prev = get_mesh()
         try:
-            axes = {k: v for k, v in cfg.as_axes().items()}
-            build_mesh(axes)
+            build_mesh(cfg.as_axes())
             parts = model_fn()
             batch = batch_fn(cfg)
             if cfg.pp > 1:

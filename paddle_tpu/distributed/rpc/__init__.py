@@ -1,11 +1,18 @@
-"""paddle.distributed.rpc parity (reference: distributed/rpc/rpc.py — brpc-based).
+"""paddle.distributed.rpc parity (reference: distributed/rpc/rpc.py,
+backed by brpc + master rendezvous in the reference).
 
-TPU-native minimal backend: in-process registry for the single-controller SPMD
-model; multi-host RPC uses the TCPStore-style socket server in
-paddle_tpu.distributed.store (planned: full remote execution).
+TPU-native backend: REAL remote execution over the job's TCPStore data
+plane. `init_rpc` registers (name -> rank) in the store and starts a serve
+thread that polls this rank's inbox; `rpc_sync/rpc_async(to=...)` pickle
+(fn, args, kwargs) to the target's inbox and wait on the per-request result
+key. In a single process the registry short-circuits to local execution
+(same semantics, no sockets).
 """
 from __future__ import annotations
 
+import pickle
+import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass
 
@@ -24,30 +31,146 @@ class WorkerInfo:
 _workers: dict[str, WorkerInfo] = {}
 _current: list = [None]
 _pool = ThreadPoolExecutor(max_workers=8)
+_serve_stop = threading.Event()
+_serve_thread: list = [None]
 
 
-def init_rpc(name: str, rank: int = 0, world_size: int = 1, master_endpoint: str | None = None):
+def _cross_process() -> bool:
+    from paddle_tpu.distributed import multiproc
+
+    return multiproc.cross_process_active()
+
+
+_tls = threading.local()
+
+
+def _store():
+    """Thread-local store CLIENT: the serve loop and async callers run on
+    their own threads, and a TCPStore client socket is not thread-safe —
+    sharing the global client interleaves request frames and deadlocks."""
+    from paddle_tpu.distributed import multiproc
+    from paddle_tpu.distributed.store import TCPStore
+
+    st = getattr(_tls, "store", None)
+    if st is None:
+        g = multiproc._store()
+        st = TCPStore(g.host, g.port, is_master=False)
+        _tls.store = st
+    return st
+
+
+def _serve_loop(rank: int):
+    """Poll this rank's inbox; execute requests; post results. The consumed
+    cursor lives in the STORE (rpc/served/{rank}) so a shutdown/init_rpc
+    cycle resumes after the already-consumed messages instead of hanging on
+    deleted keys."""
+    store = _store()
+    nxt = store.add(f"rpc/served/{rank}", 0) + 1
+    while not _serve_stop.is_set():
+        payload = store.get(f"rpc/msg/{rank}/{nxt}")
+        if payload is None:
+            time.sleep(0.02)
+            continue
+        src, seq, fn, args, kwargs = pickle.loads(payload)
+        try:
+            result = (True, fn(*args, **kwargs))
+        except Exception as e:  # ship the failure back, don't kill the server
+            result = (False, f"{type(e).__name__}: {e}")
+        store.set(f"rpc/res/{rank}/{nxt}", pickle.dumps(result))
+        store.delete_key(f"rpc/msg/{rank}/{nxt}")
+        store.add(f"rpc/served/{rank}", 1)
+        nxt += 1
+
+
+def init_rpc(name: str, rank: int | None = None, world_size: int | None = None,
+             master_endpoint: str | None = None):
+    """reference rpc.py init_rpc: register + start serving."""
+    if rank is None:
+        import os
+
+        rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
     info = WorkerInfo(name=name, rank=rank)
     _workers[name] = info
     _current[0] = info
+    if _cross_process():
+        if _serve_thread[0] is not None and _serve_thread[0].is_alive():
+            # re-init: retire the previous serve thread first (two servers
+            # on one inbox would race and double-execute)
+            _serve_stop.set()
+            _serve_thread[0].join(2)
+        store = _store()
+        store.set(f"rpc/worker/{name}", pickle.dumps(info))
+        _serve_stop.clear()
+        t = threading.Thread(target=_serve_loop, args=(rank,), daemon=True)
+        t.start()
+        _serve_thread[0] = t
     return info
 
 
+def _resolve(name: str) -> WorkerInfo:
+    if name in _workers:
+        return _workers[name]
+    if _cross_process():
+        payload = _store().wait(f"rpc/worker/{name}")
+        info = pickle.loads(payload)
+        _workers[name] = info
+        return info
+    raise KeyError(f"unknown rpc worker '{name}'")
+
+
+def _remote_call(info: WorkerInfo, fn, args, kwargs, timeout):
+    store = _store()
+    me = _current[0].rank if _current[0] else -1
+    seq = store.add(f"rpc/q/{info.rank}", 1)
+    store.set(f"rpc/msg/{info.rank}/{seq}",
+              pickle.dumps((me, seq, fn, args, kwargs)))
+    payload = store.wait(f"rpc/res/{info.rank}/{seq}", timeout=timeout)
+    store.delete_key(f"rpc/res/{info.rank}/{seq}")
+    ok, value = pickle.loads(payload)
+    if not ok:
+        raise RuntimeError(f"rpc to '{info.name}' failed remotely: {value}")
+    return value
+
+
 def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
-    return fn(*(args or ()), **(kwargs or {}))
+    args = args or ()
+    kwargs = kwargs or {}
+    info = _resolve(to)
+    me = _current[0]
+    if not _cross_process() or (me is not None and info.rank == me.rank):
+        return fn(*args, **kwargs)
+    return _remote_call(info, fn, args, kwargs, timeout)
 
 
 def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None) -> Future:
-    return _pool.submit(fn, *(args or ()), **(kwargs or {}))
+    args = args or ()
+    kwargs = kwargs or {}
+    info = _resolve(to)
+    me = _current[0]
+    if not _cross_process() or (me is not None and info.rank == me.rank):
+        return _pool.submit(fn, *args, **kwargs)
+    return _pool.submit(_remote_call, info, fn, args, kwargs, timeout)
 
 
 def shutdown():
+    """reference rpc.py shutdown: barrier so in-flight requests drain."""
+    if _cross_process() and _current[0] is not None:
+        from paddle_tpu.distributed import multiproc
+
+        try:
+            multiproc.barrier()
+        except Exception:
+            pass
+    _serve_stop.set()
+    if _serve_thread[0] is not None:
+        _serve_thread[0].join(2)
+        _serve_thread[0] = None
     _workers.clear()
     _current[0] = None
 
 
 def get_worker_info(name: str) -> WorkerInfo:
-    return _workers[name]
+    return _resolve(name)
 
 
 def get_all_worker_infos():

@@ -146,7 +146,9 @@ class CompiledTrainStep:
                 for s, p in zip(self._param_specs, self._params)
             ]
         self._key = jax.random.key(seed)
-        self._step_i = 0
+        # resume from a loaded optimizer's step count: Adam-style bias
+        # correction must continue at t, not restart at 1 with warm moments
+        self._step_i = int(getattr(optimizer, "_step_count", 0) or 0)
 
         # materialize params (sharded) + optimizer state
         self._param_vals = []

@@ -1,0 +1,53 @@
+"""TensorArray + SelectedRows containers (reference phi/core/tensor_array.h,
+selected_rows.h; python/paddle/tensor array_* API)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class TestTensorArray:
+    def test_array_write_read_length(self):
+        arr = paddle.create_array()
+        for i in range(3):
+            paddle.array_write(paddle.to_tensor(np.full(2, float(i), np.float32)),
+                               i, arr)
+        assert int(paddle.array_length(arr)) == 3
+        x = paddle.array_read(arr, 1)
+        np.testing.assert_array_equal(np.asarray(x._value), [1.0, 1.0])
+        stacked = arr.stack()
+        assert stacked.shape == [3, 2]
+        popped = paddle.array_pop(arr)
+        np.testing.assert_array_equal(np.asarray(popped._value), [2.0, 2.0])
+        assert len(arr) == 2
+
+    def test_grad_flows_through_stack(self):
+        a = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.full(2, 2.0, np.float32), stop_gradient=False)
+        arr = paddle.TensorArray([a * 2, b * 3])
+        loss = arr.stack().sum()
+        loss.backward()
+        np.testing.assert_allclose(np.asarray(a.grad._value), [2.0, 2.0])
+        np.testing.assert_allclose(np.asarray(b.grad._value), [3.0, 3.0])
+
+
+class TestSelectedRows:
+    def test_merge_and_to_dense(self):
+        vals = paddle.to_tensor(np.array([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]],
+                                         np.float32))
+        sr = paddle.SelectedRows([1, 3, 1], vals, height=5)
+        assert sr.nnz == 3
+        merged = sr.merge()
+        assert merged.nnz == 2
+        dense = np.asarray(sr.to_dense()._value)
+        want = np.zeros((5, 2), np.float32)
+        want[1] = [4.0, 4.0]
+        want[3] = [2.0, 2.0]
+        np.testing.assert_array_equal(dense, want)
+        np.testing.assert_array_equal(np.asarray(merged.to_dense()._value), want)
+
+    def test_grad_through_to_dense(self):
+        vals = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+        sr = paddle.SelectedRows([0, 2], vals, height=4)
+        sr.to_dense().sum().backward()
+        np.testing.assert_array_equal(np.asarray(vals.grad._value),
+                                      np.ones((2, 3), np.float32))

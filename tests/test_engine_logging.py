@@ -106,6 +106,15 @@ class TestEngine:
         for k in moment_keys:
             for sk in os1[k]:
                 np.testing.assert_allclose(os2[k][sk], os1[k][sk])
+        # the step count must continue (Adam bias correction at t, not t=1),
+        # and a re-save must not regress it
+        assert os1["step"] > 0
+        assert os2["step"] == os1["step"]
+        engine2._dist.train()
+        engine2._dist(paddle.to_tensor(ToyDs(8).x),
+                      paddle.to_tensor(ToyDs(8).y))
+        engine2._dist._sync()
+        assert opt2.state_dict()["step"] == os1["step"] + 1
         # resumed training continues from the loaded moments
         engine2.fit(DataLoader(ToyDs(), batch_size=8), epochs=1, verbose=0)
         assert np.isfinite(engine2.history["loss"][-1])

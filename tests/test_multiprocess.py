@@ -53,3 +53,14 @@ def test_four_process_hybrid_subgroups(tmp_path):
     proc, logs = _launch(4, _HYBRID_WORKER, str(tmp_path / "logs"))
     assert proc.returncode == 0, f"launch failed rc={proc.returncode}\n{proc.stdout}\n{logs}"
     assert logs.count("HYBRID_WORKER_OK") == 4, f"not all ranks succeeded\n{logs}"
+
+
+_RPC_WORKER = os.path.join(os.path.dirname(__file__), "workers", "rpc_worker.py")
+
+
+def test_two_process_rpc(tmp_path):
+    """Real remote execution over the TCPStore plane (reference
+    test/rpc/test_rpc.py): sync/async calls, kwargs, remote exceptions."""
+    proc, logs = _launch(2, _RPC_WORKER, str(tmp_path / "logs"))
+    assert proc.returncode == 0, f"launch failed rc={proc.returncode}\n{proc.stdout}\n{logs}"
+    assert logs.count("RPC_WORKER_OK") == 2, f"workers did not both succeed\n{logs}"

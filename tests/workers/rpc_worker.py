@@ -1,0 +1,68 @@
+"""2-process RPC worker: real remote execution over the TCPStore data plane.
+
+Launched by test_multiprocess.py. Validates (reference test pattern:
+test/rpc/test_rpc.py): worker registry, rpc_sync with args/kwargs,
+rpc_async futures, remote exception propagation, shutdown barrier.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.distributed as dist  # noqa: E402
+import paddle_tpu.distributed.rpc as rpc  # noqa: E402
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"RPC_WORKER_FAIL: {msg}", flush=True)
+        sys.exit(1)
+
+
+def add(a, b):
+    return a + b
+
+
+def scaled(x, k=2):
+    return [v * k for v in x]
+
+
+def boom():
+    raise ValueError("intentional")
+
+
+def main():
+    dist.init_parallel_env()
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    rpc.init_rpc(f"worker{rank}", rank=rank)
+
+    peer = f"worker{1 - rank}"
+    info = rpc.get_worker_info(peer)
+    check(info.rank == 1 - rank, f"registry: {info}")
+
+    out = rpc.rpc_sync(peer, add, args=(3, 4))
+    check(out == 7, f"rpc_sync add -> {out}")
+
+    out = rpc.rpc_sync(peer, scaled, args=([1, 2],), kwargs={"k": 10})
+    check(out == [10, 20], f"rpc_sync kwargs -> {out}")
+
+    fut = rpc.rpc_async(peer, add, args=(10, 20))
+    check(fut.result(timeout=60) == 30, "rpc_async result")
+
+    try:
+        rpc.rpc_sync(peer, boom)
+        check(False, "remote exception did not propagate")
+    except RuntimeError as e:
+        check("intentional" in str(e), f"exception content: {e}")
+
+    rpc.shutdown()
+    print("RPC_WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

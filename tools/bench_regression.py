@@ -1,0 +1,71 @@
+"""Benchmark regression gate.
+
+Reference analog: the reference's benchmark CI (ce framework) that fails a
+PR when throughput regresses beyond a tolerance.
+
+Runs bench.py on the current platform and compares tokens/sec (TPU) or just
+sanity (CPU smoke: finite loss, flash check skipped) against the recorded
+baseline in BENCH_BASELINE.json. Exits nonzero on a >10% regression so the
+perf path cannot rot silently. Refresh the baseline intentionally with
+`python tools/bench_regression.py --update`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "BENCH_BASELINE.json")
+TOLERANCE = 0.10
+
+
+def run_bench() -> dict:
+    res = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         capture_output=True, text=True, timeout=900)
+    if res.returncode != 0:
+        print(res.stdout[-2000:], res.stderr[-2000:], file=sys.stderr)
+        raise SystemExit("bench.py failed")
+    for line in reversed(res.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit("bench.py produced no JSON line")
+
+
+def main():
+    cur = run_bench()
+    platform = cur["detail"]["platform"]
+    if "--update" in sys.argv:
+        with open(BASELINE, "w") as f:
+            json.dump({platform: cur}, f, indent=2)
+        print(f"baseline updated for {platform}: {cur['value']} {cur['unit']}")
+        return
+
+    if not os.path.exists(BASELINE):
+        raise SystemExit(f"no {BASELINE}; record one with --update")
+    with open(BASELINE) as f:
+        base_all = json.load(f)
+    base = base_all.get(platform)
+    if base is None:
+        print(f"no recorded baseline for platform '{platform}' — run "
+              f"--update on this platform first; skipping gate")
+        return
+
+    loss = cur["detail"]["loss"]
+    if not (loss == loss and abs(loss) < 1e4):
+        raise SystemExit(f"bench loss not finite/sane: {loss}")
+    ratio = cur["value"] / base["value"]
+    print(f"throughput: {cur['value']:.1f} vs baseline {base['value']:.1f} "
+          f"({ratio:.3f}x)")
+    if platform != "cpu" and not cur["detail"].get("flash_on_hot_path", False):
+        raise SystemExit("flash kernel fell off the hot path")
+    if ratio < 1 - TOLERANCE:
+        raise SystemExit(
+            f"REGRESSION: {ratio:.3f}x is below the {1 - TOLERANCE:.2f} gate")
+    print("bench regression gate passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# CI entry point (reference analog: the reference repo's CI pipelines under
+# tools/ + paddle_build.sh test stages). Stages:
+#   1. import hygiene: importing paddle_tpu must NOT initialize the XLA
+#      backend (jax.distributed would break)
+#   2. unit suite on the virtual 8-device CPU mesh
+#   3. driver multichip gate: 8-device dryrun of the full sharded train step
+#   4. bench smoke (CPU config) + regression check against the recorded
+#      baseline (tools/bench_regression.py)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== [1/4] import hygiene =="
+python - <<'EOF'
+import jax, paddle_tpu
+from jax._src import xla_bridge
+assert not xla_bridge._backends, "import paddle_tpu initialized the XLA backend"
+print("ok: lazy backend")
+EOF
+
+echo "== [2/4] unit suite =="
+python -m pytest tests/ -q
+
+echo "== [3/4] multichip gate =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== [4/4] bench regression =="
+python tools/bench_regression.py
+
+echo "CI PASSED"
