@@ -3,3 +3,10 @@ from paddle_tpu.vision.models.resnet import (  # noqa: F401
     BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
     resnet101, resnet152,
 )
+from paddle_tpu.vision.models.alexnet import (  # noqa: F401
+    AlexNet, SqueezeNet, alexnet, squeezenet1_0, squeezenet1_1,
+)
+from paddle_tpu.vision.models.mobilenetv2 import (  # noqa: F401
+    InvertedResidual, MobileNetV2, mobilenet_v2,
+)
+from paddle_tpu.vision.models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401

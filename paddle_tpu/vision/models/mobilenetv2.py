@@ -1,0 +1,87 @@
+"""MobileNetV2 (reference: python/paddle/vision/models/mobilenetv2.py).
+Depthwise convs use feature_group_count on the TPU conv path."""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["MobileNetV2", "mobilenet_v2", "InvertedResidual"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _conv_bn(c_in, c_out, kernel, stride=1, groups=1):
+    pad = (kernel - 1) // 2
+    return nn.Sequential(
+        nn.Conv2D(c_in, c_out, kernel, stride=stride, padding=pad,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(c_out),
+        nn.ReLU6(),
+    )
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, c_in, c_out, stride, expand_ratio):
+        super().__init__()
+        self.stride = stride
+        hidden = int(round(c_in * expand_ratio))
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(c_in, hidden, 1))
+        layers += [
+            _conv_bn(hidden, hidden, 3, stride=stride, groups=hidden),  # depthwise
+            nn.Conv2D(hidden, c_out, 1, bias_attr=False),
+            nn.BatchNorm2D(c_out),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [
+            # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        c_in = _make_divisible(32 * scale)
+        features = [_conv_bn(3, c_in, 3, stride=2)]
+        for t, c, n, s in cfg:
+            c_out = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(c_in, c_out,
+                                                 s if i == 0 else 1, t))
+                c_in = c_out
+        self.last_channel = _make_divisible(1280 * max(1.0, scale))
+        features.append(_conv_bn(c_in, self.last_channel, 1))
+        self.features = nn.Sequential(*features)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:  # num_classes=0 -> backbone mode (reference idiom)
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(self.last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        x = x.reshape([x.shape[0], -1])
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)

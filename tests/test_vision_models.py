@@ -1,0 +1,71 @@
+"""Vision model-family widening (reference python/paddle/vision/models):
+VGG, MobileNetV2 (depthwise convs), AlexNet, SqueezeNet — forward shapes
+and a real train step each."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import models
+
+
+@pytest.mark.parametrize("ctor,size", [
+    (lambda: models.mobilenet_v2(scale=0.35, num_classes=10), 32),
+    (lambda: models.SqueezeNet("1.1", num_classes=10), 64),
+])
+def test_small_models_train_step(ctor, size):
+    paddle.seed(0)
+    m = ctor()
+    m.train()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, size, size)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.array([1, 3], np.int64))
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    out = m(x)
+    assert out.shape == [2, 10]
+    loss = F.cross_entropy(out, y)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss))
+
+
+def test_vgg_structure():
+    paddle.seed(0)
+    m = models.vgg11(num_classes=7)
+    m.eval()
+    x = paddle.to_tensor(np.random.RandomState(1).randn(1, 3, 224, 224)
+                         .astype(np.float32))
+    out = m(x)
+    assert out.shape == [1, 7]
+    # D config has 13 convs; A has 8
+    n_convs = sum(1 for _, s in m.named_sublayers()
+                  if type(s).__name__ == "Conv2D")
+    assert n_convs == 8
+
+
+def test_alexnet_forward():
+    paddle.seed(0)
+    m = models.alexnet(num_classes=5)
+    m.eval()
+    x = paddle.to_tensor(np.random.RandomState(2).randn(1, 3, 224, 224)
+                         .astype(np.float32))
+    assert m(x).shape == [1, 5]
+
+
+def test_mobilenet_depthwise_residuals():
+    m = models.mobilenet_v2(scale=0.35)
+    blocks = [s for _, s in m.named_sublayers()
+              if isinstance(s, models.InvertedResidual)]
+    assert len(blocks) == 17
+    assert any(b.use_res for b in blocks)
+
+
+def test_backbone_mode_and_version_validation():
+    import pytest as _pytest
+
+    m = models.mobilenet_v2(scale=0.35, num_classes=0)
+    x = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    feats = m(x)
+    assert feats.shape == [1, m.last_channel]
+    with _pytest.raises(ValueError):
+        models.SqueezeNet(version="2.0")
