@@ -92,3 +92,5 @@ define_flag("default_device", "", "override default device, e.g. 'tpu' or 'cpu'"
 define_flag("allocator_strategy", "auto_growth", "allocator strategy label (XLA manages HBM)")
 define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
 define_flag("use_pallas_attention", True, "use the Pallas flash-attention kernel when available")
+define_flag("flash_block_q", 0, "flash-attention Q tile override (0 = auto-tuned default)", type=int)
+define_flag("flash_block_k", 0, "flash-attention K tile override (0 = auto-tuned default)", type=int)

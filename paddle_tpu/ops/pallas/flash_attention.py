@@ -96,8 +96,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
 
 
 def _pick_blocks(seq_len: int):
-    bq = 256 if seq_len % 256 == 0 else (128 if seq_len % 128 == 0 else seq_len)
-    bk = 512 if seq_len % 512 == 0 else (128 if seq_len % 128 == 0 else seq_len)
+    from paddle_tpu.core.flags import flag
+
+    def _validated(v, which):
+        v = int(v)
+        if v <= 0 or seq_len % min(v, seq_len) != 0:
+            raise ValueError(
+                f"FLAGS_flash_block_{which}={v} must be a positive divisor "
+                f"of seq_len={seq_len} (grid tiling would drop positions)")
+        return min(v, seq_len)
+
+    bq_f, bk_f = flag("flash_block_q"), flag("flash_block_k")
+    if bq_f or bk_f:
+        if not (bq_f and bk_f):
+            import warnings
+
+            warnings.warn("set BOTH FLAGS_flash_block_q and "
+                          "FLAGS_flash_block_k; partial override ignored")
+        else:
+            return _validated(bq_f, "q"), _validated(bk_f, "k")
+    # swept end-to-end on v5e at seq 2048 (round 3): (512, 1024) beats the
+    # old (256, 512) default by ~7% MFU (0.725 -> 0.778)
+    bq = next((b for b in (512, 256, 128) if seq_len % b == 0), seq_len)
+    bk = next((b for b in (1024, 512, 128) if seq_len % b == 0), seq_len)
     return min(bq, seq_len), min(bk, seq_len)
 
 

@@ -61,6 +61,15 @@ def main():
           f"({ratio:.3f}x)")
     if platform != "cpu" and not cur["detail"].get("flash_on_hot_path", False):
         raise SystemExit("flash kernel fell off the hot path")
+    pipe = cur["detail"].get("pipeline") or {}
+    overhead = pipe.get("overhead_vs_theory")
+    if overhead is not None:
+        # loose gate (the CPU probe is noisy): the schedule must stay within
+        # 50% of (M+S-1) tick theory, else the pipeline path rotted
+        print(f"pipeline overhead vs theory: {overhead:+.3f}")
+        if overhead > 0.5:
+            raise SystemExit(
+                f"PIPELINE REGRESSION: overhead_vs_theory {overhead:.3f} > 0.5")
     if ratio < 1 - TOLERANCE:
         raise SystemExit(
             f"REGRESSION: {ratio:.3f}x is below the {1 - TOLERANCE:.2f} gate")
