@@ -43,7 +43,13 @@ from paddle_tpu import device  # noqa: F401
 from paddle_tpu import distributed  # noqa: F401
 from paddle_tpu import framework  # noqa: F401
 from paddle_tpu import io  # noqa: F401
+from paddle_tpu import distribution  # noqa: F401
+from paddle_tpu import fft  # noqa: F401
 from paddle_tpu import jit  # noqa: F401
+from paddle_tpu import linalg  # noqa: F401
+from paddle_tpu import regularizer  # noqa: F401
+from paddle_tpu import signal  # noqa: F401
+from paddle_tpu.regularizer import L1Decay, L2Decay  # noqa: F401
 from paddle_tpu import metric  # noqa: F401
 from paddle_tpu import nn  # noqa: F401
 from paddle_tpu import optimizer  # noqa: F401

@@ -1,0 +1,17 @@
+"""paddle.distribution parity (reference: python/paddle/distribution/ —
+Distribution base, the distribution zoo, and the kl_divergence registry).
+
+TPU-native: samplers are jax.random draws keyed from the framework RNG
+(reparameterized where the reference is), log_prob/entropy are closed-form
+jnp expressions that differentiate and jit like any other op.
+"""
+from paddle_tpu.distribution.distributions import (  # noqa: F401
+    Bernoulli, Beta, Categorical, Dirichlet, Distribution, Exponential, Gamma,
+    Geometric, Gumbel, Laplace, LogNormal, Multinomial, Normal, Poisson,
+    Uniform, kl_divergence, register_kl,
+)
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
+           "Laplace", "LogNormal", "Multinomial", "Poisson", "kl_divergence",
+           "register_kl"]

@@ -1,0 +1,648 @@
+"""Distribution zoo.
+
+Reference parity: python/paddle/distribution/{distribution,normal,uniform,
+bernoulli,categorical,beta,dirichlet,exponential,gamma,geometric,gumbel,
+laplace,lognormal,multinomial,poisson,kl}.py — sample/rsample/log_prob/
+entropy/mean/variance surfaces plus the @register_kl double-dispatch
+registry.
+
+TPU-native: one jax.random draw per sample keyed from the global RNG
+(`ops/random_state.py`); log_prob/entropy are jnp closed forms, so they
+differentiate through the tape and fuse under jit.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
+           "Laplace", "LogNormal", "Multinomial", "Poisson", "kl_divergence",
+           "register_kl"]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+def _key():
+    from paddle_tpu.ops.random_state import default_generator
+
+    return default_generator.next_key()
+
+
+def _shape(sample_shape, *params):
+    base = jnp.broadcast_shapes(*[jnp.shape(p) for p in params]) if params else ()
+    return tuple(sample_shape) + tuple(base)
+
+
+class Distribution:
+    """reference distribution.py:39."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op(jnp.exp, self.log_prob(value), name="prob")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """reference normal.py. Tensor-valued loc/scale stay attached to the
+    autograd tape: rsample/log_prob route through apply_op so pathwise
+    (reparameterized) gradients flow to the parameters."""
+
+    def __init__(self, loc, scale, name=None):
+        self._loc_t = loc if isinstance(loc, Tensor) else Tensor(_v(loc))
+        self._scale_t = scale if isinstance(scale, Tensor) else Tensor(_v(scale))
+        self.loc = self._loc_t._value
+        self.scale = self._scale_t._value
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale**2, self.batch_shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.loc, self.scale)
+        eps = jax.random.normal(_key(), shp, jnp.float32)
+        return apply_op(lambda l, s: l + s * eps, self._loc_t, self._scale_t,
+                        name="normal_rsample")
+
+    def log_prob(self, value):
+        def f(x, l, s):
+            return (-jnp.log(s) - 0.5 * math.log(2 * math.pi)
+                    - 0.5 * ((x - l) / s) ** 2)
+
+        return apply_op(f, value, self._loc_t, self._scale_t,
+                        name="normal_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+
+class LogNormal(Distribution):
+    """reference lognormal.py (exp of a Normal)."""
+
+    def __init__(self, loc, scale):
+        self._base = Normal(loc, scale)
+        self.loc, self.scale = self._base.loc, self._base.scale
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale**2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale**2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=()):
+        # through apply_op so pathwise grads reach loc/scale via the base
+        return apply_op(jnp.exp, self._base.rsample(shape),
+                        name="lognormal_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(x):
+            lx = jnp.log(x)
+            return (-jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+                    - jnp.log(x) - 0.5 * ((lx - self.loc) / self.scale) ** 2)
+
+        return apply_op(f, value, name="lognormal_log_prob")
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+                      + self.loc)
+
+
+class Uniform(Distribution):
+    """reference uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.low, self.high)
+        u = jax.random.uniform(_key(), shp, jnp.float32)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        def f(x):
+            inside = (x >= self.low) & (x < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+        return apply_op(f, value, name="uniform_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low)
+                      + jnp.zeros(self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    """reference bernoulli.py (probs parameterization)."""
+
+    def __init__(self, probs):
+        self.probs = _v(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.probs)
+        return Tensor(jax.random.bernoulli(_key(), self.probs, shp)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(x):
+            p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+            return x * jnp.log(p) + (1 - x) * jnp.log1p(-p)
+
+        return apply_op(f, value, name="bernoulli_log_prob")
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Geometric(Distribution):
+    """reference geometric.py: #failures before the first success."""
+
+    def __init__(self, probs):
+        self.probs = _v(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs**2)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.probs)
+        u = jax.random.uniform(_key(), shp, jnp.float32, 1e-7, 1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        def f(k):
+            p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+            return k * jnp.log1p(-p) + jnp.log(p)
+
+        return apply_op(f, value, name="geometric_log_prob")
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Categorical(Distribution):
+    """reference categorical.py (logits parameterization)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("Categorical needs logits or probs")
+        if logits is not None:
+            self.logits = _v(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_v(probs), 1e-9, None))
+        super().__init__(jnp.shape(self.logits)[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jax.nn.softmax(self.logits, -1))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + jnp.shape(self.logits)[:-1]
+        return Tensor(jax.random.categorical(_key(), self.logits, shape=shp))
+
+    def log_prob(self, value):
+        def f(idx):
+            logp = jax.nn.log_softmax(self.logits, -1)
+            return jnp.take_along_axis(
+                jnp.broadcast_to(logp, idx.shape + logp.shape[-1:]),
+                idx[..., None].astype(jnp.int32), -1)[..., 0]
+
+        return apply_op(f, value, name="categorical_log_prob")
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Multinomial(Distribution):
+    """reference multinomial.py: counts over `total_count` categorical draws."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _v(probs)
+        super().__init__(jnp.shape(self.probs)[:-1], jnp.shape(self.probs)[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        k = self.probs.shape[-1]
+        logits = jnp.log(jnp.clip(self.probs, 1e-9, None))
+        shp = tuple(shape) + jnp.shape(self.probs)[:-1]
+        draws = jax.random.categorical(
+            _key(), logits, shape=(self.total_count,) + shp)
+        counts = jax.nn.one_hot(draws, k, dtype=jnp.float32).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        def f(x):
+            logp = jnp.log(jnp.clip(self.probs, 1e-9, None))
+            return (jax.scipy.special.gammaln(self.total_count + 1.0)
+                    - jnp.sum(jax.scipy.special.gammaln(x + 1.0), -1)
+                    + jnp.sum(x * logp, -1))
+
+        return apply_op(f, value, name="multinomial_log_prob")
+
+
+class Beta(Distribution):
+    """reference beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s * s * (s + 1)))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.alpha, self.beta)
+        return Tensor(jax.random.beta(_key(), self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        def f(x):
+            from jax.scipy.special import betaln
+
+            return ((self.alpha - 1) * jnp.log(x)
+                    + (self.beta - 1) * jnp.log1p(-x)
+                    - betaln(self.alpha, self.beta))
+
+        return apply_op(f, value, name="beta_log_prob")
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
+                      - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    """reference dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = _v(concentration)
+        super().__init__(jnp.shape(self.concentration)[:-1],
+                         jnp.shape(self.concentration)[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / jnp.sum(self.concentration, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + jnp.shape(self.concentration)[:-1]
+        return Tensor(jax.random.dirichlet(_key(), self.concentration, shp))
+
+    def log_prob(self, value):
+        def f(x):
+            from jax.scipy.special import gammaln
+
+            a = self.concentration
+            return (jnp.sum((a - 1) * jnp.log(x), -1)
+                    + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+        return apply_op(f, value, name="dirichlet_log_prob")
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        return Tensor(jnp.sum(gammaln(a), -1) - gammaln(a0)
+                      + (a0 - k) * digamma(a0)
+                      - jnp.sum((a - 1) * digamma(a), -1))
+
+
+class Exponential(Distribution):
+    """reference exponential.py (rate parameterization)."""
+
+    def __init__(self, rate):
+        self.rate = _v(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate**2)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.rate)
+        return Tensor(jax.random.exponential(_key(), shp, jnp.float32)
+                      / self.rate)
+
+    def log_prob(self, value):
+        def f(x):
+            return jnp.log(self.rate) - self.rate * x
+
+        return apply_op(f, value, name="exponential_log_prob")
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    """reference gamma.py (concentration/rate)."""
+
+    def __init__(self, concentration, rate):
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate**2)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.concentration, self.rate)
+        return Tensor(jax.random.gamma(_key(), self.concentration, shp)
+                      / self.rate)
+
+    def log_prob(self, value):
+        def f(x):
+            from jax.scipy.special import gammaln
+
+            a, b = self.concentration, self.rate
+            return a * jnp.log(b) + (a - 1) * jnp.log(x) - b * x - gammaln(a)
+
+        return apply_op(f, value, name="gamma_log_prob")
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+
+        a = self.concentration
+        return Tensor(a - jnp.log(self.rate) + gammaln(a)
+                      + (1 - a) * digamma(a))
+
+
+class Laplace(Distribution):
+    """reference laplace.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.scale**2)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.loc, self.scale)
+        u = jax.random.uniform(_key(), shp, jnp.float32, -0.5 + 1e-7, 0.5)
+        return Tensor(self.loc - self.scale * jnp.sign(u)
+                      * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        def f(x):
+            return (-jnp.log(2 * self.scale)
+                    - jnp.abs(x - self.loc) / self.scale)
+
+        return apply_op(f, value, name="laplace_log_prob")
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    """reference gumbel.py."""
+
+    _EULER = 0.5772156649015329
+
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * self._EULER)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi**2 / 6) * self.scale**2)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.loc, self.scale)
+        g = jax.random.gumbel(_key(), shp, jnp.float32)
+        return Tensor(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        def f(x):
+            z = (x - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+        return apply_op(f, value, name="gumbel_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + self._EULER
+                      + jnp.zeros(self.batch_shape))
+
+
+class Poisson(Distribution):
+    """reference poisson.py."""
+
+    def __init__(self, rate):
+        self.rate = _v(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.rate)
+        return Tensor(jax.random.poisson(_key(), self.rate, shp)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(k):
+            from jax.scipy.special import gammaln
+
+            return k * jnp.log(self.rate) - self.rate - gammaln(k + 1.0)
+
+        return apply_op(f, value, name="poisson_log_prob")
+
+
+# ---- KL registry (reference kl.py @register_kl double dispatch) ------------
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    matches = [(pc, qc, fn) for (pc, qc), fn in _KL_REGISTRY.items()
+               if isinstance(p, pc) and isinstance(q, qc)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    # most-specific match (reference kl.py total-order dispatch): the entry
+    # closest to the instances' own classes in their MROs wins
+    pc, qc, fn = min(matches, key=lambda m: (
+        type(p).__mro__.index(m[0]) + type(q).__mro__.index(m[1])))
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return Tensor(betaln(a2, b2) - betaln(a1, b1)
+                  + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                  + (a2 - a1 + b2 - b1) * digamma(a1 + b1))

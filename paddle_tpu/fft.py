@@ -1,0 +1,75 @@
+"""paddle.fft parity (reference: python/paddle/fft.py — 1669 LoC of
+_C_ops.fft_* wrappers). TPU-native: jnp.fft lowers to XLA's FFT HLO.
+Norm semantics ('backward'|'ortho'|'forward') match the reference."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
+           "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _wrap1(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward"):
+        return apply_op(lambda v: jfn(v, n=n, axis=axis, norm=norm), x,
+                        name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _wrap2(name, jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward"):
+        return apply_op(lambda v: jfn(v, s=s, axes=axes, norm=norm), x,
+                        name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _wrapn(name, jfn):
+    def op(x, s=None, axes=None, norm="backward"):
+        return apply_op(lambda v: jfn(v, s=s, axes=axes, norm=norm), x,
+                        name=name)
+
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+fft2 = _wrap2("fft2", jnp.fft.fft2)
+ifft2 = _wrap2("ifft2", jnp.fft.ifft2)
+rfft2 = _wrap2("rfft2", jnp.fft.rfft2)
+irfft2 = _wrap2("irfft2", jnp.fft.irfft2)
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return Tensor(jnp.fft.fftfreq(n, d).astype(to_jax_dtype(dtype)))
+
+
+def rfftfreq(n, d=1.0, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(to_jax_dtype(dtype)))
+
+
+def fftshift(x, axes=None):
+    return apply_op(lambda v: jnp.fft.fftshift(v, axes=axes), x, name="fftshift")
+
+
+def ifftshift(x, axes=None):
+    return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), x, name="ifftshift")

@@ -1,0 +1,12 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py — re-exports
+the tensor linalg ops under one module)."""
+from paddle_tpu.ops.linalg import (  # noqa: F401
+    cholesky, cond, cross, det, eig, eigh, eigvals, eigvalsh, inv, lstsq, lu,
+    matmul, matrix_power, matrix_rank, multi_dot, norm, pinv, qr, slogdet,
+    solve, svd, triangular_solve,
+)
+
+__all__ = ["cholesky", "cond", "cross", "det", "eig", "eigh", "eigvals",
+           "eigvalsh", "inv", "lstsq", "lu", "matmul", "matrix_power",
+           "matrix_rank", "multi_dot", "norm", "pinv", "qr", "slogdet",
+           "solve", "svd", "triangular_solve"]

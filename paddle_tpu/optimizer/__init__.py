@@ -65,14 +65,23 @@ class Optimizer:
         self._use_master_weights = multi_precision
         self._jit_update = jax.jit(self._update, donate_argnums=(0, 2))
 
-    @staticmethod
-    def _parse_wd(weight_decay):
+    def _parse_wd(self, weight_decay):
+        self._wd_l1 = bool(getattr(weight_decay, "_l1", False))
         if weight_decay is None:
             return 0.0
         if isinstance(weight_decay, (int, float)):
             return float(weight_decay)
-        # L2Decay-style object with a coefficient
+        # L1Decay/L2Decay-style object with a coefficient
         return float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+
+    def _decayed(self, g, p):
+        """Apply the configured regularizer to a gradient: L2 adds coeff*p,
+        L1Decay adds coeff*sign(p)."""
+        if not self._weight_decay:
+            return g
+        if self._wd_l1:
+            return g + self._weight_decay * jnp.sign(p)
+        return g + self._weight_decay * p
 
     # -- subclass interface -------------------------------------------------
     def _init_state(self, p: Tensor) -> dict:
@@ -153,8 +162,7 @@ class Optimizer:
 
 class SGD(Optimizer):
     def _update(self, pv, gv, state, lr, step):
-        if self._weight_decay:
-            gv = gv + self._weight_decay * pv
+        gv = self._decayed(gv, pv)
         return pv - lr.astype(pv.dtype) * gv, state
 
 
@@ -170,8 +178,7 @@ class Momentum(Optimizer):
         return {"velocity": jnp.zeros_like(p._value)}
 
     def _update(self, pv, gv, state, lr, step):
-        if self._weight_decay:
-            gv = gv + self._weight_decay * pv
+        gv = self._decayed(gv, pv)
         v = self._momentum * state["velocity"] + gv
         if self._nesterov:
             upd = gv + self._momentum * v
@@ -282,8 +289,7 @@ class Adamax(Optimizer):
     def _update(self, pv, gv, state, lr, step):
         g32 = gv.astype(jnp.float32)
         p32 = pv.astype(jnp.float32)
-        if self._weight_decay:
-            g32 = g32 + self._weight_decay * p32
+        g32 = self._decayed(g32, p32)
         m = self._b1 * state["m"] + (1 - self._b1) * g32
         u = jnp.maximum(self._b2 * state["u"], jnp.abs(g32))
         t = step.astype(jnp.float32)
@@ -304,8 +310,7 @@ class Adagrad(Optimizer):
     def _update(self, pv, gv, state, lr, step):
         g32 = gv.astype(jnp.float32)
         p32 = pv.astype(jnp.float32)
-        if self._weight_decay:
-            g32 = g32 + self._weight_decay * p32
+        g32 = self._decayed(g32, p32)
         acc = state["acc"] + jnp.square(g32)
         new = p32 - lr * g32 / (jnp.sqrt(acc) + self._eps)
         return new.astype(pv.dtype), {"acc": acc}
@@ -324,8 +329,7 @@ class Adadelta(Optimizer):
     def _update(self, pv, gv, state, lr, step):
         g32 = gv.astype(jnp.float32)
         p32 = pv.astype(jnp.float32)
-        if self._weight_decay:
-            g32 = g32 + self._weight_decay * p32
+        g32 = self._decayed(g32, p32)
         avg_sq = self._rho * state["avg_sq"] + (1 - self._rho) * jnp.square(g32)
         upd = jnp.sqrt(state["avg_upd"] + self._eps) / jnp.sqrt(avg_sq + self._eps) * g32
         avg_upd = self._rho * state["avg_upd"] + (1 - self._rho) * jnp.square(upd)
@@ -348,8 +352,7 @@ class RMSProp(Optimizer):
     def _update(self, pv, gv, state, lr, step):
         g32 = gv.astype(jnp.float32)
         p32 = pv.astype(jnp.float32)
-        if self._weight_decay:
-            g32 = g32 + self._weight_decay * p32
+        g32 = self._decayed(g32, p32)
         ms = self._rho * state["ms"] + (1 - self._rho) * jnp.square(g32)
         if self._centered:
             mg = self._rho * state["mg"] + (1 - self._rho) * g32
@@ -444,8 +447,7 @@ class ASGD(Optimizer):
     def _update(self, pv, gv, state, lr, step):
         g32 = gv.astype(jnp.float32)
         p32 = pv.astype(jnp.float32)
-        if self._weight_decay:
-            g32 = g32 + self._weight_decay * p32
+        g32 = self._decayed(g32, p32)
         # reference asgd op: update with the average of the last batch_num
         # grads (circular window d = d - oldest + g)
         n = self._batch_num
@@ -486,8 +488,7 @@ class NAdam(Optimizer):
     def _update(self, pv, gv, state, lr, step):
         g32 = gv.astype(jnp.float32)
         p32 = pv.astype(jnp.float32)
-        if self._weight_decay:
-            g32 = g32 + self._weight_decay * p32
+        g32 = self._decayed(g32, p32)
         t = step.astype(jnp.float32)
         mu_t = self._b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
         mu_t1 = self._b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
@@ -519,8 +520,7 @@ class RAdam(Optimizer):
     def _update(self, pv, gv, state, lr, step):
         g32 = gv.astype(jnp.float32)
         p32 = pv.astype(jnp.float32)
-        if self._weight_decay:
-            g32 = g32 + self._weight_decay * p32
+        g32 = self._decayed(g32, p32)
         t = step.astype(jnp.float32)
         m = self._b1 * state["m"] + (1 - self._b1) * g32
         v = self._b2 * state["v"] + (1 - self._b2) * jnp.square(g32)
@@ -667,8 +667,7 @@ class LBFGS(Optimizer):
             loss = None
         x = self._flat_params()
         g = self._flat_grads()
-        if self._weight_decay:
-            g = g + self._weight_decay * x
+        g = self._decayed(g, x)
         if self._prev_flat is not None:
             s = x - self._prev_flat
             y = g - self._prev_grad
@@ -728,7 +727,10 @@ class LBFGS(Optimizer):
             self._assign_flat(flat)
             val = float(self._eval_closure(closure))
             if self._weight_decay:
-                val += 0.5 * self._weight_decay * float(jnp.dot(flat, flat))
+                if self._wd_l1:
+                    val += self._weight_decay * float(jnp.sum(jnp.abs(flat)))
+                else:
+                    val += 0.5 * self._weight_decay * float(jnp.dot(flat, flat))
             return val
 
         gtd = float(jnp.dot(g, d))

@@ -1,0 +1,34 @@
+"""paddle.regularizer parity (reference: python/paddle/regularizer.py).
+The coefficient objects optimizers read via their weight_decay parameter."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    """Weight decay coefficient holder (optimizers read `_coeff`)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay:
+    """L1 regularization: optimizers add coeff * sign(p) to the gradient."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self._l1 = True
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"

@@ -1,0 +1,136 @@
+"""paddle.signal parity (reference: python/paddle/signal.py — stft/istft).
+TPU-native: framing via gather (static hops), FFT via jnp.fft — the whole
+spectrogram is one XLA program."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _check_axis(axis):
+    if axis not in (0, -1):
+        raise ValueError(f"axis must be 0 or -1 (reference contract), got {axis}")
+
+
+def frame(x, frame_length: int, hop_length: int, axis=-1, name=None):
+    """Overlapping frames (reference signal.py frame): axis=-1 ->
+    [..., frame_length, num_frames]; axis=0 -> [num_frames, frame_length, ...]."""
+    _check_axis(axis)
+
+    def f(v):
+        n = v.shape[0] if axis == 0 else v.shape[-1]
+        n_frames = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        if axis == 0:
+            return jnp.take(v, idx, axis=0)  # [num_frames, frame_length, ...]
+        out = jnp.take(v, idx, axis=-1)      # [..., num_frames, frame_length]
+        return jnp.swapaxes(out, -1, -2)     # [..., frame_length, num_frames]
+
+    return apply_op(f, x, name="frame")
+
+
+def overlap_add(x, hop_length: int, axis=-1, name=None):
+    """Inverse of frame (reference signal.py overlap_add): axis=-1 input
+    [..., frame_length, num_frames] -> [..., n]; axis=0 input
+    [num_frames, frame_length, ...] -> [n, ...]."""
+    _check_axis(axis)
+
+    def f(v):
+        if axis == 0:  # -> [..., frame_length, num_frames]
+            v = jnp.moveaxis(jnp.moveaxis(v, 0, -1), 0, -2)
+        v = jnp.swapaxes(v, -1, -2)          # [..., num_frames, frame_length]
+        n_frames, flen = v.shape[-2], v.shape[-1]
+        n = (n_frames - 1) * hop_length + flen
+        starts = jnp.arange(n_frames) * hop_length
+        idx = (starts[:, None] + jnp.arange(flen)[None, :]).reshape(-1)
+        lead = v.shape[:-2]
+        out = jnp.zeros(lead + (n,), v.dtype)
+        out = out.at[..., idx].add(v.reshape(lead + (n_frames * flen,)))
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply_op(f, x, name="overlap_add")
+
+
+def stft(x, n_fft: int, hop_length: int | None = None,
+         win_length: int | None = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True):
+    """reference signal.py stft: returns [..., n_fft//2+1 (or n_fft), n_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones(win_length, jnp.float32)
+    else:
+        win = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+    if win_length < n_fft:  # center-pad the window to n_fft (reference)
+        pad = n_fft - win_length
+        win = jnp.pad(win, (pad // 2, pad - pad // 2))
+
+    def f(v):
+        if center:
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+                        mode=pad_mode)
+        n = v.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = jnp.take(v, idx, axis=-1) * win  # [..., n_frames, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, n_frames]
+
+    return apply_op(f, x, name="stft")
+
+
+def istft(x, n_fft: int, hop_length: int | None = None,
+          win_length: int | None = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True, length=None,
+          return_complex: bool = False):
+    """reference signal.py istft (WOLA reconstruction)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones(win_length, jnp.float32)
+    else:
+        win = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+    if win_length < n_fft:
+        pad = n_fft - win_length
+        win = jnp.pad(win, (pad // 2, pad - pad // 2))
+
+    def f(v):
+        spec = jnp.swapaxes(v, -1, -2)  # [..., n_frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win
+        n_frames = frames.shape[-2]
+        n = (n_frames - 1) * hop_length + n_fft
+        starts = jnp.arange(n_frames) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        lead = frames.shape[:-2]
+        out = jnp.zeros(lead + (n,), frames.dtype)
+        out = out.at[..., idx].add(frames.reshape(lead + (n_frames * n_fft,)))
+        # WOLA normalization by the summed squared window
+        wsq = jnp.zeros(n, win.dtype).at[idx].add(
+            jnp.tile(win * win, n_frames))
+        out = out / jnp.maximum(wsq, 1e-10)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_op(f, x, name="istft")

@@ -1,0 +1,214 @@
+"""paddle.fft / paddle.signal / paddle.linalg namespace / paddle.distribution
+(reference: python/paddle/fft.py, signal.py, linalg.py, distribution/)."""
+import math
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+class TestFFT:
+    def test_fft_roundtrip_and_numpy_parity(self):
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        t = paddle.to_tensor(x)
+        got = np.asarray(paddle.fft.fft(t)._value)
+        np.testing.assert_allclose(got, np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        back = np.asarray(paddle.fft.ifft(paddle.fft.fft(t))._value)
+        np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-5)
+
+    def test_rfft_irfft(self):
+        x = np.random.RandomState(1).randn(8, 32).astype(np.float32)
+        t = paddle.to_tensor(x)
+        got = np.asarray(paddle.fft.rfft(t)._value)
+        np.testing.assert_allclose(got, np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+        back = np.asarray(paddle.fft.irfft(paddle.fft.rfft(t))._value)
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+    def test_fft2_fftn_shift_freq(self):
+        x = np.random.RandomState(2).randn(4, 8, 8).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(np.asarray(paddle.fft.fft2(t)._value),
+                                   np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(paddle.fft.fftn(t)._value),
+                                   np.fft.fftn(x), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(paddle.fft.fftfreq(8)._value),
+                                   np.fft.fftfreq(8).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.fftshift(paddle.fft.fftfreq(8))._value),
+            np.fft.fftshift(np.fft.fftfreq(8)).astype(np.float32))
+
+    def test_grad_through_rfft(self):
+        x = paddle.to_tensor(np.random.RandomState(3).randn(16).astype(np.float32),
+                             stop_gradient=False)
+        spec = paddle.fft.rfft(x)
+        mag = (spec * spec.conj()).real().sum() if hasattr(spec, "conj") else None
+        if mag is None:
+            import paddle_tpu.ops as _
+
+            mag = paddle.real(spec * paddle.conj(spec)).sum()
+        mag.backward()
+        assert x.grad is not None
+        assert np.abs(np.asarray(x.grad._value)).sum() > 0
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip(self):
+        x = np.arange(32, dtype=np.float32)[None]
+        t = paddle.to_tensor(x)
+        frames = paddle.signal.frame(t, frame_length=8, hop_length=8)
+        # reference layout: [..., frame_length, num_frames]
+        assert frames.shape == [1, 8, 4]
+        back = paddle.signal.overlap_add(frames, hop_length=8)
+        np.testing.assert_allclose(np.asarray(back._value), x)
+
+    def test_frame_reference_example_and_axis0(self):
+        # the reference docstring example: frame(arange(8), 4, 2) -> [4, 3]
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+        y = np.asarray(paddle.signal.frame(x, 4, 2)._value)
+        np.testing.assert_array_equal(
+            y, [[0, 2, 4], [1, 3, 5], [2, 4, 6], [3, 5, 7]])
+        y0 = np.asarray(paddle.signal.frame(x, 4, 2, axis=0)._value)
+        assert y0.shape == (3, 4)
+        np.testing.assert_array_equal(y0[1], [2, 3, 4, 5])
+        back = paddle.signal.overlap_add(
+            paddle.to_tensor(y0), hop_length=4, axis=0)
+        np.testing.assert_array_equal(np.asarray(back._value)[:4], [0, 1, 2, 3])
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            paddle.signal.frame(x, 4, 2, axis=1)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 400).astype(np.float32)
+        t = paddle.to_tensor(x)
+        win = paddle.to_tensor(np.hanning(128).astype(np.float32))
+        spec = paddle.signal.stft(t, n_fft=128, hop_length=32, window=win)
+        assert spec.shape[1] == 65  # onesided bins
+        back = paddle.signal.istft(spec, n_fft=128, hop_length=32, window=win)
+        b = np.asarray(back._value)
+        # compare the fully-overlapped interior (istft covers the frames'
+        # span, which is shorter than the input when hops don't tile it)
+        n = min(b.shape[1], 400)
+        np.testing.assert_allclose(b[:, 64:n - 64], x[:, 64:n - 64],
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_stft_matches_scipy(self):
+        from scipy.signal import stft as sp_stft
+
+        x = np.random.RandomState(1).randn(256).astype(np.float32)
+        spec = np.asarray(paddle.signal.stft(
+            paddle.to_tensor(x[None]), n_fft=64, hop_length=32,
+            window=paddle.to_tensor(np.hanning(64).astype(np.float32)),
+            center=False)._value)[0]
+        _, _, ref = sp_stft(x, nperseg=64, noverlap=32,
+                            window=np.hanning(64), boundary=None,
+                            padded=False)
+        # scipy normalizes by window sum; compare up to that scale
+        scale = np.hanning(64).sum()
+        np.testing.assert_allclose(spec, ref * scale, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_namespace():
+    a = np.random.RandomState(0).randn(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    t = paddle.to_tensor(spd)
+    np.testing.assert_allclose(float(paddle.linalg.det(t)),
+                               np.linalg.det(spd), rtol=1e-4)
+    assert paddle.linalg.cholesky(t).shape == [3, 3]
+    assert set(["svd", "qr", "eigh", "solve"]) <= set(paddle.linalg.__all__)
+
+
+class TestDistributions:
+    def setup_method(self):
+        paddle.seed(0)
+
+    def test_normal_moments_logprob_kl(self):
+        d = D.Normal(1.0, 2.0)
+        s = d.sample((20000,))
+        arr = np.asarray(s._value)
+        assert abs(arr.mean() - 1.0) < 0.1 and abs(arr.std() - 2.0) < 0.1
+        lp = float(d.log_prob(paddle.to_tensor(np.float32(0.5))))
+        np.testing.assert_allclose(lp, st.norm(1, 2).logpdf(0.5), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()), st.norm(1, 2).entropy(),
+                                   rtol=1e-5)
+        kl = float(D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)))
+        want = (math.log(2.0) + (1 + 1) / (2 * 4) - 0.5)
+        np.testing.assert_allclose(kl, want, rtol=1e-5)
+
+    @pytest.mark.parametrize("d,ref", [
+        (lambda: D.Uniform(-1.0, 3.0), st.uniform(-1, 4)),
+        (lambda: D.Exponential(2.0), st.expon(scale=0.5)),
+        (lambda: D.Laplace(0.5, 1.5), st.laplace(0.5, 1.5)),
+        (lambda: D.Gumbel(0.0, 2.0), st.gumbel_r(0, 2)),
+        (lambda: D.Gamma(3.0, 2.0), st.gamma(3, scale=0.5)),
+        (lambda: D.Beta(2.0, 5.0), st.beta(2, 5)),
+        (lambda: D.LogNormal(0.0, 0.5), st.lognorm(0.5)),
+    ])
+    def test_continuous_logprob_matches_scipy(self, d, ref):
+        dist = d()
+        x = np.asarray(dist.sample((5,))._value)
+        lp = np.asarray(dist.log_prob(paddle.to_tensor(x.astype(np.float32)))._value)
+        np.testing.assert_allclose(lp, ref.logpdf(x), rtol=1e-3, atol=1e-4)
+
+    def test_discrete(self):
+        b = D.Bernoulli(0.3)
+        s = np.asarray(b.sample((20000,))._value)
+        assert abs(s.mean() - 0.3) < 0.02
+        np.testing.assert_allclose(float(b.log_prob(paddle.to_tensor(1.0))),
+                                   math.log(0.3), rtol=1e-4)
+
+        c = D.Categorical(logits=np.log(np.array([0.2, 0.3, 0.5], np.float32)))
+        s = np.asarray(c.sample((30000,))._value)
+        freq = np.bincount(s, minlength=3) / len(s)
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+        np.testing.assert_allclose(float(c.entropy()),
+                                   st.entropy([0.2, 0.3, 0.5]), rtol=1e-4)
+
+        p = D.Poisson(4.0)
+        np.testing.assert_allclose(float(p.log_prob(paddle.to_tensor(3.0))),
+                                   st.poisson(4).logpmf(3), rtol=1e-4)
+
+        g = D.Geometric(0.25)
+        np.testing.assert_allclose(float(g.log_prob(paddle.to_tensor(2.0))),
+                                   st.geom(0.25, loc=-1).logpmf(2), rtol=1e-4)
+
+    def test_dirichlet_multinomial(self):
+        d = D.Dirichlet(np.array([2.0, 3.0, 5.0], np.float32))
+        s = np.asarray(d.sample((1000,))._value)
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.03)
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(
+            float(d.log_prob(paddle.to_tensor(x))),
+            st.dirichlet([2.0, 3.0, 5.0]).logpdf(x), rtol=1e-4)
+
+        m = D.Multinomial(10, np.array([0.2, 0.8], np.float32))
+        s = np.asarray(m.sample((500,))._value)
+        assert s.shape == (500, 2) and np.all(s.sum(-1) == 10)
+        np.testing.assert_allclose(
+            float(m.log_prob(paddle.to_tensor(np.array([3.0, 7.0], np.float32)))),
+            st.multinomial(10, [0.2, 0.8]).logpmf([3, 7]), rtol=1e-4)
+
+    def test_rsample_reparameterized_grads(self):
+        loc = paddle.to_tensor(np.float32(0.0), stop_gradient=False)
+        # rsample path: d/dloc E[x] == 1 pathwise
+        d = D.Normal(loc, 1.0)
+        s = d.rsample((64,))
+        s.mean().backward()
+        np.testing.assert_allclose(float(loc.grad), 1.0, rtol=1e-5)
+
+    def test_kl_registry_extensible(self):
+        class MyDist(D.Normal):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl(p, q):
+            return paddle.to_tensor(np.float32(42.0))
+
+        assert float(D.kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0))) == 42.0
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Poisson(1.0), D.Beta(1.0, 1.0))

@@ -289,3 +289,23 @@ class TestOptimizerTail:
         st = opt._state[id(w)]
         # same-sign grads grow the per-weight step
         assert float(st["step_size"][0]) > 0.1
+
+
+def test_l1decay_applies_sign_regularization():
+    """L1Decay must add coeff*sign(p), not coeff*p (reference regularizer)."""
+    w_np = np.array([2.0, -3.0], np.float32)
+    g_np = np.array([0.0, 0.0], np.float32)
+
+    w1 = nn.Parameter(paddle.to_tensor(w_np)._value)
+    opt1 = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w1],
+                                weight_decay=paddle.L1Decay(0.1))
+    w1.grad = paddle.to_tensor(g_np)
+    opt1.step()
+    np.testing.assert_allclose(w1.numpy(), [2.0 - 0.1, -3.0 + 0.1], rtol=1e-6)
+
+    w2 = nn.Parameter(paddle.to_tensor(w_np)._value)
+    opt2 = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w2],
+                                weight_decay=paddle.L2Decay(0.1))
+    w2.grad = paddle.to_tensor(g_np)
+    opt2.step()
+    np.testing.assert_allclose(w2.numpy(), w_np * 0.9, rtol=1e-6)
