@@ -42,6 +42,7 @@ from paddle_tpu import autograd  # noqa: F401
 from paddle_tpu import device  # noqa: F401
 from paddle_tpu import distributed  # noqa: F401
 from paddle_tpu import framework  # noqa: F401
+from paddle_tpu import geometric  # noqa: F401
 from paddle_tpu import io  # noqa: F401
 from paddle_tpu import distribution  # noqa: F401
 from paddle_tpu import fft  # noqa: F401

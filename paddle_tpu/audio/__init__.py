@@ -108,3 +108,28 @@ class features:
             return apply_op(
                 lambda mv, d: jnp.einsum("...mt,mk->...kt", jnp.log(mv + 1e-6), d),
                 m, self.dct, name="mfcc")
+
+    class LogMelSpectrogram:
+        """reference paddle.audio.features.LogMelSpectrogram."""
+
+        def __init__(self, sr=16000, n_fft=512, hop_length=None, n_mels=64,
+                     f_min=0.0, f_max=None, power=2.0, ref_value=1.0,
+                     amin=1e-10, top_db=None):
+            self.mel = features.MelSpectrogram(sr, n_fft, hop_length, n_mels,
+                                               f_min, f_max, power)
+            self.ref = ref_value
+            self.amin = amin
+            self.top_db = top_db
+
+        def __call__(self, x: Tensor):
+            m = self.mel(x)
+
+            def f(mv):
+                db = 10.0 * jnp.log10(jnp.maximum(mv, self.amin))
+                db = db - 10.0 * jnp.log10(jnp.maximum(self.ref, self.amin))
+                if self.top_db is not None:
+                    db = jnp.maximum(db, db.max() - self.top_db)
+                return db
+
+            return apply_op(f, m, name="log_mel")
+

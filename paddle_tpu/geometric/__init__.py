@@ -1,0 +1,119 @@
+"""paddle.geometric parity (reference: python/paddle/geometric — graph
+message passing over segment reductions).
+
+TPU-native: segment_sum/mean/max/min and gather-scatter message passing are
+jax.ops.segment_* / scatter ops with STATIC num_segments — one XLA program,
+MXU-free but fusion-friendly. The send_u_recv / send_ue_recv surfaces match
+the reference message_passing API.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _nseg(segment_ids, num_segments):
+    if num_segments is not None:
+        return int(num_segments)
+    import numpy as np
+
+    ids = segment_ids._value if isinstance(segment_ids, Tensor) else segment_ids
+    return int(np.asarray(ids).max()) + 1 if np.asarray(ids).size else 0
+
+
+def segment_sum(data, segment_ids, num_segments=None, name=None):
+    """reference geometric/math.py segment_sum."""
+    n = _nseg(segment_ids, num_segments)
+    return apply_op(
+        lambda d, i: jax.ops.segment_sum(d, i.astype(jnp.int32), num_segments=n),
+        data, segment_ids, name="segment_sum")
+
+
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    n = _nseg(segment_ids, num_segments)
+
+    def f(d, i):
+        i = i.astype(jnp.int32)
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(d.shape[:1], d.dtype), i,
+                                  num_segments=n)
+        shape = cnt.shape + (1,) * (d.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+
+    return apply_op(f, data, segment_ids, name="segment_mean")
+
+
+def _segment_extreme(jfn, name):
+    def op(data, segment_ids, num_segments=None, _name=None):
+        n = _nseg(segment_ids, num_segments)
+
+        def f(d, i):
+            i = i.astype(jnp.int32)
+            out = jfn(d, i, num_segments=n)
+            # empty segments: the reference returns 0; detect them by COUNT
+            # (dtype-safe — isfinite would miss int fills and clobber real infs)
+            cnt = jax.ops.segment_sum(jnp.ones(d.shape[:1], jnp.int32), i,
+                                      num_segments=n)
+            shape = cnt.shape + (1,) * (d.ndim - 1)
+            return jnp.where(cnt.reshape(shape) > 0, out,
+                             jnp.zeros((), d.dtype))
+
+        return apply_op(f, data, segment_ids, name=name)
+
+    op.__name__ = name
+    return op
+
+
+segment_max = _segment_extreme(jax.ops.segment_max, "segment_max")
+segment_min = _segment_extreme(jax.ops.segment_min, "segment_min")
+
+
+_REDUCERS = {"sum": segment_sum, "mean": segment_mean,
+             "max": segment_max, "min": segment_min}
+_MESSAGE_OPS = {"add": lambda a, b: a + b, "sub": lambda a, b: a - b,
+                "mul": lambda a, b: a * b, "div": lambda a, b: a / b}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Graph message passing (reference message_passing/send_recv.py
+    send_u_recv): gather x at src, reduce at dst."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"reduce_op must be one of {sorted(_REDUCERS)}")
+    from paddle_tpu.ops.manipulation import gather
+
+    msgs = gather(x, src_index, axis=0)
+    n = out_size if out_size is not None else (
+        x.shape[0] if hasattr(x, "shape") else None)
+    return _REDUCERS[reduce_op](msgs, dst_index, num_segments=n)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """reference send_ue_recv: combine node features with edge features
+    (message_op) before the dst reduction."""
+    from paddle_tpu.ops.manipulation import gather
+
+    msgs = gather(x, src_index, axis=0)
+    if message_op not in _MESSAGE_OPS:
+        raise ValueError(f"message_op must be one of {sorted(_MESSAGE_OPS)}")
+    combined = apply_op(_MESSAGE_OPS[message_op], msgs, y, name=f"ue_{message_op}")
+    n = out_size if out_size is not None else (
+        x.shape[0] if hasattr(x, "shape") else None)
+    return _REDUCERS[reduce_op](combined, dst_index, num_segments=n)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """reference send_uv: per-edge messages from both endpoints (no reduce)."""
+    from paddle_tpu.ops.manipulation import gather
+
+    xs = gather(x, src_index, axis=0)
+    yd = gather(y, dst_index, axis=0)
+    if message_op not in _MESSAGE_OPS:
+        raise ValueError(f"message_op must be one of {sorted(_MESSAGE_OPS)}")
+    return apply_op(_MESSAGE_OPS[message_op], xs, yd, name=f"uv_{message_op}")

@@ -1,6 +1,7 @@
 """Experimental features (reference: python/paddle/incubate — MoE at
 incubate/distributed/models/moe, memory-efficient attention, ASP)."""
 from paddle_tpu.incubate.distributed.models.moe import MoELayer  # noqa: F401
+from paddle_tpu.incubate import asp  # noqa: F401
 from paddle_tpu.incubate import nn  # noqa: F401
 
-__all__ = ["MoELayer", "nn"]
+__all__ = ["MoELayer", "asp", "nn"]

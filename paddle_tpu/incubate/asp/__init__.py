@@ -1,0 +1,118 @@
+"""Automatic SParsity (reference: python/paddle/incubate/asp — 2:4
+semi-structured sparsity: prune weights to the n:m pattern, mask gradients
+so training preserves it).
+
+TPU-native: masks are plain jnp arrays applied at prune time and re-applied
+after every optimizer step by the decorated optimizer (the reference's
+OptimizerWithSparsityGuarantee). The 2:4 pattern keeps the MXU-friendly
+dense layout; sparsity is a model-size/regularity property here, not a
+kernel switch.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "add_supported_layer"]
+
+_EXCLUDED: set = set()
+_SUPPORTED_TYPES: list = []
+# masks live ON the pruned Tensor (attribute _asp_mask): no id-keyed registry
+# to leak or mis-hit after object ids are recycled
+
+
+def _supported_types():
+    import paddle_tpu.nn as nn
+
+    return tuple([nn.Linear] + _SUPPORTED_TYPES)
+
+
+def set_excluded_layers(layers, main_program=None):
+    """reference asp.set_excluded_layers: skip these layer names/objects."""
+    for l in layers if isinstance(layers, (list, tuple)) else [layers]:
+        _EXCLUDED.add(l if isinstance(l, str) else id(l))
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def add_supported_layer(layer_type):
+    _SUPPORTED_TYPES.append(layer_type)
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (reference utils.calculate_density)."""
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _nm_mask_2d(w: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Best n-of-m magnitude mask along the REDUCTION (input) dim — the
+    reference masks fc weights transposed (asp.py _default_pruning on
+    weight.T), so the n:m groups run down each output column. Linear weight
+    layout here is [in_features, out_features]."""
+    wt = w.T  # [out, in]: group along the in dim
+    rows, cols = wt.shape
+    pad = (-cols) % m
+    wp = np.pad(np.abs(wt), ((0, 0), (0, pad)))
+    groups = wp.reshape(rows, -1, m)
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    mask = mask.reshape(rows, -1)[:, :cols]
+    return mask.T.astype(w.dtype)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """reference asp.prune_model: apply n:m magnitude pruning to every
+    supported layer's weight and remember the masks."""
+    types = _supported_types()
+    pruned = {}
+    for name, sub in model.named_sublayers(include_self=True):
+        if not isinstance(sub, types) or name in _EXCLUDED or id(sub) in _EXCLUDED:
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None or len(w.shape) != 2:
+            continue
+        wv = np.asarray(w._value)
+        mask = _nm_mask_2d(wv, n, m)
+        w._set_value(jnp.asarray(wv * mask))
+        if with_mask:
+            w._asp_mask = jnp.asarray(mask)
+        pruned[name or type(sub).__name__] = calculate_density(w)
+    return pruned
+
+
+class OptimizerWithSparsityGuarantee:
+    """reference asp/asp.py OptimizerWithSparsityGuarantee: every step()
+    re-applies the pruning masks so updates cannot resurrect pruned weights."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner"], name)
+
+    def step(self, *a, **k):
+        out = self._inner.step(*a, **k)
+        for p in self._inner._params:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._set_value(p._value * mask.astype(p._value.dtype))
+        return out
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._inner._params]
+
+
+def decorate(optimizer):
+    """reference asp.decorate."""
+    return OptimizerWithSparsityGuarantee(optimizer)

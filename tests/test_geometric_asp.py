@@ -1,0 +1,115 @@
+"""paddle.geometric segment/message-passing ops + incubate.asp 2:4 sparsity
+(reference: python/paddle/geometric, python/paddle/incubate/asp)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import geometric as G
+from paddle_tpu.incubate import asp
+
+
+class TestGeometric:
+    def test_segment_reductions(self):
+        data = paddle.to_tensor(np.array([[1.0, 2], [3, 4], [5, 6], [7, 8]],
+                                         np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int64))
+        np.testing.assert_allclose(
+            np.asarray(G.segment_sum(data, ids)._value), [[4, 6], [12, 14]])
+        np.testing.assert_allclose(
+            np.asarray(G.segment_mean(data, ids)._value), [[2, 3], [6, 7]])
+        np.testing.assert_allclose(
+            np.asarray(G.segment_max(data, ids)._value), [[3, 4], [7, 8]])
+        np.testing.assert_allclose(
+            np.asarray(G.segment_min(data, ids)._value), [[1, 2], [5, 6]])
+        # empty segment -> 0 (reference behavior)
+        out = np.asarray(G.segment_max(data, ids, num_segments=3)._value)
+        np.testing.assert_allclose(out[2], [0, 0])
+
+    def test_send_u_recv_matches_manual(self):
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int64))
+        out = np.asarray(G.send_u_recv(x, src, dst, reduce_op="sum")._value)
+        want = np.zeros((4, 2), np.float32)
+        xs = np.arange(8, dtype=np.float32).reshape(4, 2)
+        for s, d in zip([0, 1, 2, 0], [1, 2, 1, 0]):
+            want[d] += xs[s]
+        np.testing.assert_allclose(out, want)
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        e = paddle.to_tensor(np.full((3, 2), 2.0, np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        dst = paddle.to_tensor(np.array([0, 0, 0], np.int64))
+        out = np.asarray(G.send_ue_recv(x, e, src, dst, "mul", "sum")._value)
+        np.testing.assert_allclose(out[0], [6.0, 6.0])
+        uv = np.asarray(G.send_uv(x, x, src, dst, "add")._value)
+        np.testing.assert_allclose(uv, np.full((3, 2), 2.0))
+
+    def test_grads_flow_through_segment_sum(self):
+        x = paddle.to_tensor(np.ones((4, 2), np.float32), stop_gradient=False)
+        ids = paddle.to_tensor(np.array([0, 1, 0, 1], np.int64))
+        G.segment_sum(x, ids).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value), np.ones((4, 2)))
+
+
+class TestASP:
+    def test_prune_to_2_4_and_density(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+        assert asp.calculate_density(net[0].weight) == 1.0
+        asp.prune_model(net)
+        d = asp.calculate_density(net[0].weight)
+        assert abs(d - 0.5) < 1e-6
+        # reference convention: groups of 4 along the REDUCTION (input) dim,
+        # 2 survivors per group in every output column
+        w = np.asarray(net[0].weight._value)  # [in=16, out=8]
+        per_group = (w.reshape(-1, 4, w.shape[1]) != 0).sum(1)
+        assert np.all(per_group == 2)
+
+    def test_decorated_optimizer_preserves_mask(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8))
+        asp.prune_model(net)
+        zero_mask = np.asarray(net[0].weight._value) == 0
+        opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                                parameters=net.parameters()))
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        for _ in range(3):
+            loss = (net(x) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        w = np.asarray(net[0].weight._value)
+        assert np.all(w[zero_mask] == 0), "pruned weights resurrected"
+        assert abs(asp.calculate_density(net[0].weight) - 0.5) < 1e-6
+
+    def test_excluded_layers(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers(["0"])
+        try:
+            asp.prune_model(net)
+            assert asp.calculate_density(net[0].weight) == 1.0
+            assert abs(asp.calculate_density(net[1].weight) - 0.5) < 1e-6
+        finally:
+            asp.reset_excluded_layers()
+
+
+def test_log_mel_spectrogram():
+    from paddle_tpu.audio import features
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 4096).astype(np.float32))
+    lm = features.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=32)
+    out = np.asarray(lm(x)._value)
+    assert out.shape[1] == 32
+    assert np.isfinite(out).all()
+
+
+def test_segment_max_int_dtype_empty_segment():
+    """int data: empty segments must read 0, not iinfo.min (count-based fill)."""
+    data = paddle.to_tensor(np.array([[5], [7]], np.int32))
+    ids = paddle.to_tensor(np.array([0, 0], np.int64))
+    out = np.asarray(G.segment_max(data, ids, num_segments=2)._value)
+    np.testing.assert_array_equal(out, [[7], [0]])
