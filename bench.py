@@ -134,13 +134,14 @@ def main():
     on_tpu = jax.devices()[0].platform != "cpu"
 
     if on_tpu:
-        # largest LLaMA fitting 16GB with full AdamW state at the best-MFU
-        # batch (bs4 x seq2048, swept in round 3): 645M params
+        # largest LLaMA fitting 16GB with full AdamW state (645M params) at
+        # the NORTH-STAR context length: LLaMA-2's seq 4096 (round-3 sweep:
+        # bs2 x 4096 with flash tiles (512,1024) reaches ~0.78 MFU)
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
                           num_hidden_layers=10, num_attention_heads=16,
-                          num_key_value_heads=16, max_position_embeddings=2048,
+                          num_key_value_heads=16, max_position_embeddings=4096,
                           use_parallel_cross_entropy=False)
-        batch, seq, iters = 4, 2048, 20
+        batch, seq, iters = 2, 4096, 20
     else:  # CPU smoke (CI)
         cfg = LlamaConfig(vocab_size=1024, hidden_size=128, intermediate_size=256,
                           num_hidden_layers=2, num_attention_heads=4,

@@ -9,8 +9,9 @@ from paddle_tpu.distributed.mesh import build_mesh, get_mesh, set_mesh  # noqa: 
 from paddle_tpu.distributed.collective import (  # noqa: F401
     P2POp, Group, ReduceOp, all_gather, all_gather_object, all_reduce,
     all_to_all, all_to_all_single, barrier, batch_isend_irecv, broadcast,
-    broadcast_object_list, gather, get_group, irecv, isend, new_group, recv,
-    reduce, reduce_scatter, scatter, send, stream, wait,
+    broadcast_object_list, gather, get_group, irecv, isend, new_group,
+    partial_allgather, partial_recv, partial_send, recv, reduce,
+    reduce_scatter, scatter, send, stream, wait,
 )
 from paddle_tpu.distributed.parallel import (  # noqa: F401
     DataParallel, init_parallel_env, is_initialized,

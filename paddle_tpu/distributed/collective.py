@@ -41,7 +41,8 @@ __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
     "all_gather_object", "all_to_all", "all_to_all_single", "reduce",
     "reduce_scatter", "broadcast", "broadcast_object_list", "scatter", "gather",
-    "send", "recv", "isend", "irecv", "barrier", "wait", "P2POp",
+    "send", "recv", "isend", "irecv", "partial_send", "partial_recv",
+    "partial_allgather", "barrier", "wait", "P2POp",
     "batch_isend_irecv", "stream",
 ]
 
@@ -420,6 +421,90 @@ def isend(tensor, dst=0, group=None):
 
 def irecv(tensor, src=0, group=None):
     return recv(tensor, src, group)
+
+
+# ---- partial p2p (reference four_directions_p2p_communication.py:208
+# _partial_send_op/_partial_recv_op/_partial_allgather_op: ship only this
+# mp rank's 1/nranks slice of a pipeline activation, then reassemble) -------
+
+def _partial_slice(numel: int, nranks: int, rank_id: int):
+    if numel % nranks != 0:
+        raise ValueError(f"partial op: numel {numel} not divisible by nranks {nranks}")
+    per = numel // nranks
+    return rank_id * per, per
+
+
+def partial_send(tensor, dst=0, nranks=1, rank_id=0, group=None):
+    """Send the rank_id-th 1/nranks slice of the flattened tensor."""
+    flat = tensor.reshape([-1])
+    start, per = _partial_slice(flat.shape[0], nranks, rank_id)
+    return send(flat[start:start + per], dst=dst, group=group)
+
+
+def partial_recv(tensor, src=0, nranks=1, rank_id=0, group=None):
+    """Receive into the rank_id-th 1/nranks slice of `tensor` (in place).
+    Bound-axes first, like recv(): in-graph tracing must never reach the
+    host-side store path."""
+    if _bound_axes(_axis_names(group)):
+        return recv(tensor, src=src, group=group)
+    shape = list(tensor.shape)
+    numel = int(np.prod(shape)) if shape else 1
+    start, per = _partial_slice(numel, nranks, rank_id)
+    if multiproc.cross_process_active():
+        piece = multiproc.store_recv(src)
+        flat = jnp.asarray(np.asarray(tensor._value)).reshape(-1)
+        flat = flat.at[start:start + per].set(jnp.asarray(piece).reshape(-1))
+        tensor._set_value(flat.reshape(shape))
+        return tensor
+    return recv(tensor, src=src, group=group)
+
+
+def partial_allgather(tensor, nranks, rank_id, group=None):
+    """All-gather the slices back into the full flattened tensor (in place):
+    each member contributes its own 1/nranks slice."""
+    shape = list(tensor.shape)
+    numel = int(np.prod(shape)) if shape else 1
+    start, per = _partial_slice(numel, nranks, rank_id)
+    axes = _bound_axes(_axis_names(group))
+    if axes:
+        ax = axes if len(axes) > 1 else axes[0]
+
+        def f(v):
+            # each DEVICE contributes the slice at its own axis position —
+            # the host-side rank_id would bake one index into the SPMD trace
+            flat = v.reshape(-1)
+            idx = jax.lax.axis_index(axes[0]) if len(axes) == 1 else (
+                jax.lax.axis_index(axes))
+            piece = jax.lax.dynamic_slice_in_dim(flat, idx * per, per)
+            return jax.lax.all_gather(piece, ax, tiled=True).reshape(v.shape)
+
+        out = apply_op(f, tensor, name="partial_allgather")
+        tensor._set_value(out._value)
+        tensor._grad_node = out._grad_node
+        return tensor
+    if multiproc.cross_process_active():
+        ranks = _group_ranks(group)
+        members = sorted(ranks or range(multiproc.num_processes()))
+        if len(members) != nranks:
+            raise ValueError(
+                f"partial_allgather: nranks={nranks} != group size {len(members)}")
+        me = members.index(get_rank())
+        if me != rank_id:
+            raise ValueError(
+                f"partial_allgather: rank_id={rank_id} but this rank is group "
+                f"member {me}; reassembly is in member order")
+        flat = np.asarray(tensor._value).reshape(-1)
+        rows = multiproc.allgather_np(flat[start:start + per], ranks)
+        if rows.size != numel:
+            raise ValueError(
+                f"partial_allgather: gathered {rows.size} elements != {numel}")
+        tensor._set_value(jnp.asarray(rows.reshape(-1)).reshape(shape))
+        return tensor
+    if nranks > 1:
+        raise NotImplementedError(
+            "partial_allgather with nranks > 1 requires a multi-process job "
+            "or a bound mesh axis (single-process view cannot reassemble)")
+    return tensor
 
 
 @dataclass

@@ -68,6 +68,20 @@ def main():
         dist.recv(r, src=0)
         np.testing.assert_allclose(r.numpy(), np.arange(6, dtype=np.float32))
 
+    # partial p2p (reference four_directions_p2p partial_send/recv/allgather):
+    # ship only one 1/nranks slice, then reassemble
+    full = np.arange(8, dtype=np.float32)
+    if rank == 0:
+        dist.partial_send(paddle.to_tensor(full), dst=1, nranks=2, rank_id=1)
+    else:
+        buf = paddle.to_tensor(np.zeros(8, np.float32))
+        dist.partial_recv(buf, src=0, nranks=2, rank_id=1)
+        np.testing.assert_allclose(buf.numpy()[4:], full[4:])
+        np.testing.assert_allclose(buf.numpy()[:4], np.zeros(4))
+    pa = paddle.to_tensor(np.where(np.arange(8) // 4 == rank, full, 0.0).astype(np.float32))
+    dist.partial_allgather(pa, nranks=2, rank_id=rank)
+    np.testing.assert_allclose(pa.numpy(), full)
+
     # scatter from rank 0
     recv_t = paddle.to_tensor(np.zeros(2, np.float32))
     tl = ([paddle.to_tensor(np.array([1.0, 2.0], np.float32)),
