@@ -4,7 +4,8 @@ from __future__ import annotations
 
 from paddle_tpu.nn import functional as _F
 
-__all__ = ["memory_efficient_attention", "FusedLinear", "FusedMultiHeadAttention"]
+__all__ = ["memory_efficient_attention", "FusedLinear",
+           "FusedMultiHeadAttention", "FusedFeedForward"]
 
 
 def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0, scale=None,
@@ -17,4 +18,6 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0, scale=N
 
 
 from paddle_tpu.nn.layer.common import Linear as FusedLinear  # noqa: E402
-from paddle_tpu.nn.layer.transformer import MultiHeadAttention as FusedMultiHeadAttention  # noqa: E402
+from paddle_tpu.incubate.nn.fused_transformer import (  # noqa: E402
+    FusedFeedForward, FusedMultiHeadAttention,
+)
