@@ -94,3 +94,5 @@ define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|hi
 define_flag("use_pallas_attention", True, "use the Pallas flash-attention kernel when available")
 define_flag("flash_block_q", 0, "flash-attention Q tile override (0 = auto-tuned default)", type=int)
 define_flag("flash_block_k", 0, "flash-attention K tile override (0 = auto-tuned default)", type=int)
+define_flag("flash_bwd_block_q", 0, "flash-attention BACKWARD Q tile override (0 = same as forward)", type=int)
+define_flag("flash_bwd_block_k", 0, "flash-attention BACKWARD K tile override (0 = same as forward)", type=int)

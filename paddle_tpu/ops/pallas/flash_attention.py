@@ -95,16 +95,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
     lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).astype(jnp.float32)
 
 
+def _validated_block(v, which, seq_len, prefix="flash_block"):
+    v = int(v)
+    if v <= 0 or seq_len % min(v, seq_len) != 0:
+        raise ValueError(
+            f"FLAGS_{prefix}_{which}={v} must be a positive divisor "
+            f"of seq_len={seq_len} (grid tiling would drop positions)")
+    return min(v, seq_len)
+
+
 def _pick_blocks(seq_len: int):
     from paddle_tpu.core.flags import flag
-
-    def _validated(v, which):
-        v = int(v)
-        if v <= 0 or seq_len % min(v, seq_len) != 0:
-            raise ValueError(
-                f"FLAGS_flash_block_{which}={v} must be a positive divisor "
-                f"of seq_len={seq_len} (grid tiling would drop positions)")
-        return min(v, seq_len)
 
     bq_f, bk_f = flag("flash_block_q"), flag("flash_block_k")
     if bq_f or bk_f:
@@ -114,12 +115,31 @@ def _pick_blocks(seq_len: int):
             warnings.warn("set BOTH FLAGS_flash_block_q and "
                           "FLAGS_flash_block_k; partial override ignored")
         else:
-            return _validated(bq_f, "q"), _validated(bk_f, "k")
+            return (_validated_block(bq_f, "q", seq_len),
+                    _validated_block(bk_f, "k", seq_len))
     # swept end-to-end on v5e at seq 2048 (round 3): (512, 1024) beats the
     # old (256, 512) default by ~7% MFU (0.725 -> 0.778)
     bq = next((b for b in (512, 256, 128) if seq_len % b == 0), seq_len)
     bk = next((b for b in (1024, 512, 128) if seq_len % b == 0), seq_len)
     return min(bq, seq_len), min(bk, seq_len)
+
+
+def _pick_blocks_bwd(seq_len: int):
+    """Backward kernels tile independently of the forward (different
+    arithmetic intensity); FLAGS_flash_bwd_block_q/k override."""
+    from paddle_tpu.core.flags import flag
+
+    bq_f, bk_f = flag("flash_bwd_block_q"), flag("flash_bwd_block_k")
+    if bq_f or bk_f:
+        if not (bq_f and bk_f):
+            import warnings
+
+            warnings.warn("set BOTH FLAGS_flash_bwd_block_q and "
+                          "FLAGS_flash_bwd_block_k; partial override ignored")
+        else:
+            return (_validated_block(bq_f, "q", seq_len, "flash_bwd_block"),
+                    _validated_block(bk_f, "k", seq_len, "flash_bwd_block"))
+    return _pick_blocks(seq_len)
 
 
 def _flash_fwd(q, k, v, causal: bool, scale: float, group: int, interpret: bool):
@@ -243,7 +263,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal: bool, scale: float, group: int,
     """Blocked flash-2 backward. q/do/out/lse: [BHq, ...]; k/v: [BHkv, ...]."""
     bhq, s, d = q.shape
     bhkv = k.shape[0]
-    block_q, block_k = _pick_blocks(s)
+    block_q, block_k = _pick_blocks_bwd(s)
     q_blocks, k_blocks = s // block_q, s // block_k
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
                     keepdims=True)                       # [BHq, S, 1]
