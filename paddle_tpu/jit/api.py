@@ -54,14 +54,17 @@ def _unwrap_tree(x):
 class StaticFunction:
     """A captured callable: params are implicit inputs, the body is one XLA program."""
 
-    def __init__(self, fn: Callable, layer=None, input_spec=None, backend=None):
+    def __init__(self, fn: Callable, layer=None, input_spec=None, backend=None,
+                 bucketize=False):
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
+        self._bucketize = bucketize
         functools.update_wrapper(self, fn, updated=())
         self._params: list[Tensor] | None = None
         self._jitted = None
         self._warmed = False
+        self.trace_count = 0  # diagnostics: how many programs were traced
 
     # -- functionalization --------------------------------------------------
     def _collect_params(self):
@@ -71,6 +74,7 @@ class StaticFunction:
 
     def _pure(self, param_vals: Sequence, args_vals: tuple, kwargs_vals: dict):
         """Run fn with params + inputs bound to (possibly traced) buffers."""
+        self.trace_count += 1
         params = self._params
         old = [p._value for p in params]
         try:
@@ -85,6 +89,56 @@ class StaticFunction:
             for p, v in zip(params, old):
                 p._set_value(v)
 
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Next power-of-two batch bucket (min 1) — SURVEY §7.3 hard part 5:
+        varying batch sizes hit a handful of compiled programs, not one per
+        distinct size."""
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _pad_to_buckets(self, args_vals):
+        """Pad dim 0 of each array input up to its bucket; return (padded,
+        (original_n, bucket)) or (args, None) when already bucket-sized."""
+        ns = [v.shape[0] for v in jax.tree_util.tree_leaves(args_vals)
+              if _is_arr(v) and getattr(v, "ndim", 0) >= 1]
+        if not ns or len(set(ns)) != 1:
+            return args_vals, None  # no shared batch dim: skip bucketing
+        n = ns[0]
+        b = self._bucket(n)
+        if b == n:
+            return args_vals, None
+
+        def pad(v):
+            if _is_arr(v) and getattr(v, "ndim", 0) >= 1 and v.shape[0] == n:
+                widths = [(0, b - n)] + [(0, 0)] * (v.ndim - 1)
+                return jnp.pad(jnp.asarray(v), widths)
+            return v
+
+        return jax.tree_util.tree_map(pad, args_vals), (n, b)
+
+    @staticmethod
+    def _slice_outputs(out_vals, n, b):
+        """Cut padded rows back out. Only leaves whose dim 0 equals the
+        padded bucket are sliced; a 0-d (reduced) output cannot be un-padded
+        and means the function mixed phantom rows into a reduction — raise
+        rather than return silently-wrong numbers."""
+
+        def cut(v):
+            if not _is_arr(v):
+                return v
+            if getattr(v, "ndim", 0) == 0:
+                raise ValueError(
+                    "bucketize=True requires per-row outputs: a scalar "
+                    "(batch-reduced) output would include the padded rows. "
+                    "Reduce outside the to_static function or disable "
+                    "bucketize.")
+            return v[:n] if v.shape[0] == b else v
+
+        return jax.tree_util.tree_map(cut, out_vals)
+
     def __call__(self, *args, **kwargs):
         if self._params is None:
             self._params = self._collect_params()
@@ -97,6 +151,16 @@ class StaticFunction:
             isinstance(t, Tensor) and not t.stop_gradient
             for t in jax.tree_util.tree_leaves(args, is_leaf=lambda v: isinstance(v, Tensor))
         )
+
+        bucket_info = None
+        if self._bucketize and not (needs_grad or in_grad):
+            if kwargs_vals:
+                import warnings
+
+                warnings.warn("bucketize=True is skipped for keyword-argument "
+                              "calls; pass batch inputs positionally")
+            else:
+                args_vals, bucket_info = self._pad_to_buckets(args_vals)
 
         if needs_grad or in_grad:
             # whole-program forward + whole-program vjp through the tape
@@ -113,6 +177,8 @@ class StaticFunction:
                 lambda pv, av, kv: self._pure(pv, av, kv),
             )
         out_vals = self._jitted([p._value for p in params], args_vals, kwargs_vals)
+        if bucket_info is not None:
+            out_vals = self._slice_outputs(out_vals, *bucket_info)
         return jax.tree_util.tree_map(lambda v: Tensor(v) if _is_arr(v) else v, out_vals)
 
     def warmup(self):
@@ -160,14 +226,20 @@ def _rewrap(out):
     return out
 
 
-def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
-    """Decorator/wrapper: compile a function or Layer.forward to one XLA program."""
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              bucketize=False, **kwargs):
+    """Decorator/wrapper: compile a function or Layer.forward to one XLA
+    program. bucketize=True pads a shared leading batch dim up to power-of-two
+    buckets (outputs sliced back), bounding recompiles under varying batch
+    sizes (SURVEY §7.3 shape bucketing; the reference predictor's dynamic-
+    shape strategy)."""
 
     def wrap(fn):
         from paddle_tpu.nn.layer.layers import Layer
 
         if isinstance(fn, Layer):
-            sf = StaticFunction(fn.forward, layer=fn, input_spec=input_spec, backend=backend)
+            sf = StaticFunction(fn.forward, layer=fn, input_spec=input_spec,
+                                backend=backend, bucketize=bucketize)
             fn.forward = sf
             if input_spec is not None:
                 try:
@@ -175,7 +247,8 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
                 except Exception:
                     pass  # warmup is an optimization; first call still compiles
             return fn
-        return StaticFunction(fn, layer=None, input_spec=input_spec, backend=backend)
+        return StaticFunction(fn, layer=None, input_spec=input_spec,
+                              backend=backend, bucketize=bucketize)
 
     if function is not None:
         return wrap(function)

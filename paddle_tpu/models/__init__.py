@@ -9,3 +9,6 @@ from paddle_tpu.models.bert import (  # noqa: F401
 from paddle_tpu.models.gpt_moe import (  # noqa: F401
     GptMoeConfig, GptMoeForCausalLM, gpt_moe_tiny_config,
 )
+from paddle_tpu.models.gpt import (  # noqa: F401
+    GptConfig, GptForCausalLM, gpt_tiny_config,
+)

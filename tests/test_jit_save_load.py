@@ -108,3 +108,60 @@ def test_save_dynamic_batch_spec(tmp_path):
         want = np.asarray(net(paddle.to_tensor(x))._value)
         got = np.asarray(loaded(paddle.to_tensor(x))._value)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_bucketize_bounds_recompiles():
+    """SURVEY §7.3 hard part 5: varying batch sizes must hit a handful of
+    power-of-two-bucketed programs, not one trace per distinct size."""
+    net = TinyNet()
+    for p in net.parameters():
+        p.stop_gradient = True
+    snet = paddle.jit.to_static(net, bucketize=True)
+    rng = np.random.RandomState(0)
+    outs = {}
+    for n in (3, 5, 7, 8, 12, 6, 3):
+        x = rng.randn(n, 4).astype(np.float32)
+        out = snet(paddle.to_tensor(x))
+        assert out.shape == [n, 2]
+        outs[n] = (x, np.asarray(out._value))
+    # buckets used: {4, 8, 16} -> at most 3 traces
+    assert snet.forward.trace_count <= 3, snet.forward.trace_count
+    # padded-and-sliced results equal DIRECT execution on an unwrapped twin
+    # (to_static mutates net.forward in place, so net itself is bucketized)
+    fresh = TinyNet()
+    fresh.set_state_dict(net.state_dict())
+    for n, (x, got) in outs.items():
+        want = np.asarray(fresh(paddle.to_tensor(x))._value)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_bucketize_rejects_scalar_outputs():
+    """Zero-padding cannot be undone through a batch reduction: loud error,
+    never a silently-wrong mean."""
+    import paddle_tpu.nn as nn
+
+    class Mean(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x).mean()
+
+    m = Mean()
+    for p in m.parameters():
+        p.stop_gradient = True
+    sm = paddle.jit.to_static(m, bucketize=True)
+    with pytest.raises(ValueError, match="per-row outputs"):
+        sm(paddle.to_tensor(np.zeros((3, 4), np.float32)))
+
+
+def test_to_static_without_bucketize_retraces_per_shape():
+    net = TinyNet()
+    for p in net.parameters():
+        p.stop_gradient = True
+    snet = paddle.jit.to_static(net)
+    rng = np.random.RandomState(0)
+    for n in (3, 5, 7):
+        snet(paddle.to_tensor(rng.randn(n, 4).astype(np.float32)))
+    assert snet.forward.trace_count == 3

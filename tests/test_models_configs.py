@@ -220,3 +220,61 @@ class TestGptMoEP:
         spec = step._param_specs[[id(p) for p in model.parameters()].index(id(w1))]
         set_mesh(None)
         assert tuple(spec) and tuple(spec)[0] == "ep", f"expert dim not ep-sharded: {spec}"
+
+
+class TestGptDense:
+    """Dense GPT-2-style family (round 3): trains eagerly, and the layer
+    list decomposes for the compiled pipeline route."""
+
+    def test_gpt_trains(self):
+        from paddle_tpu.models import GptForCausalLM, gpt_tiny_config
+
+        set_mesh(None)
+        paddle.seed(0)
+        model = GptForCausalLM(gpt_tiny_config())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 256, (4, 16)).astype(np.int64))
+        losses = []
+        for _ in range(4):
+            loss = model(ids, ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_gpt_pipeline_route(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+        from paddle_tpu.models import GptForCausalLM, gpt_tiny_config
+        import paddle_tpu.nn.functional as F
+
+        set_mesh(None)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 2, "sharding_degree": 1,
+                                   "sep_degree": 1}
+        strategy.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 4}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        cfg = gpt_tiny_config()
+
+        def loss_fn(logits, labels):
+            V = cfg.vocab_size
+            return F.cross_entropy(logits[:, :-1].reshape([-1, V]),
+                                   labels[:, 1:].reshape([-1]))
+
+        pipe = PipelineLayer(layers=GptForCausalLM.pipeline_layers(cfg),
+                             num_stages=2, loss_fn=loss_fn)
+        model = fleet.distributed_model(pipe)
+        opt = fleet.distributed_optimizer(paddle.optimizer.AdamW(
+            learning_rate=1e-3, parameters=pipe.parameters()))
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 256, (8, 16)).astype(np.int64))
+        l0 = float(model.train_batch([ids, ids], opt))
+        l1 = float(model.train_batch([ids, ids], opt))
+        assert model._compiled_step is not None  # took the compiled route
+        assert l1 < l0
+        set_mesh(None)
