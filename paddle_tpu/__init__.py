@@ -60,6 +60,7 @@ from paddle_tpu import utils  # noqa: F401
 from paddle_tpu import vision  # noqa: F401
 
 from paddle_tpu.framework.io_ import load, save  # noqa: F401
+from paddle_tpu.framework.inspection import flops, summary  # noqa: F401
 from paddle_tpu.nn.initializer import ParamAttr  # noqa: F401
 
 __version__ = "0.1.0"

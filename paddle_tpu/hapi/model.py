@@ -271,13 +271,6 @@ class Model:
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None):
-        lines = ["-" * 60]
-        total = 0
-        for name, p in self.network.named_parameters():
-            lines.append(f"{name:<40} {str(p.shape):<15} {p.size}")
-            total += p.size
-        lines.append("-" * 60)
-        lines.append(f"Total params: {total}")
-        s = "\n".join(lines)
-        print(s)
-        return {"total_params": total}
+        from paddle_tpu.framework.inspection import summary as _summary
+
+        return _summary(self.network, input_size)

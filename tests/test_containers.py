@@ -51,3 +51,18 @@ class TestSelectedRows:
         sr.to_dense().sum().backward()
         np.testing.assert_array_equal(np.asarray(vals.grad._value),
                                       np.ones((2, 3), np.float32))
+
+
+def test_summary_and_flops():
+    """paddle.summary per-layer table + paddle.flops via XLA cost analysis
+    (reference hapi/model_summary.py, hapi/dynamic_flops.py)."""
+    import paddle_tpu.nn as nn
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    info = paddle.summary(net)
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+    assert info["trainable_params"] == info["total_params"]
+
+    n = paddle.flops(net, [2, 8])
+    # 2 matmuls: 2*(2*8*16) + 2*(2*16*4) = 768 macs*2; XLA counts ~2*macs
+    assert 500 <= n <= 2000, n
