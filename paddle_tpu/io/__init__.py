@@ -2,9 +2,11 @@
 Dataset/Sampler/BatchSampler under io/dataloader/).
 
 TPU-native notes: batches are assembled host-side as numpy and transferred once
-per step (minimizing host->device traffic); worker parallelism uses threads
-(the GIL releases during numpy/np IO) with an optional prefetch queue, replacing
-the reference's fork-based multiprocess workers + shared-memory ring.
+per step (minimizing host->device traffic). num_workers > 0 forks worker
+PROCESSES (fetch/transform/collate off the parent's GIL) with ordered
+delivery, fault propagation, and optional POSIX shared-memory batch transport
+(use_shared_memory, like the reference's shm ring); iterable datasets and
+non-CPU-initialized backends fall back to a thread prefetcher.
 """
 from __future__ import annotations
 
@@ -296,7 +298,122 @@ def _fork_workers_safe() -> bool:
         return False  # fail closed: introspection failure -> thread prefetcher
 
 
-def _worker_loop(dataset, index_q, result_q, collate, worker_init_fn, wid):
+class _ShmRef:
+    """Placeholder for an array parked in a POSIX shared-memory segment —
+    a distinct type, so it is recognizable at ANY nesting depth and can never
+    be confused with a container tuple."""
+
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+    def __reduce__(self):
+        return (_ShmRef, (self.name, self.shape, self.dtype))
+
+
+def _shm_tree_map(tree, fn):
+    if isinstance(tree, tuple):
+        return tuple(_shm_tree_map(v, fn) for v in tree)
+    if isinstance(tree, list):
+        return [_shm_tree_map(v, fn) for v in tree]
+    if isinstance(tree, dict):
+        return {k: _shm_tree_map(v, fn) for k, v in tree.items()}
+    return fn(tree)
+
+
+def _shm_export(tree):
+    """Move the numpy leaves of a collated batch (any tuple/list/dict
+    nesting) into POSIX shared memory; the parent maps the segments instead
+    of unpickling array bytes through the queue pipe (reference:
+    use_shared_memory=True, core _array_to_share_memory_tensor). On partial
+    failure every already-created segment is unlinked."""
+    from multiprocessing import shared_memory
+
+    names = []
+
+    def export(v):
+        if isinstance(v, np.ndarray) and v.nbytes >= 1024:
+            seg = shared_memory.SharedMemory(create=True, size=v.nbytes)
+            names.append(seg.name)
+            np.ndarray(v.shape, v.dtype, buffer=seg.buf)[...] = v
+            # the PARENT owns the segment's lifetime: stop this process's
+            # resource_tracker from unlinking it at worker exit
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(seg._name, "shared_memory")
+            except Exception:
+                pass
+            seg.close()
+            return _ShmRef(seg.name, v.shape, str(v.dtype))
+        return v
+
+    try:
+        return _shm_tree_map(tree, export)
+    except Exception:
+        for n in names:
+            try:
+                seg = shared_memory.SharedMemory(name=n)
+                seg.close()
+                seg.unlink()
+            except Exception:
+                pass
+        raise
+
+
+def _shm_import(tree):
+    """Parent side: map + copy out + unlink each shared segment."""
+    from multiprocessing import shared_memory
+
+    def imp(v):
+        if isinstance(v, _ShmRef):
+            seg = shared_memory.SharedMemory(name=v.name)
+            try:
+                return np.array(np.ndarray(v.shape, np.dtype(v.dtype),
+                                           buffer=seg.buf))
+            finally:
+                seg.close()
+                seg.unlink()
+        return v
+
+    return _shm_tree_map(tree, imp)
+
+
+def _shm_release(tree):
+    """Unlink a batch's segments without reading them (early-stop/error
+    teardown: nothing else will — the workers unregistered their trackers)."""
+    from multiprocessing import shared_memory
+
+    def rel(v):
+        if isinstance(v, _ShmRef):
+            try:
+                seg = shared_memory.SharedMemory(name=v.name)
+                seg.close()
+                seg.unlink()
+            except Exception:
+                pass
+        return v
+
+    _shm_tree_map(tree, rel)
+
+
+def _contains_shm(tree) -> bool:
+    found = [False]
+
+    def chk(v):
+        if isinstance(v, _ShmRef):
+            found[0] = True
+        return v
+
+    _shm_tree_map(tree, chk)
+    return found[0]
+
+
+def _worker_loop(dataset, index_q, result_q, collate, worker_init_fn, wid,
+                 use_shared_memory=False):
     """Child process: fetch+transform+collate — the Python-heavy work that
     would serialize on the parent's GIL (reference io/dataloader/worker.py)."""
     if worker_init_fn is not None:
@@ -308,7 +425,14 @@ def _worker_loop(dataset, index_q, result_q, collate, worker_init_fn, wid):
         bid, idxs = item
         try:
             batch = collate([dataset[i] for i in idxs])
-            result_q.put((bid, batch, None))
+            if use_shared_memory:
+                batch = _shm_export(batch)
+            try:
+                result_q.put((bid, batch, None))
+            except Exception:
+                if use_shared_memory:
+                    _shm_release(batch)
+                raise
         except Exception:
             import traceback
 
@@ -327,6 +451,10 @@ class _MultiprocessIter:
         ctx = mp.get_context("fork")
         self._collate_user = loader.collate_fn is not default_collate_fn
         collate = loader.collate_fn if self._collate_user else _collate_np
+        # shared memory only applies to the numpy default-collate layout
+        self._use_shm = bool(getattr(loader, "use_shared_memory", False)
+                             and not self._collate_user)
+        self.shm_batches = 0  # diagnostics
         self._index_q = ctx.Queue()
         self._result_q = ctx.Queue()
         self._timeout = loader.timeout or None
@@ -335,7 +463,7 @@ class _MultiprocessIter:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(loader.dataset, self._index_q, self._result_q, collate,
-                      loader.worker_init_fn, wid),
+                      loader.worker_init_fn, wid, self._use_shm),
                 daemon=True)
             w.start()
             self._workers.append(w)
@@ -389,6 +517,10 @@ class _MultiprocessIter:
         self._dispatch()
         if self._collate_user:
             return batch
+        if self._use_shm:
+            had_shm = _contains_shm(batch)
+            batch = _shm_import(batch)
+            self.shm_batches += had_shm
         return _np_to_tensor_tree(batch)
 
     def _shutdown(self):
@@ -402,6 +534,21 @@ class _MultiprocessIter:
             if w.is_alive():
                 w.terminate()
         self._workers = []
+        if self._use_shm:
+            # release in-flight segments: the workers unregistered their
+            # trackers, so undelivered batches would otherwise leak in shm
+            import queue as _q
+
+            for batch in self._pending.values():
+                _shm_release(batch)
+            self._pending = {}
+            while True:
+                try:
+                    _, batch, err = self._result_q.get_nowait()
+                except (_q.Empty, OSError, ValueError):
+                    break
+                if err is None:
+                    _shm_release(batch)
 
     def __del__(self):
         try:
@@ -424,6 +571,7 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
             self.batch_size = batch_size
