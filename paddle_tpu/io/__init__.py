@@ -272,12 +272,16 @@ def _collate_np(batch):
 
 
 def _np_to_tensor_tree(x):
+    import jax
+
     if isinstance(x, tuple):
         return tuple(_np_to_tensor_tree(v) for v in x)
     if isinstance(x, dict):
         return {k: _np_to_tensor_tree(v) for k, v in x.items()}
     if isinstance(x, np.ndarray):
         return Tensor(jnp.asarray(x))
+    if isinstance(x, jax.Array):  # shm-imported leaves arrive device-ready
+        return Tensor(x)
     return x
 
 
@@ -324,19 +328,30 @@ def _shm_tree_map(tree, fn):
     return fn(tree)
 
 
-def _shm_export(tree):
+def _shm_export(tree, prefix="", counter=None):
     """Move the numpy leaves of a collated batch (any tuple/list/dict
     nesting) into POSIX shared memory; the parent maps the segments instead
     of unpickling array bytes through the queue pipe (reference:
-    use_shared_memory=True, core _array_to_share_memory_tensor). On partial
-    failure every already-created segment is unlinked."""
+    use_shared_memory=True, core _array_to_share_memory_tensor).
+    Segments carry a job-unique name prefix so the parent can sweep strays
+    after an abnormal worker death. ENOSPC (tiny /dev/shm) and structured
+    dtypes fall back to the pickle path per-leaf; partial export failures
+    unlink every already-created segment."""
     from multiprocessing import shared_memory
 
     names = []
 
     def export(v):
-        if isinstance(v, np.ndarray) and v.nbytes >= 1024:
-            seg = shared_memory.SharedMemory(create=True, size=v.nbytes)
+        if (isinstance(v, np.ndarray) and v.nbytes >= 1024
+                and v.dtype.names is None and not v.dtype.hasobject):
+            if counter is not None:
+                counter[0] += 1
+            name = f"{prefix}{counter[0]}" if prefix else None
+            try:
+                seg = shared_memory.SharedMemory(name=name, create=True,
+                                                 size=v.nbytes)
+            except OSError:
+                return v  # shm exhausted/unavailable: ship via pickle
             names.append(seg.name)
             np.ndarray(v.shape, v.dtype, buffer=seg.buf)[...] = v
             # the PARENT owns the segment's lifetime: stop this process's
@@ -348,7 +363,7 @@ def _shm_export(tree):
             except Exception:
                 pass
             seg.close()
-            return _ShmRef(seg.name, v.shape, str(v.dtype))
+            return _ShmRef(seg.name, v.shape, v.dtype.str)
         return v
 
     try:
@@ -365,21 +380,31 @@ def _shm_export(tree):
 
 
 def _shm_import(tree):
-    """Parent side: map + copy out + unlink each shared segment."""
+    """Parent side: map each segment, move it ONCE into the XLA host buffer
+    (jnp.asarray), then unlink — no intermediate numpy copy. Returns
+    (tree, n_refs_consumed)."""
     from multiprocessing import shared_memory
+
+    count = [0]
 
     def imp(v):
         if isinstance(v, _ShmRef):
+            count[0] += 1
             seg = shared_memory.SharedMemory(name=v.name)
             try:
-                return np.array(np.ndarray(v.shape, np.dtype(v.dtype),
-                                           buffer=seg.buf))
+                view = np.ndarray(v.shape, np.dtype(v.dtype), buffer=seg.buf)
+                # copy=True is load-bearing: the CPU backend zero-copy
+                # aliases aligned numpy buffers, and the segment is about to
+                # be unlinked
+                arr = jnp.array(view, copy=True)
+                arr.block_until_ready()
+                return arr
             finally:
                 seg.close()
                 seg.unlink()
         return v
 
-    return _shm_tree_map(tree, imp)
+    return _shm_tree_map(tree, imp), count[0]
 
 
 def _shm_release(tree):
@@ -400,24 +425,13 @@ def _shm_release(tree):
     _shm_tree_map(tree, rel)
 
 
-def _contains_shm(tree) -> bool:
-    found = [False]
-
-    def chk(v):
-        if isinstance(v, _ShmRef):
-            found[0] = True
-        return v
-
-    _shm_tree_map(tree, chk)
-    return found[0]
-
-
 def _worker_loop(dataset, index_q, result_q, collate, worker_init_fn, wid,
-                 use_shared_memory=False):
+                 use_shared_memory=False, shm_prefix=""):
     """Child process: fetch+transform+collate — the Python-heavy work that
     would serialize on the parent's GIL (reference io/dataloader/worker.py)."""
     if worker_init_fn is not None:
         worker_init_fn(wid)
+    seq = [0]
     while True:
         item = index_q.get()
         if item is None:
@@ -426,7 +440,7 @@ def _worker_loop(dataset, index_q, result_q, collate, worker_init_fn, wid,
         try:
             batch = collate([dataset[i] for i in idxs])
             if use_shared_memory:
-                batch = _shm_export(batch)
+                batch = _shm_export(batch, f"{shm_prefix}w{wid}_", seq)
             try:
                 result_q.put((bid, batch, None))
             except Exception:
@@ -454,6 +468,9 @@ class _MultiprocessIter:
         # shared memory only applies to the numpy default-collate layout
         self._use_shm = bool(getattr(loader, "use_shared_memory", False)
                              and not self._collate_user)
+        import os as _os
+
+        self._shm_prefix = f"ptdl_{_os.getpid()}_{id(self) & 0xffff:x}_"
         self.shm_batches = 0  # diagnostics
         self._index_q = ctx.Queue()
         self._result_q = ctx.Queue()
@@ -463,7 +480,8 @@ class _MultiprocessIter:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(loader.dataset, self._index_q, self._result_q, collate,
-                      loader.worker_init_fn, wid, self._use_shm),
+                      loader.worker_init_fn, wid, self._use_shm,
+                      self._shm_prefix),
                 daemon=True)
             w.start()
             self._workers.append(w)
@@ -518,9 +536,8 @@ class _MultiprocessIter:
         if self._collate_user:
             return batch
         if self._use_shm:
-            had_shm = _contains_shm(batch)
-            batch = _shm_import(batch)
-            self.shm_batches += had_shm
+            batch, n_refs = _shm_import(batch)
+            self.shm_batches += n_refs > 0
         return _np_to_tensor_tree(batch)
 
     def _shutdown(self):
@@ -549,6 +566,19 @@ class _MultiprocessIter:
                     break
                 if err is None:
                     _shm_release(batch)
+            # sweep strays from abnormally-died workers (their refs never
+            # reached the queue; names carry this loader's unique prefix)
+            import glob as _glob
+
+            for path in _glob.glob(f"/dev/shm/{self._shm_prefix}*"):
+                try:
+                    from multiprocessing import shared_memory as _sm
+
+                    seg = _sm.SharedMemory(name=path.rsplit("/", 1)[1])
+                    seg.close()
+                    seg.unlink()
+                except Exception:
+                    pass
 
     def __del__(self):
         try:

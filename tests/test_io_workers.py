@@ -99,6 +99,9 @@ def test_shared_memory_transport_roundtrip():
         def __len__(self):
             return 8
 
+    import glob
+
+    base = len(glob.glob("/dev/shm/*"))
     loader = DataLoader(Big(), batch_size=2, num_workers=2, shuffle=False,
                         use_shared_memory=True)
     it = iter(loader)
@@ -107,11 +110,9 @@ def test_shared_memory_transport_roundtrip():
     for b, (x, y) in enumerate(got):
         np.testing.assert_array_equal(x[0], np.full((64, 64), 2.0 * b))
         np.testing.assert_array_equal(y, [2 * b, 2 * b + 1])
-    # no leaked segments
-    import glob
-
-    leaks = glob.glob("/dev/shm/psm_*") + glob.glob("/dev/shm/wnsm_*")
-    assert not leaks, leaks
+    # no NEW segments left behind (baseline-relative: other processes may
+    # legitimately hold their own)
+    assert len(glob.glob("/dev/shm/*")) == base
 
 
 def test_shared_memory_nested_and_early_stop_no_leaks():
