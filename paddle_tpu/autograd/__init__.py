@@ -65,7 +65,9 @@ def _functionalize(func, xs):
         from paddle_tpu.core.tensor import Tensor
 
         outs = func(*[Tensor(a, stop_gradient=False) for a in arrs])
-        return outs._value if isinstance(outs, Tensor) else outs
+        return jax.tree_util.tree_map(
+            lambda o: o._value if isinstance(o, Tensor) else o, outs,
+            is_leaf=lambda o: isinstance(o, Tensor))
 
     return f, vals
 
@@ -95,3 +97,57 @@ def hessian(func, xs, batch_axis=None):
     if single:
         return Tensor(hess[0][0])
     return [[Tensor(h) for h in row] for row in hess]
+
+
+def _wrap_out(tree):
+    from paddle_tpu.core.tensor import Tensor
+
+    return jax.tree_util.tree_map(Tensor, tree)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode jacobian-vector product (reference:
+    paddle.incubate.autograd.jvp). Returns (outputs, jvp_result); func may
+    return a Tensor or a tuple/list of Tensors. TPU-native: jax.jvp over the
+    functionalized graph — forward-mode is a first-class transform, not a
+    double-vjp trick."""
+    from paddle_tpu.core.tensor import Tensor
+
+    single = isinstance(xs, Tensor)
+    xs_l = [xs] if single else list(xs)
+    f, vals = _functionalize(func, xs_l)
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        # v mirrors the PRIMAL structure: one tangent per input Tensor
+        v_l = [v] if single else list(v)
+        tangents = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                    for t in v_l]
+    out, tangent_out = jax.jvp(f, tuple(vals), tuple(tangents))
+    return _wrap_out(out), _wrap_out(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode vector-jacobian product (reference:
+    paddle.incubate.autograd.vjp). Returns (outputs, vjp_result); func may
+    return a Tensor or a tuple/list of Tensors (v then mirrors that
+    structure)."""
+    from paddle_tpu.core.tensor import Tensor
+
+    single = isinstance(xs, Tensor)
+    xs_l = [xs] if single else list(xs)
+    f, vals = _functionalize(func, xs_l)
+    out, pullback = jax.vjp(f, *vals)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot = jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else jnp.asarray(t),
+            v, is_leaf=lambda t: isinstance(t, Tensor))
+    grads = pullback(cot)
+    if single:
+        return _wrap_out(out), Tensor(grads[0])
+    return _wrap_out(out), [Tensor(g) for g in grads]
+
+
+__all__ += ["jvp", "vjp"]

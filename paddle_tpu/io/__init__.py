@@ -346,7 +346,8 @@ def _shm_export(tree, prefix="", counter=None):
                 and v.dtype.names is None and not v.dtype.hasobject):
             if counter is not None:
                 counter[0] += 1
-            name = f"{prefix}{counter[0]}" if prefix else None
+            name = (f"{prefix}{counter[0]}"
+                    if (prefix and counter is not None) else None)
             try:
                 seg = shared_memory.SharedMemory(name=name, create=True,
                                                  size=v.nbytes)

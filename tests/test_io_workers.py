@@ -101,7 +101,6 @@ def test_shared_memory_transport_roundtrip():
 
     import glob
 
-    base = len(glob.glob("/dev/shm/*"))
     loader = DataLoader(Big(), batch_size=2, num_workers=2, shuffle=False,
                         use_shared_memory=True)
     it = iter(loader)
@@ -110,20 +109,14 @@ def test_shared_memory_transport_roundtrip():
     for b, (x, y) in enumerate(got):
         np.testing.assert_array_equal(x[0], np.full((64, 64), 2.0 * b))
         np.testing.assert_array_equal(y, [2 * b, 2 * b + 1])
-    # no NEW segments left behind (baseline-relative: other processes may
-    # legitimately hold their own)
-    assert len(glob.glob("/dev/shm/*")) == base
+    # exact, race-free leak check: THIS loader's prefix must be gone
+    assert not glob.glob(f"/dev/shm/{it._shm_prefix}*")
 
 
 def test_shared_memory_nested_and_early_stop_no_leaks():
     """Nested dict batches ride shm too, a bare-array dataset resolves, and
     breaking out of iteration mid-epoch unlinks all in-flight segments."""
     import glob
-
-    def shm_count():
-        return len(glob.glob("/dev/shm/psm_*"))
-
-    base = shm_count()
 
     class NestedDs(Dataset):
         def __getitem__(self, i):
@@ -141,7 +134,8 @@ def test_shared_memory_nested_and_early_stop_no_leaks():
     assert it.shm_batches > 0  # nested dict leaves counted + transported
     it._shutdown()  # early stop: in-flight batches must be released
     time.sleep(0.2)
-    assert shm_count() == base, "leaked shm segments after early stop"
+    assert not glob.glob(f"/dev/shm/{it._shm_prefix}*"), \
+        "leaked shm segments after early stop"
 
     class BareDs(Dataset):
         def __getitem__(self, i):
@@ -150,8 +144,8 @@ def test_shared_memory_nested_and_early_stop_no_leaks():
         def __len__(self):
             return 4
 
-    loader2 = DataLoader(BareDs(), batch_size=2, num_workers=2, shuffle=False,
-                         use_shared_memory=True)
-    out = [np.asarray(b._value) for b in loader2]
+    it2 = iter(DataLoader(BareDs(), batch_size=2, num_workers=2,
+                          shuffle=False, use_shared_memory=True))
+    out = [np.asarray(b._value) for b in it2]
     np.testing.assert_array_equal(out[1][1], np.full((32, 32), 3.0))
-    assert shm_count() == base
+    assert not glob.glob(f"/dev/shm/{it2._shm_prefix}*")

@@ -725,6 +725,77 @@ case("sequence_mask", lambda x: F.sequence_mask(x, maxlen=5),
 # ---------------------------------------------------------------------------
 
 
+
+
+# ---- round-3 widening: remaining op families -------------------------------
+
+def _conv2dT_ref(x, k):
+    n, cin, h, w = x.shape
+    _, cout, kh, kw = k.shape
+    out = np.zeros((n, cout, h + kh - 1, w + kw - 1))
+    for i in range(h):
+        for j in range(w):
+            out[:, :, i:i + kh, j:j + kw] += np.einsum(
+                "nc,cokl->nokl", x[:, :, i, j], k)
+    return out
+
+
+case("conv2d_transpose", lambda x, k: F.conv2d_transpose(x, k), _conv2dT_ref,
+     r.randn(1, 3, 4, 4), r.randn(3, 2, 3, 3), rtol=1e-4, atol=1e-4)
+case("bilinear", F.bilinear,
+     lambda a, b, w: np.einsum("bi,oij,bj->bo", a, w, b),
+     r.randn(3, 4), r.randn(3, 5), r.randn(6, 4, 5))
+
+
+def _unfold_ref(x):
+    n, c, h, w = x.shape
+    cols = []
+    for i in range(h - 1):
+        for j in range(w - 1):
+            cols.append(x[:, :, i:i + 2, j:j + 2].reshape(n, -1))
+    return np.stack(cols, -1)
+
+
+case("unfold", lambda x: F.unfold(x, 2), _unfold_ref, r.randn(1, 2, 4, 4))
+
+
+def _lrn_ref(x):
+    n, c, h, w = x.shape
+    sq = x * x
+    acc = np.zeros_like(x)
+    for ch in range(c):
+        lo, hi = max(0, ch - 2), min(c, ch + 3)
+        acc[:, ch] = sq[:, lo:hi].sum(1)
+    return x / (1.0 + (1e-4 / 5) * acc) ** 0.75
+
+
+case("local_response_norm", lambda x: F.local_response_norm(x, 5),
+     _lrn_ref, r.randn(1, 6, 3, 3), rtol=1e-4, atol=1e-4)
+case("maxout", lambda x: F.maxout(x, 2),
+     lambda x: x.reshape(1, 2, 2, 5).max(2), r.randn(1, 4, 5))
+case("alpha_dropout_eval", lambda x: F.alpha_dropout(x, 0.5, training=False),
+     lambda x: x, A)
+case("rrelu_eval", lambda x: F.rrelu(x, training=False),
+     lambda x: np.where(x >= 0, x, x * ((0.125 + 1 / 3) / 2)), SH * 2)
+case("angle", paddle.angle,
+     lambda x: np.angle(x[..., 0] + 1j * x[..., 1]), r.randn(4, 2), grad=False)
+CASES[-1].fn = lambda x: paddle.angle(paddle.as_complex(x))
+case("conj_real", lambda x: paddle.real(paddle.conj(paddle.as_complex(x))),
+     lambda x: x[..., 0], r.randn(4, 2), grad=False)
+case("as_real", lambda x: paddle.as_real(paddle.as_complex(x)),
+     lambda x: x, r.randn(4, 2), grad=False)
+case("mode_v", lambda x: paddle.mode(x)[0],
+     lambda x: np.array([np.bincount(row.astype(np.int64)).argmax()
+                         for row in x]).astype(np.float64),
+     np.abs(iA).astype(np.float32), grad=False)
+case("lstsq_sol", lambda a, b: paddle.lstsq(a, b)[0],
+     lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
+     SPD, VEC[:3].reshape(3, 1), grad=False, rtol=1e-4, atol=1e-4)
+case("eigvals_abs", lambda x: paddle.sort(paddle.abs(paddle.eigvals(x))),
+     lambda x: np.sort(np.abs(np.linalg.eigvals(x))), SPD, grad=False,
+     rtol=1e-4, atol=1e-4)
+
+
 @pytest.mark.parametrize("c", CASES, ids=[c.name for c in CASES])
 def test_forward_f32(c):
     _run_forward(c, "float32")
@@ -783,3 +854,40 @@ def test_harness_catches_wrong_forward():
     planted = OpCase("bad_exp", paddle.exp, lambda x: np.exp(x) + 0.01, (A,))
     with pytest.raises(AssertionError):
         _run_forward(planted)
+
+
+class TestRandomOpsDistributional:
+    """Statistical checks for the RNG op family (reference
+    test_uniform_random_op-style moments/range assertions)."""
+
+    def setup_method(self):
+        paddle.seed(1234)
+
+    def test_randn_moments(self):
+        x = np.asarray(paddle.randn([20000])._value)
+        assert abs(x.mean()) < 0.05 and abs(x.std() - 1) < 0.05
+
+    def test_uniform_range_and_mean(self):
+        x = np.asarray(paddle.uniform([20000], min=-2.0, max=4.0)._value)
+        assert x.min() >= -2.0 and x.max() < 4.0
+        assert abs(x.mean() - 1.0) < 0.1
+
+    def test_randint_range(self):
+        x = np.asarray(paddle.randint(3, 9, [5000])._value)
+        assert x.min() >= 3 and x.max() <= 8
+        assert len(np.unique(x)) == 6
+
+    def test_randperm_is_permutation(self):
+        x = np.asarray(paddle.randperm(100)._value)
+        np.testing.assert_array_equal(np.sort(x), np.arange(100))
+
+    def test_normal_moments(self):
+        x = np.asarray(paddle.normal(mean=2.0, std=3.0, shape=[20000])._value)
+        assert abs(x.mean() - 2.0) < 0.1 and abs(x.std() - 3.0) < 0.1
+
+    def test_seed_reproducibility(self):
+        paddle.seed(7)
+        a = np.asarray(paddle.randn([16])._value)
+        paddle.seed(7)
+        b = np.asarray(paddle.randn([16])._value)
+        np.testing.assert_array_equal(a, b)

@@ -182,3 +182,55 @@ class TestHigherOrder:
         x = t([1.0, 2.0], sg=False)
         H = hessian(lambda v: (v ** 3).sum(), x)
         np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]))
+
+
+class TestForwardMode:
+    """incubate.autograd-style jvp/vjp (reference autograd/functional.py):
+    forward-mode is a first-class transform on TPU."""
+
+    def test_jvp_matches_analytic(self):
+        import paddle_tpu.autograd as A
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        v = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        out, tangent = A.jvp(lambda t: (t * t).sum(), x, v)
+        np.testing.assert_allclose(float(out), 5.0)
+        np.testing.assert_allclose(float(tangent), 2.0)  # d/dx0 of sum(x^2) = 2x0
+
+    def test_vjp_matches_backward(self):
+        import paddle_tpu.autograd as A
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out, g = A.vjp(lambda t: (t ** 3).sum(), x)
+        np.testing.assert_allclose(np.asarray(g._value), 3 * np.array([1, 4, 9.0]))
+
+    def test_jvp_vjp_consistency(self):
+        """<J v, w> == <v, J^T w> on a nonlinear map."""
+        import paddle_tpu.autograd as A
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4).astype(np.float32))
+        v = rng.randn(4).astype(np.float32)
+
+        def f(t):
+            return paddle.tanh(t * 2.0)
+
+        _, jv = A.jvp(f, x, paddle.to_tensor(v))
+        w = rng.randn(4).astype(np.float32)
+        _, jtw = A.vjp(f, x, paddle.to_tensor(w))
+        lhs = float(np.dot(np.asarray(jv._value), w))
+        rhs = float(np.dot(v, np.asarray(jtw._value)))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-5)
+
+    def test_jvp_vjp_multi_output(self):
+        import paddle_tpu.autograd as A
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out, tang = A.jvp(lambda t: (t.sum(), (t * t).sum()), x,
+                          paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+        np.testing.assert_allclose(float(out[0]), 3.0)
+        np.testing.assert_allclose(float(tang[1]), 6.0)  # sum(2x · v)
+        out2, g = A.vjp(lambda t: (t.sum(), (t * t).sum()), x,
+                        (paddle.to_tensor(np.float32(1.0)),
+                         paddle.to_tensor(np.float32(0.5))))
+        np.testing.assert_allclose(np.asarray(g._value), [1 + 1.0, 1 + 2.0])
