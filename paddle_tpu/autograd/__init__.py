@@ -141,9 +141,12 @@ def vjp(func, xs, v=None):
     if v is None:
         cot = jax.tree_util.tree_map(jnp.ones_like, out)
     else:
-        cot = jax.tree_util.tree_map(
-            lambda t: t._value if isinstance(t, Tensor) else jnp.asarray(t),
-            v, is_leaf=lambda t: isinstance(t, Tensor))
+        leaves = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                  for t in jax.tree_util.tree_leaves(
+                      v, is_leaf=lambda t: isinstance(t, Tensor))]
+        # the cotangent CONTAINER must match the output treedef exactly
+        # (a list v for a tuple output would raise in the pullback)
+        cot = jax.tree_util.tree_structure(out).unflatten(leaves)
     grads = pullback(cot)
     if single:
         return _wrap_out(out), Tensor(grads[0])

@@ -27,24 +27,45 @@ SEP_AXIS = "sep"
 _NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, qpos, kpos, scale, causal):
+def _block_attn(q, k, v, qpos, kpos, scale, causal, q_chunk=512):
     """One Q-shard x K-block attention with stats. q:[B,Sq,H,D] k/v:[B,Sk,H,D].
-    Returns (acc [B,Sq,H,D] f32 unnormalized, m [B,Sq,H,1], l [B,Sq,H,1])."""
+    Returns (acc [B,Sq,H,D] f32 unnormalized, m [B,Sq,H,1], l [B,Sq,H,1]).
+    Q is processed in chunks so peak score memory is O(q_chunk * Sk), not
+    O(Sq * Sk) — the flash-style tiling, kept in jnp so the ring stays
+    differentiable end-to-end."""
     qh = q.astype(jnp.float32)
     kh = k.astype(jnp.float32)
     vh = v.astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
-    if causal:
-        mask = qpos[:, None] >= kpos[None, :]
-        s = jnp.where(mask[None, None], s, _NEG_INF)
-    m = jnp.max(s, axis=-1)  # [B,H,Sq]
-    p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
-    # -> [B,Sq,H,1] layout for stats
-    m = jnp.transpose(m, (0, 2, 1))[..., None]
-    l = jnp.transpose(l, (0, 2, 1))[..., None]
-    return acc, m, l
+    sq = qh.shape[1]
+    # largest divisor of sq not exceeding q_chunk: non-multiples still get a
+    # bounded tile instead of silently falling back to the full score matrix
+    chunk = min(q_chunk, sq)
+    while sq % chunk != 0:
+        chunk -= 1
+
+    def one_chunk(args):
+        qc, qp = args  # [B, C, H, D], [C]
+        s = jnp.einsum("bqhd,bkhd->bhqk", qc, kh) * scale
+        if causal:
+            mask = qp[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m = jnp.max(s, axis=-1)  # [B,H,C]
+        p = jnp.exp(s - m[..., None])
+        l = jnp.sum(p, axis=-1)
+        acc = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+        m = jnp.transpose(m, (0, 2, 1))[..., None]
+        l = jnp.transpose(l, (0, 2, 1))[..., None]
+        return acc, m, l
+
+    if sq == chunk:
+        return one_chunk((qh, qpos))
+    nc = sq // chunk
+    qs = qh.reshape(qh.shape[0], nc, chunk, *qh.shape[2:]).swapaxes(0, 1)
+    qps = qpos.reshape(nc, chunk)
+    accs, ms, ls = jax.lax.map(one_chunk, (qs, qps))
+    join = lambda t: t.swapaxes(0, 1).reshape(  # noqa: E731
+        t.shape[1], sq, *t.shape[3:])
+    return join(accs), join(ms), join(ls)
 
 
 def ring_attention(q, k, v, axis_name: str = SEP_AXIS, causal: bool = True,
@@ -62,17 +83,27 @@ def ring_attention(q, k, v, axis_name: str = SEP_AXIS, causal: bool = True,
 
     def step(carry, r):
         kk, vv, m, l, acc = carry
-        src = (idx - r) % n  # which rank's block we currently hold
-        kpos = src * s_local + jnp.arange(s_local)
-        a_j, m_j, l_j = _block_attn(q, kk, vv, qpos, kpos, scale, causal)
-        m_new = jnp.maximum(m, m_j)
-        c_old = jnp.exp(m - m_new)
-        c_new = jnp.exp(m_j - m_new)
-        l = l * c_old + l_j * c_new
-        acc = acc * c_old + a_j * c_new
+
+        def compute(_):
+            src = (idx - r) % n  # which rank's block we currently hold
+            kpos = src * s_local + jnp.arange(s_local)
+            a_j, m_j, l_j = _block_attn(q, kk, vv, qpos, kpos, scale, causal)
+            m_new = jnp.maximum(m, m_j)
+            c_old = jnp.exp(m - m_new)
+            c_new = jnp.exp(m_j - m_new)
+            return (l * c_old + l_j * c_new, acc * c_old + a_j * c_new, m_new)
+
+        if causal:
+            # a K block strictly in this Q shard's future contributes
+            # nothing: skip its matmuls entirely (roughly halves ring FLOPs)
+            src = (idx - r) % n
+            l, acc, m = jax.lax.cond(
+                src > idx, lambda _: (l, acc, m), compute, None)
+        else:
+            l, acc, m = compute(None)
         kk = jax.lax.ppermute(kk, axis_name, perm)
         vv = jax.lax.ppermute(vv, axis_name, perm)
-        return (kk, vv, m_new, l, acc), None
+        return (kk, vv, m, l, acc), None
 
     b, s_, h, d = q.shape
     m0 = jnp.full((b, s_, h, 1), _NEG_INF, jnp.float32)

@@ -76,3 +76,24 @@ def test_ring_attention_grads_flow():
     set_mesh(None)
     for a, b in zip(g, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
+
+
+def test_chunked_block_path_matches_unchunked():
+    """The Q-chunked tiling inside _block_attn must be numerically identical
+    to the single-chunk path (and keep causal masking exact)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.ring_attention import _block_attn
+
+    rng = np.random.RandomState(0)
+    B, Sq, Sk, H, D = 2, 8, 8, 2, 4
+    q = jnp.asarray(rng.randn(B, Sq, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, Sk, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, Sk, H, D), jnp.float32)
+    qpos = jnp.arange(Sq)
+    kpos = jnp.arange(Sk)
+    a1, m1, l1 = _block_attn(q, k, v, qpos, kpos, 0.5, True, q_chunk=Sq)
+    a2, m2, l2 = _block_attn(q, k, v, qpos, kpos, 0.5, True, q_chunk=2)
+    np.testing.assert_allclose(np.asarray(a2), np.asarray(a1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(m1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(l2), np.asarray(l1), rtol=1e-6)

@@ -234,3 +234,13 @@ class TestForwardMode:
                         (paddle.to_tensor(np.float32(1.0)),
                          paddle.to_tensor(np.float32(0.5))))
         np.testing.assert_allclose(np.asarray(g._value), [1 + 1.0, 1 + 2.0])
+
+    def test_vjp_list_cotangent_for_tuple_output(self):
+        import paddle_tpu.autograd as A
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        # v as a LIST against a tuple-returning func (the documented shape)
+        _, g = A.vjp(lambda t: (t.sum(), (t * t).sum()), x,
+                     [paddle.to_tensor(np.float32(1.0)),
+                      paddle.to_tensor(np.float32(0.5))])
+        np.testing.assert_allclose(np.asarray(g._value), [2.0, 3.0])
