@@ -37,12 +37,9 @@ def _block_attn(q, k, v, qpos, kpos, scale, causal, q_chunk=512):
     kh = k.astype(jnp.float32)
     vh = v.astype(jnp.float32)
     sq = qh.shape[1]
-    # largest divisor of sq not exceeding q_chunk: non-multiples still get a
-    # bounded tile instead of silently falling back to the full score matrix
     chunk = min(q_chunk, sq)
-    while sq % chunk != 0:
-        chunk -= 1
 
+    @jax.checkpoint
     def one_chunk(args):
         qc, qp = args  # [B, C, H, D], [C]
         s = jnp.einsum("bqhd,bkhd->bhqk", qc, kh) * scale
@@ -59,12 +56,24 @@ def _block_attn(q, k, v, qpos, kpos, scale, causal, q_chunk=512):
 
     if sq == chunk:
         return one_chunk((qh, qpos))
-    nc = sq // chunk
+    # ceil-division tiling: Q rows are independent, so the remainder tile is
+    # zero-padded and sliced off after (no divisor hunting — a prime shard
+    # length must not degenerate to chunk=1). one_chunk is rematerialized so
+    # the O(chunk * Sk) score bound holds in the BACKWARD pass too (lax.map
+    # would otherwise stack every chunk's softmax residuals).
+    nc = -(-sq // chunk)
+    pad = nc * chunk - sq
+    if pad:
+        qh = jnp.pad(qh, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        qpos = jnp.concatenate([qpos, jnp.full((pad,), qpos[-1], qpos.dtype)])
     qs = qh.reshape(qh.shape[0], nc, chunk, *qh.shape[2:]).swapaxes(0, 1)
     qps = qpos.reshape(nc, chunk)
     accs, ms, ls = jax.lax.map(one_chunk, (qs, qps))
-    join = lambda t: t.swapaxes(0, 1).reshape(  # noqa: E731
-        t.shape[1], sq, *t.shape[3:])
+
+    def join(t):
+        full = t.swapaxes(0, 1).reshape(t.shape[1], nc * chunk, *t.shape[3:])
+        return full[:, :sq]
+
     return join(accs), join(ms), join(ls)
 
 
