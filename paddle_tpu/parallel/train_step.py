@@ -134,9 +134,13 @@ class CompiledTrainStep:
                          and (mesh is not None or get_mesh() is not None))
 
         if batch_spec is None and self.mesh is not None:
-            data_axes = tuple(a for a in ("dp", "sharding", "sep") if
+            data_axes = tuple(a for a in ("dp", "sharding") if
                               a in self.mesh.shape and self.mesh.shape[a] > 1)
-            batch_spec = PartitionSpec(data_axes if data_axes else None)
+            # TRUE sequence parallelism: 'sep' shards dim 1 (the sequence),
+            # not the batch — GSPMD inserts the K/V gathers attention needs
+            sep_on = "sep" in self.mesh.shape and self.mesh.shape["sep"] > 1
+            batch_spec = PartitionSpec(data_axes if data_axes else None,
+                                       "sep" if sep_on else None)
         self.batch_spec = batch_spec or PartitionSpec()
 
         self._param_specs = [_param_pspec(p, self.mesh) for p in self._params]
@@ -268,11 +272,17 @@ class CompiledTrainStep:
         if self.mesh is not None:
             placed = []
             for v in vals:
-                spec = self.batch_spec
-                axes = [a for a in jax.tree_util.tree_leaves(tuple(spec)) if a]
-                div = int(np.prod([self.mesh.shape[a] for a in axes])) if axes else 1
-                if v.ndim == 0 or (div > 1 and v.shape[0] % div != 0):
-                    spec = PartitionSpec()  # replicate when not shardable
+                # per-dim: trim the spec to this input's rank and drop any
+                # dim whose size doesn't divide its axes (replicate it)
+                dims = list(tuple(self.batch_spec))[: v.ndim]
+                eff = []
+                for d, entry in enumerate(dims):
+                    axes = [a for a in (entry if isinstance(entry, tuple)
+                                        else (entry,)) if a]
+                    div = int(np.prod([self.mesh.shape[a] for a in axes])) if axes else 1
+                    eff.append(entry if (div > 1 and v.shape[d] % div == 0)
+                               or div == 1 else None)
+                spec = PartitionSpec(*eff) if v.ndim else PartitionSpec()
                 placed.append(jax.device_put(v, NamedSharding(self.mesh, spec)))
             vals = tuple(placed)
         self._step_i += 1
