@@ -1,0 +1,169 @@
+"""incubate.nn.functional — fused-op functional surface.
+
+Reference parity: python/paddle/incubate/nn/functional — swiglu.py,
+fused_rotary_position_embedding.py, fused_rms_norm.py, fused_layer_norm.py,
+fused_matmul_bias.py, fused_dropout_add.py, fused_dot_product_attention.py.
+
+TPU-native: each "fused" op is one apply_op body; XLA's fusion pass is the
+CUDA kernel author here, and attention rides the Pallas flash kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["swiglu", "fused_rotary_position_embedding", "fused_rms_norm",
+           "fused_layer_norm", "fused_matmul_bias", "fused_dropout_add",
+           "fused_dot_product_attention", "fused_linear"]
+
+
+def swiglu(x, y=None, name=None):
+    """reference swiglu.py: silu(x) * y; with y=None, x splits in half."""
+    if y is None:
+        def f(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+
+        return apply_op(f, x, name="swiglu")
+    return apply_op(lambda a, b: jax.nn.silu(a) * b, x, y, name="swiglu")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """reference fused_rotary_position_embedding.py: RoPE applied to q (and
+    k; v passes through untouched per the reference contract). q/k:
+    [B, S, H, D]; sin/cos default to tables from rotary_emb_base."""
+    if time_major:
+        raise NotImplementedError("time_major=False only (the [B,S,H,D] layout)")
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    B, S, H, D = qv.shape
+    if D % 2:
+        raise ValueError("head_dim must be even for rotary embeddings")
+    if position_ids is not None:
+        pid = (position_ids._value if isinstance(position_ids, Tensor)
+               else jnp.asarray(position_ids)).astype(jnp.int32)
+        max_pos = int(pid.max()) + 1
+    else:
+        pid = None
+        max_pos = S
+    if sin is None or cos is None:
+        from paddle_tpu.models.llama import _rope_tables
+
+        cos_t, sin_t = _rope_tables(D, max_pos, rotary_emb_base)
+    else:
+        cos_t = (cos._value if isinstance(cos, Tensor) else jnp.asarray(cos))
+        sin_t = (sin._value if isinstance(sin, Tensor) else jnp.asarray(sin))
+        cos_t = cos_t.reshape(cos_t.shape[0] if cos_t.ndim == 2 else -1,
+                              -1)[:, : D // 2]
+        sin_t = sin_t.reshape(sin_t.shape[0] if sin_t.ndim == 2 else -1,
+                              -1)[:, : D // 2]
+        if max_pos > cos_t.shape[0]:
+            raise ValueError(
+                f"position id {max_pos - 1} exceeds the sin/cos table "
+                f"length {cos_t.shape[0]}")
+    if pid is not None:
+        # per-batch-row tables: [B, S, D/2] (flattening would break B > 1)
+        cos_t = jnp.take(cos_t, pid, axis=0)
+        sin_t = jnp.take(sin_t, pid, axis=0)
+        c_b = cos_t[:, :, None, :]
+        s_b = sin_t[:, :, None, :]
+    else:
+        c_b = cos_t[None, :, None, :]
+        s_b = sin_t[None, :, None, :]
+
+    def rot(xv):
+        c = c_b.astype(xv.dtype)  # preserve bf16/fp16 input dtype
+        s = s_b.astype(xv.dtype)
+        if use_neox_rotary_style:  # halves rotated against each other
+            x1, x2 = jnp.split(xv, 2, axis=-1)
+        else:  # interleaved pairs
+            x1, x2 = xv[..., 0::2], xv[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        if use_neox_rotary_style:
+            return jnp.concatenate([r1, r2], axis=-1)
+        out = jnp.stack([r1, r2], axis=-1)
+        return out.reshape(xv.shape)
+
+    outs = [apply_op(rot, q, name="fused_rope_q")]
+    if k is not None:
+        outs.append(apply_op(rot, k, name="fused_rope_k"))
+    else:
+        outs.append(None)
+    outs.append(v)
+    return tuple(outs)
+
+
+def _check_last_axis_only(begin_norm_axis, ndim, which):
+    if begin_norm_axis not in (-1, ndim - 1):
+        raise NotImplementedError(
+            f"{which}: only last-axis normalization is implemented "
+            f"(begin_norm_axis={begin_norm_axis}, ndim={ndim})")
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    """reference fused_rms_norm.py (bias optional; last-axis norm)."""
+    _check_last_axis_only(begin_norm_axis, len(x.shape), "fused_rms_norm")
+    out = F.rms_norm(x, norm_weight, epsilon=epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kwargs):
+    """reference fused_layer_norm.py (last-axis norm)."""
+    _check_last_axis_only(begin_norm_axis, len(x.shape), "fused_layer_norm")
+    shape = [x.shape[-1]]
+    return F.layer_norm(x, shape, weight=norm_weight, bias=norm_bias,
+                        epsilon=epsilon)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """reference fused_matmul_bias.py: one matmul+bias epilogue."""
+
+    def f(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply_op(f, *args, name="fused_matmul_bias")
+
+
+fused_linear = fused_matmul_bias
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """reference fused_dropout_add.py: dropout(x) + y in one body."""
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                is_causal=False, training=True, scale=None,
+                                **kwargs):
+    """reference fused_dot_product_attention.py — the Pallas flash kernel IS
+    the fused attention on TPU. A non-default scale is honored by pre-scaling
+    q (softmax(q*s @ k^T) == softmax-with-scale s)."""
+    if scale is not None:
+        import math
+
+        default = 1.0 / math.sqrt(q.shape[-1])
+        if abs(scale - default) > 1e-12:
+            q = q * (scale / default)
+    return F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)

@@ -6,7 +6,8 @@ sync (reducer.cc:1093). TPU-native: ONE jitted XLA program computes
 loss -> grads -> optimizer update with:
   - parameters/optimizer state living as device arrays between steps (donated,
     so updates are in-place in HBM),
-  - shardings from the mesh: batch over "dp"/"sharding"(+"sep"), params over
+  - shardings from the mesh: batch dim 0 over "dp"/"sharding", the
+    SEQUENCE dim over "sep" (context parallelism), params over
     "mp" (from the `_mp_pspec` annotations the TP layers attach), optimizer
     state over "sharding"/"dp" for ZeRO,
   - XLA inserting + overlapping all collectives (grad psum over dp ≈ the
