@@ -68,16 +68,29 @@ __version__ = "0.1.0"
 grad = autograd.grad
 
 
-def disable_static(*a, **k):
-    """Eager mode is the default; kept for API parity."""
+import threading as _threading
+
+_static_tls = _threading.local()
 
 
 def enable_static(*a, **k):
-    raise NotImplementedError(
-        "legacy static-graph Program mode is not supported; use paddle_tpu.jit.to_static "
-        "(whole-program XLA compilation) instead"
-    )
+    """Enter static-graph mode: subsequent ops on THIS thread record into
+    `static.default_main_program()` (reference: paddle.enable_static).
+    Recording state is thread-local, like the guard stack it wraps."""
+    if getattr(_static_tls, "guard", None) is None:
+        from paddle_tpu.static.graph import default_main_program, default_startup_program, program_guard
+
+        _static_tls.guard = program_guard(default_main_program(), default_startup_program())
+        _static_tls.guard.__enter__()
+
+
+def disable_static(*a, **k):
+    """Back to eager (the default)."""
+    guard = getattr(_static_tls, "guard", None)
+    if guard is not None:
+        guard.__exit__(None, None, None)
+        _static_tls.guard = None
 
 
 def in_dynamic_mode():
-    return True
+    return getattr(_static_tls, "guard", None) is None

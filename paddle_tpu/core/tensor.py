@@ -169,13 +169,25 @@ class Tensor:
         return _Handle()
 
     def detach(self) -> "Tensor":
-        return Tensor(self._value, stop_gradient=True, name=self.name)
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        tag = getattr(self, "_static_var", None)
+        if tag is not None:
+            # detach cuts only the autograd edge; in a recording static
+            # Program the detached view is still the same variable
+            t._static_var = tag
+        return t
 
     def clone(self) -> "Tensor":
         return apply_op(lambda x: x + 0, self, name="clone")
 
     # in-place value swap (optimizer updates); keeps autograd identity as leaf
     def _set_value(self, new_value):
+        if _static_recorder is not None and isinstance(new_value, Tensor):
+            # static recording: a mutation whose source is a recorded variable
+            # becomes a per-run writeback (BN running stats etc.)
+            hook = getattr(_static_recorder, "set_value", None)
+            if hook is not None:
+                hook(self, new_value)
         if isinstance(new_value, Tensor):
             new_value = new_value._value
         self._value = new_value
@@ -264,15 +276,38 @@ def _nan_check(name, vals):
             raise FloatingPointError(f"nan/inf detected in output of op '{name}'")
 
 
+# Static-graph instruction recorder (paddle_tpu.static). When set, every
+# apply_op dispatch is additionally appended to the recording Program as an
+# instruction node — the analog of op registration into ProgramDesc
+# (reference: python/paddle/base/framework.py append_op under static mode).
+_static_recorder = None
+
+
+def set_static_recorder(recorder):
+    """Install (or clear, with None) the static-graph instruction recorder.
+
+    recorder(name, fn, tensor_args, out_tensors, rng_args) is called after
+    eager execution of each op; `fn` is the kwargs-bound pure jax function,
+    `rng_args` the positional indices holding PRNG-key constants (so replay
+    can refresh randomness per run).
+    """
+    global _static_recorder
+    prev = _static_recorder
+    _static_recorder = recorder
+    return prev
+
+
 def apply_op(fn: Callable, *tensor_args, name: str | None = None, n_outputs: int | None = None,
-             **static_kwargs):
+             rng_args: tuple = (), **static_kwargs):
     """Execute one op eagerly with optional tape recording.
 
     `fn(*arrays, **static_kwargs)` must be a pure jax function of its array
     args; `tensor_args` may mix Tensors and raw arrays/scalars (raw args are
-    treated as constants). Returns Tensor or tuple of Tensors matching fn's
-    output structure. This is the single seam every op goes through — the
-    analog of the generated `*_ad_func` + phi api call chain (SURVEY §3.1).
+    treated as constants). `rng_args` marks positional indices carrying PRNG
+    keys (consumed by the static recorder for per-run refresh). Returns Tensor
+    or tuple of Tensors matching fn's output structure. This is the single
+    seam every op goes through — the analog of the generated `*_ad_func` +
+    phi api call chain (SURVEY §3.1).
     """
     name = name or getattr(fn, "__name__", "op")
     tensors = [a for a in tensor_args if isinstance(a, Tensor)]
@@ -314,6 +349,8 @@ def apply_op(fn: Callable, *tensor_args, name: str | None = None, n_outputs: int
             out_tensors.append(t)
         if flag("check_nan_inf"):
             _nan_check(name, outs_list)
+        if _static_recorder is not None:
+            _static_recorder(name, f, tensor_args, out_tensors, rng_args)
         if multi:
             return tuple(out_tensors)
         return out_tensors[0]
@@ -324,6 +361,8 @@ def apply_op(fn: Callable, *tensor_args, name: str | None = None, n_outputs: int
     if flag("check_nan_inf"):
         _nan_check(name, outs_list)
     outs = [Tensor(o, stop_gradient=True) for o in outs_list]
+    if _static_recorder is not None:
+        _static_recorder(name, f, tensor_args, outs, rng_args)
     return tuple(outs) if multi else outs[0]
 
 

@@ -536,10 +536,17 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
 
         args = [x] + [_t(a) for a in (weight, bias) if a is not None]
         out, mean, var = apply_op(f, *args, name="batch_norm")
-        # update running stats host-side (buffers)
+        # running-stat EMA goes through apply_op (not raw host math) so a
+        # recording static Program captures it as an instruction; _set_value
+        # with the result Tensor then registers a per-run writeback
         if running_mean is not None:
-            running_mean._set_value(momentum * running_mean._value + (1 - momentum) * mean._value)
-            running_var._set_value(momentum * running_var._value + (1 - momentum) * var._value)
+            def ema(old, new):
+                return momentum * old + (1 - momentum) * new
+
+            running_mean._set_value(
+                apply_op(ema, _t(running_mean), mean.detach(), name="bn_stat_update"))
+            running_var._set_value(
+                apply_op(ema, _t(running_var), var.detach(), name="bn_stat_update"))
         return out
 
     def f(v, m, va, *wb):
@@ -633,17 +640,19 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
 
     key = current_dropout_key()
 
-    def f(v):
+    def f(v, k):
         shape = v.shape
         if axis is not None:
             axes = (axis,) if isinstance(axis, int) else tuple(axis)
             shape = tuple(s if i in axes else 1 for i, s in enumerate(v.shape))
-        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        keep = jax.random.bernoulli(k, 1.0 - p, shape)
         if mode == "upscale_in_train":
             return jnp.where(keep, v / (1.0 - p), 0.0)
         return jnp.where(keep, v, 0.0)
 
-    return apply_op(f, _t(x), name="dropout")
+    # key as a positional arg (not a closure) so static-graph replay can
+    # substitute a fresh fold per run (rng_args marks it for the recorder)
+    return apply_op(f, _t(x), key, name="dropout", rng_args=(1,))
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
@@ -658,13 +667,13 @@ def alpha_dropout(x, p=0.5, training=True):
     alpha_p = -alpha * scale
     key = default_generator.next_key()
 
-    def f(v):
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+    def f(v, k):
+        keep = jax.random.bernoulli(k, 1.0 - p, v.shape)
         a = (1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2))) if p < 1 else 0.0
         b = -a * alpha_p * p
         return a * jnp.where(keep, v, alpha_p) + b
 
-    return apply_op(f, _t(x), name="alpha_dropout")
+    return apply_op(f, _t(x), key, name="alpha_dropout", rng_args=(1,))
 
 
 # ---------------------------------------------------------------------------

@@ -130,6 +130,12 @@ class Optimizer:
             self._state[sid] = new_state
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from paddle_tpu.static.graph import _register_minimize
+
+        if _register_minimize(self, loss):
+            # recording into a static Program: Executor.run becomes the
+            # jitted train step; nothing executes now
+            return None, [(p, None) for p in self._params]
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._params]

@@ -1,11 +1,23 @@
-"""Static-graph API shim (reference: python/paddle/static).
+"""Static-graph user API (reference: python/paddle/static).
 
-The reference's ProgramDesc/Executor static mode is superseded on TPU by
-whole-program XLA compilation: `paddle_tpu.jit.to_static` captures the graph
-and compiles it once (the analog of StandaloneExecutor+PirInterpreter,
-reference new_executor/pir_interpreter.cc). `InputSpec` is kept as the shape
-declaration type.
+TPU-native design (see graph.py): a Program is a recorded instruction list
+over the `apply_op` seam, replayed as ONE jitted XLA program by Executor —
+the ProgramDesc + StandaloneExecutor/PirInterpreter stack collapses into
+trace-record + whole-program compilation. `InputSpec` doubles as the shape
+declaration type for `jit.to_static` AOT warmup.
 """
 from paddle_tpu.jit.api import InputSpec  # noqa: F401
+from paddle_tpu.static.graph import (  # noqa: F401
+    Executor,
+    Program,
+    data,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from paddle_tpu.static import nn  # noqa: F401
 
-__all__ = ["InputSpec"]
+__all__ = [
+    "InputSpec", "Program", "program_guard", "data", "Executor",
+    "default_main_program", "default_startup_program", "nn",
+]

@@ -1,0 +1,417 @@
+"""Static-graph user API: Program / program_guard / data / Executor.
+
+Reference parity: python/paddle/static — ProgramDesc built by op appends under
+static mode (reference python/paddle/base/framework.py), executed by
+StandaloneExecutor/PirInterpreter (reference
+paddle/fluid/framework/new_executor/pir_interpreter.cc:766 BuildInstruction,
+python/paddle/base/executor.py:1637 run).
+
+TPU-native design: while a Program is recording, every `apply_op` dispatch is
+appended as an *instruction* — (pure jax fn, input var-ids/constants, output
+var-ids) — while still executing eagerly for shape/dtype propagation (the
+InferMeta analog comes free). `Executor.run` replays the instruction list as
+one pure jax function of (feeds, params) and jits it per feed signature: the
+whole Program IS one XLA executable, which is what the reference's interpreter
++ instruction scheduling collapse into on TPU. `optimizer.minimize(loss)`
+recorded in a Program turns `Executor.run` into a donated, jitted train step
+(jax.value_and_grad over the replay + the optimizer's functional `_update`).
+
+Parameters are captured live: a Layer built inside `program_guard` registers
+its Parameters the first time an instruction consumes them, and the train-step
+writes updates back, so eager inspection (`layer.state_dict()`) stays truthful
+after static training — no separate Scope is needed.
+
+PRNG-consuming instructions (dropout: `rng_args` at the apply_op seam) record
+their build-time key and are replayed with `fold_in(key, run_counter)` so
+masks refresh per run while staying deterministic per seed.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd import no_grad
+from paddle_tpu.core import tensor as _tensor_mod
+from paddle_tpu.core.dtype import to_jax_dtype
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "Program", "program_guard", "data", "Executor",
+    "default_main_program", "default_startup_program",
+]
+
+
+@dataclass
+class _Instr:
+    fn: object            # kwargs-bound pure jax function
+    in_desc: list         # ("var", vid) | ("const", value) | ("rng", key)
+    out_ids: list
+    name: str
+
+
+class Program:
+    """A recorded instruction list with feed/param/fetch var bookkeeping."""
+
+    def __init__(self):
+        self.instrs: list[_Instr] = []
+        self.feed_vars: dict[str, tuple[int, tuple, object]] = {}  # name -> (vid, shape, dtype)
+        self.params: dict[int, Tensor] = {}  # vid -> live Tensor (captured state)
+        self._mutated: dict[int, Tensor] = {}  # id(t) -> t with per-run writeback
+        self._next_id = 0
+        self._opt = None          # (optimizer, loss_vid)
+        self._opt_state = None    # {vid: state-dict pytree}
+        self._cache: dict = {}
+        self._run_counter = 0
+        self._graph_id = object()  # shared by clones: variable-ownership token
+        self._apply_writebacks = True
+
+    # -- build-time ---------------------------------------------------------
+    def _new_var(self) -> int:
+        vid = self._next_id
+        self._next_id += 1
+        return vid
+
+    def _var_id_of(self, t: Tensor) -> int:
+        """Var id of `t` in THIS program, capturing it as a parameter/state
+        var if it was produced outside the recorded region."""
+        tag = getattr(t, "_static_var", None)
+        if tag is not None and tag[0]._graph_id is self._graph_id:
+            return tag[1]
+        vid = self._new_var()
+        t._static_var = (self, vid)
+        self.params[vid] = t
+        return vid
+
+    def _record(self, name, fn, tensor_args, out_tensors, rng_args):
+        desc = []
+        for i, a in enumerate(tensor_args):
+            if isinstance(a, Tensor):
+                desc.append(("var", self._var_id_of(a)))
+            elif i in rng_args:
+                desc.append(("rng", a))
+            else:
+                desc.append(("const", a))
+        out_ids = []
+        for t in out_tensors:
+            vid = self._new_var()
+            t._static_var = (self, vid)
+            out_ids.append(vid)
+        self.instrs.append(_Instr(fn, desc, out_ids, name))
+
+    # -- parity surface -----------------------------------------------------
+    def global_block(self):
+        return self
+
+    _TRAIN_ONLY_OPS = ("dropout", "alpha_dropout")
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """Share variables/params with the original (same _graph_id). A
+        for_test clone drops the optimizer, replaces dropout instructions
+        with identity, and stops updating captured running statistics.
+        (BatchNorm batch-vs-global statistics follow how the program was
+        BUILT — build the eval program with layer.eval()/is_test=True for
+        reference `clone(for_test)` normalization semantics.)"""
+        p = Program.__new__(Program)
+        p.__dict__ = dict(self.__dict__)
+        p._cache = {}
+        if for_test:
+            p._opt = None
+            p._apply_writebacks = False
+            instrs = []
+            for ins in self.instrs:
+                if ins.name in self._TRAIN_ONLY_OPS:
+                    src = next(d for d in ins.in_desc if d[0] == "var")
+                    instrs.append(_Instr((lambda v: v), [src], list(ins.out_ids),
+                                         ins.name + "_eval"))
+                else:
+                    instrs.append(ins)
+            p.instrs = instrs
+        return p
+
+    def state_dict(self):
+        return {f"var_{vid}": t for vid, t in self.params.items()}
+
+    def num_ops(self) -> int:
+        return len(self.instrs)
+
+    def __repr__(self):
+        return (f"Program(instrs={len(self.instrs)}, feeds={list(self.feed_vars)}, "
+                f"params={len(self.params)}, train={self._opt is not None})")
+
+    # -- replay -------------------------------------------------------------
+    def _replay_env(self, feed_ids, param_ids, feed_vals, param_vals, counter):
+        env = dict(zip(feed_ids, feed_vals))
+        env.update(zip(param_ids, param_vals))
+        for k, ins in enumerate(self.instrs):
+            args = []
+            for d in ins.in_desc:
+                if d[0] == "var":
+                    args.append(env[d[1]])
+                elif d[0] == "rng":
+                    args.append(jax.random.fold_in(jax.random.fold_in(d[1], k), counter))
+                else:
+                    args.append(d[1])
+            out = ins.fn(*args)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for vid, o in zip(ins.out_ids, outs):
+                env[vid] = o
+        return env
+
+
+# ---------------------------------------------------------------------------
+_tls = threading.local()
+
+
+def _current_program() -> Program | None:
+    return getattr(_tls, "program", None)
+
+
+class _Recorder:
+    """apply_op/_set_value hooks routed to the thread's recording Program."""
+
+    def __call__(self, name, fn, tensor_args, out_tensors, rng_args):
+        prog = _current_program()
+        if prog is not None:
+            prog._record(name, fn, tensor_args, out_tensors, rng_args)
+
+    def set_value(self, target: Tensor, value: Tensor):
+        """`target._set_value(recorded_var)` during recording rebinds the
+        target to the new var and schedules a per-run writeback (how BN
+        running statistics keep updating under Executor.run)."""
+        prog = _current_program()
+        if prog is None:
+            return
+        tag = getattr(value, "_static_var", None)
+        if tag is None or tag[0]._graph_id is not prog._graph_id:
+            return
+        prog._var_id_of(target)  # ensure the pre-mutation value is a feed var
+        prog._mutated[id(target)] = target
+        target._static_var = (prog, tag[1])
+
+
+class program_guard:
+    """Record ops executed in the body into `main_program`.
+
+    `startup_program` is accepted for parity; parameter initialization is
+    eager at Layer construction on TPU, so the startup program stays empty
+    and `Executor.run(startup)` is a no-op.
+    """
+
+    def __init__(self, main_program: Program, startup_program: Program | None = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self._prev_prog = _current_program()
+        _tls.program = self.main
+        self._prev_rec = _tensor_mod.set_static_recorder(_Recorder())
+        # the replay computes gradients with jax.value_and_grad over the whole
+        # program; the eager tape is unnecessary during build
+        self._ng = no_grad()
+        self._ng.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ng.__exit__(*exc)
+        _tensor_mod.set_static_recorder(self._prev_rec)
+        _tls.program = self._prev_prog
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Declare a feed variable (reference: paddle.static.data).
+
+    Dims given as None/-1 (batch) are traced at a placeholder size of 1; the
+    replay function is shape-polymorphic, and Executor re-jits per distinct
+    feed signature (shape bucketing is the caller's concern, as with any jit).
+    """
+    prog = _current_program()
+    if prog is None:
+        raise RuntimeError("static.data must be called inside program_guard "
+                           "(or after paddle.enable_static())")
+    jdt = to_jax_dtype(dtype)
+    concrete = tuple(1 if (s is None or (isinstance(s, int) and s < 0)) else int(s)
+                     for s in shape)
+    t = Tensor(jnp.zeros(concrete, jdt), stop_gradient=True, name=name)
+    vid = prog._new_var()
+    t._static_var = (prog, vid)
+    prog.feed_vars[name] = (vid, tuple(shape), jdt)
+    return t
+
+
+# ---------------------------------------------------------------------------
+_defaults = threading.local()
+
+
+def default_main_program() -> Program:
+    if not hasattr(_defaults, "main"):
+        _defaults.main = Program()
+    return _defaults.main
+
+
+def default_startup_program() -> Program:
+    if not hasattr(_defaults, "startup"):
+        _defaults.startup = Program()
+    return _defaults.startup
+
+
+def _reset_default_programs():
+    _defaults.main = Program()
+    _defaults.startup = Program()
+
+
+class Executor:
+    """Replay a Program as one jitted XLA program (reference:
+    python/paddle/base/executor.py:1637 run → StandaloneExecutor)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Program | None = None, feed: dict | None = None,
+            fetch_list=None, return_numpy: bool = True, **kw):
+        prog = program if program is not None else default_main_program()
+        if not isinstance(prog, Program):
+            raise TypeError(f"Executor.run expects a static.Program, got {type(prog)}")
+        if not prog.instrs:  # startup program: params are eager-initialized
+            return []
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+
+        missing = [n for n in prog.feed_vars if n not in feed]
+        if missing:
+            raise ValueError(f"missing feeds: {missing} (declared: {list(prog.feed_vars)})")
+
+        feed_names = list(prog.feed_vars)
+        feed_vals = []
+        for n in feed_names:
+            vid, _, jdt = prog.feed_vars[n]
+            v = feed[n]
+            arr = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v), jdt)
+            feed_vals.append(arr)
+
+        fetch_ids = []
+        for fv in fetch_list:
+            tag = getattr(fv, "_static_var", None)
+            # clones share the graph id, so a clone's variables are
+            # fetchable from the original and vice versa
+            if tag is None or tag[0]._graph_id is not prog._graph_id:
+                raise ValueError("fetch_list entries must be variables of the run program")
+            fetch_ids.append(tag[1])
+
+        param_ids = list(prog.params)
+        feed_ids = [prog.feed_vars[n][0] for n in feed_names]
+        # per-run writebacks (BN running stats): final var id of each mutated
+        # tensor, fetched alongside and written back after the run
+        wb_tensors, wb_ids = [], []
+        if prog._apply_writebacks:
+            for t in prog._mutated.values():
+                tag = getattr(t, "_static_var", None)
+                if tag is not None and tag[0]._graph_id is prog._graph_id:
+                    wb_tensors.append(t)
+                    wb_ids.append(tag[1])
+        sig = (tuple((v.shape, str(v.dtype)) for v in feed_vals),
+               tuple(fetch_ids), tuple(wb_ids))
+
+        if prog._opt is not None:
+            outs, wb_vals = self._run_train(prog, sig, feed_ids, param_ids,
+                                            feed_vals, fetch_ids, wb_ids)
+        else:
+            fn = prog._cache.get(sig)
+            if fn is None:
+                def infer_fn(feed_vals, param_vals, counter):
+                    env = prog._replay_env(feed_ids, param_ids, feed_vals, param_vals, counter)
+                    return [env[i] for i in fetch_ids], [env[i] for i in wb_ids]
+
+                fn = jax.jit(infer_fn)
+                prog._cache[sig] = fn
+            param_vals = [prog.params[i]._value for i in param_ids]
+            outs, wb_vals = fn(feed_vals, param_vals,
+                               jnp.asarray(prog._run_counter, jnp.int32))
+        for t, v in zip(wb_tensors, wb_vals):
+            t._value = v
+        prog._run_counter += 1
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    # -- train path ---------------------------------------------------------
+    def _run_train(self, prog, sig, feed_ids, param_ids, feed_vals, fetch_ids, wb_ids):
+        opt, loss_vid = prog._opt
+        # trainable = optimizer params that this program actually captured
+        # (prog.params is shared with clones, so membership is checked there
+        # rather than against the tag's program identity)
+        opt_vids = set()
+        for p in opt._parameter_list():
+            tag = getattr(p, "_static_var", None)
+            if tag is not None and prog.params.get(tag[1]) is p and not p.stop_gradient:
+                opt_vids.add(tag[1])
+        train_ids = [vid for vid in param_ids if vid in opt_vids]
+        other_ids = [vid for vid in param_ids if vid not in opt_vids]
+
+        if prog._opt_state is None:
+            prog._opt_state = {}
+        for vid in train_ids:
+            if vid not in prog._opt_state:
+                prog._opt_state[vid] = opt._init_state(prog.params[vid])
+
+        key = ("train",) + sig + (tuple(train_ids), tuple(wb_ids))
+        fn = prog._cache.get(key)
+        if fn is None:
+            clip = opt._grad_clip
+
+            def step_fn(feed_vals, train_vals, other_vals, states, lr, stepi, counter):
+                def loss_of(tv):
+                    env = prog._replay_env(
+                        feed_ids, train_ids + other_ids, feed_vals,
+                        list(tv) + list(other_vals), counter)
+                    return env[loss_vid].astype(jnp.float32).sum(), env
+
+                (loss, env), grads = jax.value_and_grad(loss_of, has_aux=True)(tuple(train_vals))
+                grads = [g.astype(p.dtype) for g, p in zip(grads, train_vals)]
+                if clip is not None:
+                    pairs = clip([(Tensor(p), Tensor(g)) for p, g in zip(train_vals, grads)])
+                    grads = [g._value for _, g in pairs]
+                new_train, new_states = [], []
+                for pv, gv, st in zip(train_vals, grads, states):
+                    npv, nst = opt._update(pv, gv, st, lr, stepi)
+                    new_train.append(npv)
+                    new_states.append(nst)
+                fetches = [env[i] for i in fetch_ids]
+                return fetches, new_train, new_states, [env[i] for i in wb_ids]
+
+            fn = jax.jit(step_fn, donate_argnums=(1, 3))
+            prog._cache[key] = fn
+
+        opt._step_count += 1
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        stepi = jnp.asarray(opt._step_count, jnp.int32)
+        train_vals = [prog.params[i]._value for i in train_ids]
+        other_vals = [prog.params[i]._value for i in other_ids]
+        states = [prog._opt_state[i] for i in train_ids]
+        fetches, new_train, new_states, wb_vals = fn(
+            feed_vals, train_vals, other_vals, states, lr, stepi,
+            jnp.asarray(prog._run_counter, jnp.int32))
+        for vid, nv, nst in zip(train_ids, new_train, new_states):
+            p = prog.params[vid]
+            p._set_value(nv)
+            prog._opt_state[vid] = nst
+            opt._state[id(p)] = nst  # keep optimizer.state_dict() truthful
+        return fetches, wb_vals
+
+
+def _register_minimize(optimizer, loss) -> bool:
+    """Route optimizer.minimize(loss) into the recording program. Returns
+    True when handled statically."""
+    prog = _current_program()
+    if prog is None:
+        return False
+    tag = getattr(loss, "_static_var", None)
+    if tag is None or tag[0]._graph_id is not prog._graph_id:
+        raise ValueError("minimize(loss): loss is not a variable of the "
+                         "recording program")
+    prog._opt = (optimizer, tag[1])
+    return True

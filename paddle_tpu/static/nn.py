@@ -1,0 +1,73 @@
+"""paddle.static.nn — layer-building helpers for static programs.
+
+Reference: python/paddle/static/nn/common.py (fc:63, batch_norm, conv2d...).
+Each helper constructs the corresponding eager Layer on the fly (parameters
+initialize eagerly, as the reference's startup program would) and applies it,
+so the ops record into the current Program like any other layer call.
+"""
+from __future__ import annotations
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= int(s)
+    if tuple(x.shape[num_flatten_dims:]) != (in_features,):
+        # -1 for the leading (batch) dim: static.data batch dims are traced at
+        # a placeholder size, so baking them in would break real batch sizes
+        new_shape = (-1,) + tuple(int(s) for s in x.shape[1:num_flatten_dims]) + (in_features,)
+        x = x.reshape(new_shape)
+    layer = nn.Linear(in_features, size, weight_attr=weight_attr, bias_attr=bias_attr)
+    out = layer(x)
+    if activation is not None:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(x, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCHW"):
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    in_channels = int(x.shape[1] if data_format == "NCHW" else x.shape[-1])
+    layer = nn.Conv2D(in_channels, num_filters, filter_size, stride=stride,
+                      padding=padding, dilation=dilation, groups=groups,
+                      weight_attr=param_attr, bias_attr=bias_attr,
+                      data_format=data_format)
+    out = layer(x)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(x, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_format="NCHW", in_place=False, name=None,
+               is_test=False):
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    channels = int(x.shape[1] if data_format in ("NCHW", "NCL") else x.shape[-1])
+    layer = nn.BatchNorm2D(channels, momentum=momentum, epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+    if is_test:
+        layer.eval()
+    out = layer(x)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32"):
+    import paddle_tpu.nn as nn
+
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=param_attr)
+    return layer(input)

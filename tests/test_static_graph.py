@@ -1,0 +1,204 @@
+"""Static-graph user API: Program recording + Executor replay.
+
+Reference: python/paddle/static (ProgramDesc build under static mode,
+base/executor.py:1637 Executor.run → StandaloneExecutor/PirInterpreter).
+TPU-native: instructions recorded at the apply_op seam replay as ONE jitted
+XLA program (paddle_tpu/static/graph.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import static
+
+
+def _batch(n=16, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 4).astype("float32")
+    y = (x[:, :1].sum(axis=1, keepdims=True) > 0).astype("int64")
+    return x, y
+
+
+def test_program_records_and_trains():
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "int64")
+        h = static.nn.fc(x, 16, activation="relu")
+        out = static.nn.fc(h, 3)
+        loss = F.cross_entropy(out, y).mean()
+        params = [t for t in main.params.values() if not t.stop_gradient]
+        opt = paddle.optimizer.Adam(0.05, parameters=params)
+        opt.minimize(loss)
+
+    assert main.num_ops() > 0 and "x" in main.feed_vars and "y" in main.feed_vars
+    exe = static.Executor()
+    assert exe.run(startup) == []  # params init eagerly; startup is a no-op
+
+    xb, yb = _batch()
+    losses = []
+    for _ in range(30):
+        lv, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.5 * losses[0], losses
+
+    # eval clone: no optimizer, shares instructions/params, fetchable
+    test_prog = main.clone(for_test=True)
+    ov, = exe.run(test_prog, feed={"x": xb, "y": yb}, fetch_list=[out])
+    assert ov.shape == (16, 3)
+    assert np.argmax(ov, axis=1).reshape(-1, 1).mean() >= 0  # sane numbers
+
+    # a different batch size re-jits the same polymorphic replay
+    xb5, yb5 = _batch(5, seed=1)
+    ov5, = exe.run(test_prog, feed={"x": xb5, "y": yb5}, fetch_list=[out])
+    assert ov5.shape == (5, 3)
+
+
+def test_static_matches_eager_losses():
+    """Same init, same data, same optimizer: recorded-replay training must
+    produce the same loss sequence as eager tape training."""
+
+    def build():
+        paddle.seed(7)
+        return nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 3))
+
+    xb, yb = _batch(8, seed=3)
+
+    # eager twin
+    model_e = build()
+    opt_e = paddle.optimizer.SGD(0.1, parameters=model_e.parameters())
+    eager_losses = []
+    for _ in range(5):
+        out = model_e(paddle.to_tensor(xb))
+        loss = F.cross_entropy(out, paddle.to_tensor(yb)).mean()
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+        eager_losses.append(float(loss))
+
+    # static twin (fresh but identically seeded params)
+    main = static.Program()
+    with static.program_guard(main):
+        model_s = build()
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "int64")
+        loss_v = F.cross_entropy(model_s(x), y).mean()
+        opt_s = paddle.optimizer.SGD(0.1, parameters=model_s.parameters())
+        opt_s.minimize(loss_v)
+
+    exe = static.Executor()
+    static_losses = [float(exe.run(main, feed={"x": xb, "y": yb},
+                                   fetch_list=[loss_v])[0]) for _ in range(5)]
+    np.testing.assert_allclose(static_losses, eager_losses, rtol=2e-5, atol=2e-6)
+
+    # static updates write back into the live parameters
+    np.testing.assert_allclose(
+        np.asarray(model_s.state_dict()["0.weight"]._value),
+        np.asarray(model_e.state_dict()["0.weight"]._value), rtol=2e-5, atol=2e-6)
+
+
+def test_enable_static_default_program():
+    paddle.seed(0)
+    from paddle_tpu.static.graph import _reset_default_programs
+
+    _reset_default_programs()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+        x = static.data("x", [None, 4], "float32")
+        out = static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        ov, = exe.run(static.default_main_program(),
+                      feed={"x": np.ones((3, 4), "float32")}, fetch_list=[out])
+        assert ov.shape == (3, 2)
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+
+
+def test_static_dropout_refreshes_per_run():
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 64], "float32")
+        out = F.dropout(x, 0.5, training=True)
+    exe = static.Executor()
+    feed = {"x": np.ones((2, 64), "float32")}
+    a, = exe.run(main, feed=feed, fetch_list=[out])
+    b, = exe.run(main, feed=feed, fetch_list=[out])
+    # masks must differ across runs (frozen-key replay would make them equal)
+    assert (a != b).any()
+    # and the dropout still zeroes ~half
+    assert 0.2 < (a == 0).mean() < 0.8
+
+
+def test_for_test_clone_is_deterministic():
+    """clone(for_test=True) neutralizes dropout: identical feeds give
+    identical outputs (reference Program.clone(for_test) semantics)."""
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 32], "float32")
+        out = F.dropout(x * 2.0, 0.5, training=True)
+    test_prog = main.clone(for_test=True)
+    exe = static.Executor()
+    feed = {"x": np.ones((2, 32), "float32")}
+    a, = exe.run(test_prog, feed=feed, fetch_list=[out])
+    b, = exe.run(test_prog, feed=feed, fetch_list=[out])
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(a, 2.0)  # identity, not a frozen mask
+
+
+def test_fc_flattens_with_polymorphic_batch():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3, 8], "float32")
+        out = static.nn.fc(x, 5)
+    exe = static.Executor()
+    ov, = exe.run(main, feed={"x": np.ones((4, 3, 8), "float32")}, fetch_list=[out])
+    assert ov.shape == (4, 5)
+
+
+def test_batch_norm_stats_update_across_runs():
+    """BN running statistics recorded as writeback instructions keep their
+    EMA moving under Executor.run (not frozen at build-time values)."""
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        bn = nn.BatchNorm1D(6, momentum=0.5)
+        out = bn(x)
+    exe = static.Executor()
+    rm0 = np.asarray(bn._mean._value).copy()
+    rs = np.random.RandomState(0)
+    feed = {"x": (rs.randn(32, 6) * 3 + 5).astype("float32")}
+    for _ in range(8):
+        exe.run(main, feed=feed, fetch_list=[out])
+    rm = np.asarray(bn._mean._value)
+    rv = np.asarray(bn._variance._value)
+    assert not np.allclose(rm, rm0)
+    # after 8 runs at momentum 0.5 the EMA is within ~0.4% of batch stats
+    np.testing.assert_allclose(rm, feed["x"].mean(0), rtol=0.1, atol=0.1)
+    np.testing.assert_allclose(rv, feed["x"].var(0), rtol=0.15, atol=0.15)
+    # eval clone does not move the stats
+    test_prog = main.clone(for_test=True)
+    exe.run(test_prog, feed=feed, fetch_list=[out])
+    np.testing.assert_array_equal(np.asarray(bn._mean._value), rm)
+
+
+def test_fetch_foreign_var_rejected():
+    main, other = static.Program(), static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        out = x * 2.0
+    with static.program_guard(other):
+        x2 = static.data("x", [2, 2], "float32")
+        out2 = x2 + 1.0
+    exe = static.Executor()
+    with pytest.raises(ValueError, match="fetch_list"):
+        exe.run(main, feed={"x": np.zeros((2, 2), "float32")}, fetch_list=[out2])
+    with pytest.raises(ValueError, match="missing feeds"):
+        exe.run(main, feed={}, fetch_list=[out])
