@@ -386,16 +386,37 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode
     return _pool(x, kernel_size, stride, padding, 1, "avg", 0.0, "NCL")
 
 
+def _adaptive_bin_matrix(in_size: int, out_size: int):
+    """(out_size, in_size) row-averaging matrix: row i averages the adaptive
+    bin [floor(i*in/out), ceil((i+1)*in/out)) — torch/paddle bin semantics."""
+    m = np.zeros((out_size, in_size), np.float32)
+    for i in range(out_size):
+        lo = (i * in_size) // out_size
+        hi = -(-((i + 1) * in_size) // out_size)  # ceil div
+        m[i, lo:hi] = 1.0 / (hi - lo)
+    return m
+
+
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     os = _pair(output_size)
     x = _t(x)
-    h, w = x._value.shape[2], x._value.shape[3]
+    if data_format == "NCHW":
+        h, w = x._value.shape[2], x._value.shape[3]
+    else:
+        h, w = x._value.shape[1], x._value.shape[2]
     if h % os[0] == 0 and w % os[1] == 0:
         return _pool(x, (h // os[0], w // os[1]), (h // os[0], w // os[1]), 0, 2, "avg", 0.0, data_format)
-    # general: mean over computed windows via interpolation-style reduction
+    # non-divisible bins: contract with per-axis averaging matrices — two
+    # skinny MXU matmuls instead of 16 gather/slice reductions
+    ah = _adaptive_bin_matrix(h, os[0])
+    aw = _adaptive_bin_matrix(w, os[1])
+
     def f(v):
-        vh = v.reshape(v.shape[0], v.shape[1], os[0], h // os[0] if h % os[0] == 0 else -1, w)
-        raise NotImplementedError("adaptive_avg_pool2d requires divisible sizes for now")
+        if data_format == "NCHW":
+            return jnp.einsum("nchw,oh,pw->ncop", v, ah, aw,
+                              preferred_element_type=v.dtype)
+        return jnp.einsum("nhwc,oh,pw->nopc", v, ah, aw,
+                          preferred_element_type=v.dtype)
 
     return apply_op(f, x, name="adaptive_avg_pool2d")
 
@@ -404,14 +425,35 @@ def adaptive_avg_pool1d(x, output_size):
     x = _t(x)
     l = x._value.shape[2]
     os = int(output_size)
-    return _pool(x, l // os, l // os, 0, 1, "avg", 0.0, "NCL")
+    if l % os == 0:
+        return _pool(x, l // os, l // os, 0, 1, "avg", 0.0, "NCL")
+    a = _adaptive_bin_matrix(l, os)
+
+    def f(v):
+        return jnp.einsum("ncl,ol->nco", v, a, preferred_element_type=v.dtype)
+
+    return apply_op(f, x, name="adaptive_avg_pool1d")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False):
     os = _pair(output_size)
     x = _t(x)
     h, w = x._value.shape[2], x._value.shape[3]
-    return _pool(x, (h // os[0], w // os[1]), (h // os[0], w // os[1]), 0, 2, "max", -np.inf, "NCHW")
+    if h % os[0] == 0 and w % os[1] == 0:
+        return _pool(x, (h // os[0], w // os[1]), (h // os[0], w // os[1]), 0, 2, "max", -np.inf, "NCHW")
+
+    def bins(size, out):
+        return [((i * size) // out, -(-((i + 1) * size) // out)) for i in range(out)]
+
+    hb, wb = bins(h, os[0]), bins(w, os[1])
+
+    def f(v):
+        rows = [jnp.stack([v[:, :, hl:hh, wl:wh].max(axis=(2, 3))
+                           for (wl, wh) in wb], axis=-1)
+                for (hl, hh) in hb]
+        return jnp.stack(rows, axis=-2)
+
+    return apply_op(f, x, name="adaptive_max_pool2d")
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):

@@ -10,3 +10,21 @@ from paddle_tpu.vision.models.mobilenetv2 import (  # noqa: F401
     InvertedResidual, MobileNetV2, mobilenet_v2,
 )
 from paddle_tpu.vision.models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from paddle_tpu.vision.models.resnet import (  # noqa: F401
+    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+    resnext152_32x4d, resnext152_64x4d, wide_resnet50_2, wide_resnet101_2,
+)
+from paddle_tpu.vision.models.densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
+)
+from paddle_tpu.vision.models.googlenet import GoogLeNet, googlenet  # noqa: F401
+from paddle_tpu.vision.models.inceptionv3 import InceptionV3, inception_v3  # noqa: F401
+from paddle_tpu.vision.models.mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
+from paddle_tpu.vision.models.mobilenetv3 import (  # noqa: F401
+    MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large, mobilenet_v3_small,
+)
+from paddle_tpu.vision.models.shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    shufflenet_v2_swish,
+)

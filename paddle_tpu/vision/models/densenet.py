@@ -1,0 +1,129 @@
+"""DenseNet family (reference: python/paddle/vision/models/densenet.py).
+
+Dense blocks concatenate features along channels; on TPU the concat chain
+fuses into the following 1x1 conv's im2col-free matmul, so the memory cost
+stays O(growth_rate) per layer under XLA's buffer reuse.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops.manipulation import concat, flatten
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CONFIGS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, c_in, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(c_in)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(c_in, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                               bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
+
+
+class _DenseBlock(nn.Layer):
+    def __init__(self, c_in, growth_rate, num_layers, bn_size, dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            _DenseLayer(c_in + i * growth_rate, growth_rate, bn_size, dropout)
+            for i in range(num_layers)
+        ])
+        self.out_channels = c_in + num_layers * growth_rate
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class _Transition(nn.Layer):
+    def __init__(self, c_in, c_out):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(c_in)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(c_in, c_out, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in _CONFIGS:
+            raise ValueError(f"layers must be one of {sorted(_CONFIGS)}, got {layers}")
+        num_init_features, growth_rate, block_cfg = _CONFIGS[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, num_init_features, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(num_init_features),
+            nn.ReLU(),
+            nn.MaxPool2D(3, 2, 1),
+        )
+        blocks = []
+        c = num_init_features
+        for i, n in enumerate(block_cfg):
+            block = _DenseBlock(c, growth_rate, n, bn_size, dropout)
+            blocks.append(block)
+            c = block.out_channels
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(c, c // 2))
+                c = c // 2
+        self.features = nn.Sequential(*blocks)
+        self.norm_final = nn.BatchNorm2D(c)
+        self.relu_final = nn.ReLU()
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.relu_final(self.norm_final(self.features(self.stem(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(264, **kwargs)

@@ -1,0 +1,150 @@
+"""Inception-v3 (reference: python/paddle/vision/models/inceptionv3.py).
+
+Factorized 7x1/1x7 and 3x1/1x3 convolutions map to skinny MXU matmuls that
+XLA fuses with the BN+ReLU epilogues.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops.manipulation import concat, flatten
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+def _conv_bn(c_in, c_out, kernel, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(c_in, c_out, kernel, stride=stride, padding=padding,
+                  bias_attr=False),
+        nn.BatchNorm2D(c_out),
+        nn.ReLU(),
+    )
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, c_in, pool_features):
+        super().__init__()
+        self.b1 = _conv_bn(c_in, 64, 1)
+        self.b5 = nn.Sequential(_conv_bn(c_in, 48, 1), _conv_bn(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_conv_bn(c_in, 64, 1), _conv_bn(64, 96, 3, padding=1),
+                                _conv_bn(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, 1), _conv_bn(c_in, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    """Grid reduction 35x35 -> 17x17."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = _conv_bn(c_in, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_conv_bn(c_in, 64, 1), _conv_bn(64, 96, 3, padding=1),
+                                 _conv_bn(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, c_in, c7):
+        super().__init__()
+        self.b1 = _conv_bn(c_in, 192, 1)
+        self.b7 = nn.Sequential(
+            _conv_bn(c_in, c7, 1),
+            _conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+            _conv_bn(c7, 192, (7, 1), padding=(3, 0)),
+        )
+        self.b7d = nn.Sequential(
+            _conv_bn(c_in, c7, 1),
+            _conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+            _conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+            _conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+            _conv_bn(c7, 192, (1, 7), padding=(0, 3)),
+        )
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, 1), _conv_bn(c_in, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    """Grid reduction 17x17 -> 8x8."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = nn.Sequential(_conv_bn(c_in, 192, 1), _conv_bn(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _conv_bn(c_in, 192, 1),
+            _conv_bn(192, 192, (1, 7), padding=(0, 3)),
+            _conv_bn(192, 192, (7, 1), padding=(3, 0)),
+            _conv_bn(192, 192, 3, stride=2),
+        )
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b1 = _conv_bn(c_in, 320, 1)
+        self.b3_stem = _conv_bn(c_in, 384, 1)
+        self.b3_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_conv_bn(c_in, 448, 1),
+                                      _conv_bn(448, 384, 3, padding=1))
+        self.b3d_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, 1), _conv_bn(c_in, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        b3 = concat([self.b3_a(s), self.b3_b(s)], axis=1)
+        d = self.b3d_stem(x)
+        b3d = concat([self.b3d_a(d), self.b3d_b(d)], axis=1)
+        return concat([self.b1(x), b3, b3d, self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.stem = nn.Sequential(
+            _conv_bn(3, 32, 3, stride=2),
+            _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, 2),
+            _conv_bn(64, 80, 1),
+            _conv_bn(80, 192, 3),
+            nn.MaxPool2D(3, 2),
+        )
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160), _InceptionC(768, 160),
+            _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,64 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py).
+
+Pure depthwise-separable stacks; the depthwise 3x3s run on the VPU, the
+pointwise 1x1s are MXU matmuls — XLA pipelines the pair per block.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops.manipulation import flatten
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+def _conv_bn(c_in, c_out, kernel, stride=1, padding=0, groups=1):
+    return nn.Sequential(
+        nn.Conv2D(c_in, c_out, kernel, stride=stride, padding=padding,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(c_out),
+        nn.ReLU(),
+    )
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, c_in, c_out, stride, scale):
+        super().__init__()
+        c_in = int(c_in * scale)
+        c_out = int(c_out * scale)
+        self.depthwise = _conv_bn(c_in, c_in, 3, stride=stride, padding=1, groups=c_in)
+        self.pointwise = _conv_bn(c_in, c_out, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        # (c_in, c_out, stride) for the 13 separable blocks
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        self.conv1 = _conv_bn(3, int(32 * scale), 3, stride=2, padding=1)
+        self.blocks = nn.Sequential(*[
+            _DepthwiseSeparable(ci, co, s, scale) for ci, co, s in cfg
+        ])
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,153 @@
+"""MobileNetV3 small/large (reference: python/paddle/vision/models/mobilenetv3.py).
+
+Squeeze-excite gates are global-pool matmuls; hardswish/hardsigmoid are
+cheap VPU elementwise fused into the conv epilogues.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops.manipulation import flatten
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _act(name):
+    return nn.Hardswish() if name == "hardswish" else nn.ReLU()
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        squeeze = _make_divisible(channels // reduction)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(channels, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze, channels, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, c_in, hidden, c_out, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if hidden != c_in:
+            layers += [nn.Conv2D(c_in, hidden, 1, bias_attr=False),
+                       nn.BatchNorm2D(hidden), _act(act)]
+        layers += [
+            nn.Conv2D(hidden, hidden, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=hidden, bias_attr=False),
+            nn.BatchNorm2D(hidden), _act(act),
+        ]
+        if use_se:
+            layers.append(_SqueezeExcite(hidden))
+        layers += [nn.Conv2D(hidden, c_out, 1, bias_attr=False), nn.BatchNorm2D(c_out)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class _MobileNetV3(nn.Layer):
+    # rows: kernel, expanded, out, use_se, activation, stride
+    CFG: list
+    LAST_CONV: int
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        first = _make_divisible(16 * scale)
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, first, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(first), nn.Hardswish(),
+        )
+        blocks = []
+        c_in = first
+        for k, e, c, se, act, s in self.CFG:
+            hidden = _make_divisible(e * scale)
+            c_out = _make_divisible(c * scale)
+            blocks.append(_InvertedResidual(c_in, hidden, c_out, k, s, se, act))
+            c_in = c_out
+        self.blocks = nn.Sequential(*blocks)
+        last = _make_divisible(self.LAST_CONV * scale)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(c_in, last, 1, bias_attr=False),
+            nn.BatchNorm2D(last), nn.Hardswish(),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            head = 1280 if self.LAST_CONV == 960 else 1024
+            self.classifier = nn.Sequential(
+                nn.Linear(last, head), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(head, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.conv_last(self.blocks(self.conv1(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    CFG = [
+        (3, 16, 16, True, "relu", 2),
+        (3, 72, 24, False, "relu", 2),
+        (3, 88, 24, False, "relu", 1),
+        (5, 96, 40, True, "hardswish", 2),
+        (5, 240, 40, True, "hardswish", 1),
+        (5, 240, 40, True, "hardswish", 1),
+        (5, 120, 48, True, "hardswish", 1),
+        (5, 144, 48, True, "hardswish", 1),
+        (5, 288, 96, True, "hardswish", 2),
+        (5, 576, 96, True, "hardswish", 1),
+        (5, 576, 96, True, "hardswish", 1),
+    ]
+    LAST_CONV = 576
+
+
+class MobileNetV3Large(_MobileNetV3):
+    CFG = [
+        (3, 16, 16, False, "relu", 1),
+        (3, 64, 24, False, "relu", 2),
+        (3, 72, 24, False, "relu", 1),
+        (5, 72, 40, True, "relu", 2),
+        (5, 120, 40, True, "relu", 1),
+        (5, 120, 40, True, "relu", 1),
+        (3, 240, 80, False, "hardswish", 2),
+        (3, 200, 80, False, "hardswish", 1),
+        (3, 184, 80, False, "hardswish", 1),
+        (3, 184, 80, False, "hardswish", 1),
+        (3, 480, 112, True, "hardswish", 1),
+        (3, 672, 112, True, "hardswish", 1),
+        (5, 672, 160, True, "hardswish", 2),
+        (5, 960, 160, True, "hardswish", 1),
+        (5, 960, 160, True, "hardswish", 1),
+    ]
+    LAST_CONV = 960
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -1,0 +1,151 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py).
+
+Channel shuffle is a reshape-transpose-reshape, which XLA lowers to a free
+layout change fused into the surrounding convs.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops.manipulation import concat, flatten
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+_STAGE_REPEATS = (4, 8, 4)
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = x.reshape((n, groups, c // groups, h, w))
+    x = x.transpose((0, 2, 1, 3, 4))
+    return x.reshape((n, c, h, w))
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class InvertedResidualUnit(nn.Layer):
+    def __init__(self, c_in, c_out, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = c_out // 2
+        if stride == 1:
+            in_branch = c_in // 2
+        else:
+            in_branch = c_in
+            # spatial-downsampling shortcut branch
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_branch, in_branch, 3, stride=stride, padding=1,
+                          groups=in_branch, bias_attr=False),
+                nn.BatchNorm2D(in_branch),
+                nn.Conv2D(in_branch, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                _act(act),
+            )
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in_branch if stride > 1 else in_branch, branch_c, 1,
+                      bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            _act(act),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            _act(act),
+        )
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1 = x[:, :c]
+            x2 = x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale must be one of {sorted(_STAGE_OUT)}, got {scale}")
+        outs = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, outs[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(outs[0]),
+            _act(act),
+        )
+        self.maxpool = nn.MaxPool2D(3, 2, 1)
+        stages = []
+        c_in = outs[0]
+        for stage_i, repeats in enumerate(_STAGE_REPEATS):
+            c_out = outs[stage_i + 1]
+            units = [InvertedResidualUnit(c_in, c_out, 2, act)]
+            units += [InvertedResidualUnit(c_out, c_out, 1, act)
+                      for _ in range(repeats - 1)]
+            stages.append(nn.Sequential(*units))
+            c_in = c_out
+        self.stages = nn.LayerList(stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(c_in, outs[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(outs[-1]),
+            _act(act),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for stage in self.stages:
+            x = stage(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

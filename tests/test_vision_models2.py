@@ -1,0 +1,86 @@
+"""Vision model families round 2 (reference python/paddle/vision/models):
+DenseNet, GoogLeNet, InceptionV3, ShuffleNetV2, MobileNetV1/V3, ResNeXt and
+wide-ResNet factories — forward shapes plus a train step on the cheap ones."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import models
+
+
+def _x(n, size, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(n, 3, size, size).astype(np.float32))
+
+
+@pytest.mark.parametrize("ctor,size", [
+    (lambda: models.shufflenet_v2_x0_25(num_classes=10), 64),
+    (lambda: models.mobilenet_v1(scale=0.25, num_classes=10), 64),
+    (lambda: models.mobilenet_v3_small(scale=0.5, num_classes=10), 64),
+])
+def test_small_families_train_step(ctor, size):
+    paddle.seed(0)
+    m = ctor()
+    m.train()
+    x = _x(2, size)
+    y = paddle.to_tensor(np.array([1, 3], np.int64))
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    out = m(x)
+    assert out.shape == [2, 10]
+    loss = F.cross_entropy(out, y)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss))
+
+
+def test_densenet121_forward_and_growth():
+    paddle.seed(0)
+    m = models.densenet121(num_classes=6)
+    m.eval()
+    assert m(_x(1, 64)).shape == [1, 6]
+    # 4 dense blocks with 6/12/24/16 layers
+    from paddle_tpu.vision.models.densenet import _DenseBlock
+    blocks = [s for _, s in m.named_sublayers() if isinstance(s, _DenseBlock)]
+    assert [len(b.layers) for b in blocks] == [6, 12, 24, 16]
+    assert blocks[-1].out_channels == 1024
+
+
+def test_googlenet_aux_heads_in_train_mode():
+    paddle.seed(0)
+    m = models.googlenet(num_classes=4)
+    m.train()
+    out, aux1, aux2 = m(_x(2, 96))
+    assert out.shape == [2, 4] and aux1.shape == [2, 4] and aux2.shape == [2, 4]
+    m.eval()
+    assert m(_x(2, 96)).shape == [2, 4]
+
+
+def test_inception_v3_forward():
+    paddle.seed(0)
+    m = models.inception_v3(num_classes=3)
+    m.eval()
+    # inception v3 needs >=75px; canonical input is 299
+    assert m(_x(1, 128)).shape == [1, 3]
+
+
+def test_shufflenet_channel_shuffle_permutes():
+    from paddle_tpu.vision.models.shufflenetv2 import channel_shuffle
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1))
+    got = np.asarray(channel_shuffle(x, 2)._value).reshape(-1)
+    np.testing.assert_array_equal(got, [0, 4, 1, 5, 2, 6, 3, 7])
+
+
+def test_resnext_and_wide_factories():
+    paddle.seed(0)
+    m = models.resnext50_32x4d(num_classes=2)
+    # grouped bottleneck: first block conv2 has 32 groups at width 128
+    from paddle_tpu.vision.models.resnet import BottleneckBlock
+    blk = next(s for _, s in m.named_sublayers() if isinstance(s, BottleneckBlock))
+    assert blk.conv2._groups == 32
+    w = models.wide_resnet50_2(num_classes=2)
+    wblk = next(s for _, s in w.named_sublayers() if isinstance(s, BottleneckBlock))
+    # doubled bottleneck width: 64 * (128/64) = 128 channels in stage 1
+    assert wblk.conv2.weight.shape[0] == 128
+    m.eval()
+    assert m(_x(1, 64)).shape == [1, 2]
