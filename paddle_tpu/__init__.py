@@ -30,6 +30,9 @@ from paddle_tpu.core.containers import (  # noqa: F401
     SelectedRows, TensorArray, array_length, array_pop, array_read,
     array_write, create_array,
 )
+from paddle_tpu.core.string_tensor import (  # noqa: F401
+    StringTensor, strings_empty, strings_lower, strings_upper,
+)
 from paddle_tpu.autograd.tape import enable_grad, no_grad, set_grad_enabled  # noqa: F401
 
 # ops (also installs Tensor methods)

@@ -1,0 +1,121 @@
+"""StringTensor: host-resident string tensor (reference: paddle/phi/core/
+string_tensor.h:33, kernels paddle/phi/kernels/strings/ — empty/copy/
+lower/upper with unicode handling via unicode.cc).
+
+TPU-native design: strings never touch the accelerator (no XLA string type);
+the storage is a numpy object array of Python str on host, which already
+carries full unicode semantics — the reference's pstring + unicode_flag
+tables exist because C++ lacks them. Ops stay shape-preserving elementwise,
+matching the StringsLowerUpper kernel contract.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "strings_empty", "strings_lower", "strings_upper"]
+
+
+class StringTensor:
+    """N-d tensor of variable-length unicode strings."""
+
+    def __init__(self, data, name: str | None = None):
+        if isinstance(data, StringTensor):
+            arr = data._data.copy()
+        else:
+            arr = np.array(data, dtype=object)
+            # normalize scalar entries to str (bytes decode as utf-8, the
+            # reference's default charconvert path)
+            flat = arr.reshape(-1)
+            for i, s in enumerate(flat):
+                if isinstance(s, bytes):
+                    flat[i] = s.decode("utf-8")
+                elif not isinstance(s, str):
+                    flat[i] = str(s)
+        self._data = arr
+        self.name = name
+
+    # -- TensorBase-shaped surface ------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return "pstring"
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def reshape(self, shape):
+        out = StringTensor.__new__(StringTensor)
+        out._data = self._data.reshape(shape)
+        out.name = self.name
+        return out
+
+    def copy_(self, other: "StringTensor"):
+        self._data = other._data.copy()
+        return self
+
+    def clone(self) -> "StringTensor":
+        return StringTensor(self)
+
+    # -- strings kernels ----------------------------------------------------
+    def _map(self, fn, name):
+        out = np.empty_like(self._data)
+        of, sf = out.reshape(-1), self._data.reshape(-1)
+        for i, s in enumerate(sf):
+            of[i] = fn(s)
+        t = StringTensor.__new__(StringTensor)
+        t._data = out
+        t.name = name
+        return t
+
+    def lower(self, use_utf8_encoding: bool = True) -> "StringTensor":
+        """Elementwise lowercase (reference strings_lower_upper_kernel.h;
+        use_utf8_encoding=False restricts to ASCII case folding)."""
+        if use_utf8_encoding:
+            return self._map(str.lower, "lower")
+        return self._map(lambda s: "".join(
+            c.lower() if ord(c) < 128 else c for c in s), "lower")
+
+    def upper(self, use_utf8_encoding: bool = True) -> "StringTensor":
+        if use_utf8_encoding:
+            return self._map(str.upper, "upper")
+        return self._map(lambda s: "".join(
+            c.upper() if ord(c) < 128 else c for c in s), "upper")
+
+    def __getitem__(self, idx):
+        got = self._data[idx]
+        if isinstance(got, str):
+            return got
+        t = StringTensor.__new__(StringTensor)
+        t._data = got
+        t.name = self.name
+        return t
+
+    def __eq__(self, other):
+        if isinstance(other, StringTensor):
+            return bool((self._data == other._data).all())
+        return NotImplemented
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def strings_empty(shape) -> StringTensor:
+    """reference: strings_empty_kernel.cc — uninitialized -> empty strings."""
+    t = StringTensor.__new__(StringTensor)
+    t._data = np.full(tuple(shape), "", dtype=object)
+    t.name = None
+    return t
+
+
+def strings_lower(x: StringTensor, use_utf8_encoding: bool = True) -> StringTensor:
+    return x.lower(use_utf8_encoding)
+
+
+def strings_upper(x: StringTensor, use_utf8_encoding: bool = True) -> StringTensor:
+    return x.upper(use_utf8_encoding)

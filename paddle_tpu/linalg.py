@@ -1,12 +1,14 @@
 """paddle.linalg namespace (reference: python/paddle/linalg.py — re-exports
 the tensor linalg ops under one module)."""
 from paddle_tpu.ops.linalg import (  # noqa: F401
-    cholesky, cond, cross, det, eig, eigh, eigvals, eigvalsh, inv, lstsq, lu,
-    matmul, matrix_power, matrix_rank, multi_dot, norm, pinv, qr, slogdet,
-    solve, svd, triangular_solve,
+    cholesky, cholesky_solve, cond, corrcoef, cov, cross, det, eig, eigh,
+    eigvals, eigvalsh, householder_product, inv, lstsq, lu, lu_unpack,
+    matmul, matrix_exp, matrix_power, matrix_rank, multi_dot, norm, pinv, qr,
+    slogdet, solve, svd, triangular_solve,
 )
 
-__all__ = ["cholesky", "cond", "cross", "det", "eig", "eigh", "eigvals",
-           "eigvalsh", "inv", "lstsq", "lu", "matmul", "matrix_power",
-           "matrix_rank", "multi_dot", "norm", "pinv", "qr", "slogdet",
-           "solve", "svd", "triangular_solve"]
+__all__ = ["cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "cross",
+           "det", "eig", "eigh", "eigvals", "eigvalsh", "householder_product",
+           "inv", "lstsq", "lu", "lu_unpack", "matmul", "matrix_exp",
+           "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv", "qr",
+           "slogdet", "solve", "svd", "triangular_solve"]

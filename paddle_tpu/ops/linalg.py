@@ -14,8 +14,9 @@ __all__ = [
     "matmul", "mm", "bmm", "dot", "t", "outer", "inner", "cross", "norm",
     "dist", "cond", "einsum", "matrix_power", "multi_dot", "cholesky", "qr",
     "svd", "eig", "eigh", "eigvals", "eigvalsh", "inv", "pinv", "solve",
-    "triangular_solve", "lstsq", "lu", "det", "slogdet", "matrix_rank",
-    "histogram", "mv", "kron",
+    "triangular_solve", "lstsq", "lu", "lu_unpack", "cholesky_solve",
+    "matrix_exp", "householder_product", "cov", "corrcoef", "det", "slogdet",
+    "matrix_rank", "histogram", "mv", "kron",
 ]
 
 
@@ -192,10 +193,93 @@ def lstsq(x, y, rcond=None, driver=None):
     return apply_op(f, _t_(x), _t_(y), name="lstsq")
 
 
-def lu(x, pivot=True):
+def lu(x, pivot=True, get_infos=False):
+    """Packed LU factorization (reference: tensor/linalg.py lu — returns
+    LU-packed matrix + 1-indexed sequential-swap pivots [+ info])."""
     import jax.scipy.linalg as jsl
 
-    return apply_op(lambda v: tuple(jsl.lu(v)), _t_(x), name="lu")
+    def f(v):
+        lu_, piv = jnp.vectorize(jsl.lu_factor, signature="(m,n)->(m,n),(k)")(v)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    lu_t, piv_t = apply_op(f, _t_(x), name="lu")
+    if get_infos:
+        return lu_t, piv_t, Tensor(jnp.zeros((), jnp.int32))
+    return lu_t, piv_t
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """P, L, U from `lu` output (reference: tensor/linalg.py lu_unpack).
+    Pivots are LAPACK sequential row swaps, 1-indexed."""
+
+    def f(lu_, piv):
+        def one(lu2, piv1):
+            m, n = lu2.shape
+            k = min(m, n)
+            L = jnp.tril(lu2[:, :k], -1) + jnp.eye(m, k, dtype=lu2.dtype)
+            U = jnp.triu(lu2[:k, :])
+
+            def body(i, perm):
+                j = piv1[i] - 1
+                pi, pj = perm[i], perm[j]
+                return perm.at[i].set(pj).at[j].set(pi)
+
+            perm = jax.lax.fori_loop(0, piv1.shape[0], body, jnp.arange(m))
+            # A[perm] = L @ U  =>  A = P @ L @ U with P[perm[i], i] = 1
+            P = jnp.zeros((m, m), lu2.dtype).at[perm, jnp.arange(m)].set(1.0)
+            return P, L, U
+
+        return jnp.vectorize(one, signature="(m,n),(k)->(m,m),(m,k),(k,n)")(lu_, piv)
+
+    return apply_op(f, _t_(x), _t_(y), name="lu_unpack")
+
+
+def cholesky_solve(x, y, upper=False):
+    """Solve A z = x given y = Cholesky factor of A (reference:
+    tensor/linalg.py cholesky_solve)."""
+    import jax.scipy.linalg as jsl
+
+    def f(b, chol):
+        def one(b2, c2):
+            return jsl.cho_solve((c2, not upper), b2)
+
+        return jnp.vectorize(one, signature="(m,k),(m,m)->(m,k)")(b, chol)
+
+    return apply_op(f, _t_(x), _t_(y), name="cholesky_solve")
+
+
+def matrix_exp(x):
+    import jax.scipy.linalg as jsl
+
+    def f(v):
+        return jnp.vectorize(jsl.expm, signature="(m,m)->(m,m)")(v)
+
+    return apply_op(f, _t_(x), name="matrix_exp")
+
+
+def householder_product(x, tau):
+    """Q from Householder reflectors (reference: tensor/linalg.py
+    householder_product; lowers to LAPACK orgqr's XLA analog)."""
+    from jax.lax.linalg import householder_product as hh
+
+    return apply_op(lambda a, t_: hh(a, t_), _t_(x), _t_(tau),
+                    name="householder_product")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = None if fweights is None else _t_(fweights)._value
+    aw = None if aweights is None else _t_(aweights)._value
+
+    def f(v):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+
+    return apply_op(f, _t_(x), name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), _t_(x),
+                    name="corrcoef")
 
 
 def det(x):

@@ -66,3 +66,36 @@ def test_summary_and_flops():
     n = paddle.flops(net, [2, 8])
     # 2 matmuls: 2*(2*8*16) + 2*(2*16*4) = 768 macs*2; XLA counts ~2*macs
     assert 500 <= n <= 2000, n
+
+
+# ---------------------------------------------------------------------------
+# StringTensor (reference: paddle/phi/core/string_tensor.h:33,
+# kernels paddle/phi/kernels/strings/)
+def test_string_tensor_lower_upper_unicode():
+    import paddle_tpu as paddle
+
+    t = paddle.StringTensor([["Hello", "WÖRLD"], ["ÀÉÎ", "mixed123"]])
+    assert t.shape == [2, 2] and t.dtype == "pstring" and t.numel() == 4
+    lo = t.lower()
+    up = t.upper()
+    assert lo[0][0] == "hello" and lo[0][1] == "wörld" and lo[1][0] == "àéî"
+    assert up[1][1] == "MIXED123" and up[0][1] == "WÖRLD"
+    # ascii-only folding leaves non-ascii untouched
+    ascii_lo = t.lower(use_utf8_encoding=False)
+    assert ascii_lo[0][1] == "wÖrld"
+    # module-level kernel aliases
+    assert paddle.strings_lower(t) == lo
+
+
+def test_string_tensor_empty_copy_reshape():
+    import paddle_tpu as paddle
+
+    e = paddle.strings_empty([2, 3])
+    assert e.shape == [2, 3] and e[0][0] == ""
+    t = paddle.StringTensor([b"bytes", "str"])
+    assert t[0] == "bytes"  # utf-8 decode on construction
+    r = t.reshape((2, 1))
+    assert r.shape == [2, 1] and r[1][0] == "str"
+    c = paddle.strings_empty([2])
+    c.copy_(t)
+    assert c == t and c.clone() == t

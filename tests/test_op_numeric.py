@@ -251,6 +251,21 @@ case("svd_vals", lambda x: paddle.svd(x)[1],
 case("qr_r", lambda x: paddle.qr(x)[1].abs(),
      lambda x: np.abs(np.linalg.qr(x)[1]), SPD, grad=False)
 case("eigvalsh", paddle.eigvalsh, np.linalg.eigvalsh, SPD, grad=False)
+case("matrix_exp", paddle.linalg.matrix_exp,
+     lambda x: __import__("scipy.linalg", fromlist=["expm"]).expm(x),
+     0.3 * M33, grad=False)
+case("cov", paddle.linalg.cov, np.cov, r.randn(3, 40),
+     grad=False, rtol=6e-3, atol=1e-3)
+case("corrcoef", paddle.linalg.corrcoef, np.corrcoef, r.randn(3, 40),
+     grad=False, rtol=6e-3, atol=1e-3)
+case("cholesky_solve",
+     lambda b, a: paddle.linalg.cholesky_solve(b, paddle.linalg.cholesky(a)),
+     lambda b, a: np.linalg.solve(a, b), r.randn(3, 2), SPD + 3 * np.eye(3),
+     wrt=(0,))
+case("lu_reconstruct",
+     lambda a: (lambda plu: plu[0] @ plu[1] @ plu[2])(
+         paddle.linalg.lu_unpack(*paddle.linalg.lu(a))),
+     lambda a: a, M33 + 2 * np.eye(3), grad=False)
 case("eigh_vals", lambda x: paddle.eigh(x)[0],
      lambda x: np.linalg.eigvalsh(x), SPD, grad=False)
 case("norm_fro", lambda x: paddle.norm(x), np.linalg.norm, A)
