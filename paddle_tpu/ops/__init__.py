@@ -21,6 +21,7 @@ from paddle_tpu.ops import (  # noqa: F401
 )
 from paddle_tpu.ops.comparison import *  # noqa: F401,F403
 from paddle_tpu.ops.creation import *  # noqa: F401,F403
+from paddle_tpu.ops.extras import *  # noqa: F401,F403
 from paddle_tpu.ops.linalg import *  # noqa: F401,F403
 from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
 from paddle_tpu.ops.math import *  # noqa: F401,F403

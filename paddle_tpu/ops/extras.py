@@ -1,0 +1,370 @@
+"""Op-surface tail: special functions, index mutations, samplers, misc.
+
+Reference parity targets (all in /root/reference/paddle/phi/ops/yaml/ops.yaml
+with kernels under paddle/phi/kernels/): digamma, lgamma, polygamma, i0/i0e/
+i1/i1e, gammaincc, logcumsumexp, copysign, heaviside, nextafter, ldexp,
+nanmedian, renorm, logspace, trapezoid, vander, trace, diagonal, diag_embed,
+fill_diagonal, index_add/index_put/index_fill, multiplex, addmm, complex,
+broadcast_tensors, as_strided, unique_consecutive, bucketize, histogramdd,
+combinations, bernoulli, poisson, multinomial, standard_gamma,
+bitwise_left_shift, bitwise_right_shift.
+
+TPU notes: everything static-shaped lowers through apply_op -> XLA; the
+dynamic-output ops (unique_consecutive, combinations' host index build) use
+the same host-numpy pattern as `unique` (dynamic shapes cannot live in XLA
+programs). Samplers draw from the lazy default_generator.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+import numpy as np
+
+from paddle_tpu.core.dtype import to_jax_dtype
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.ops.random_state import default_generator
+
+__all__ = [
+    "digamma", "lgamma", "gammaln", "gammainc", "gammaincc", "polygamma",
+    "i0", "i0e", "i1", "i1e", "logcumsumexp", "copysign", "heaviside",
+    "nextafter", "ldexp", "nanmedian", "renorm", "logspace", "trapezoid",
+    "vander", "trace", "diagonal", "diag_embed", "fill_diagonal", "index_add",
+    "index_put", "index_fill", "multiplex", "addmm", "complex",
+    "broadcast_tensors", "as_strided", "unique_consecutive", "bucketize",
+    "histogramdd", "combinations", "bernoulli", "poisson", "multinomial",
+    "standard_gamma", "bitwise_left_shift", "bitwise_right_shift",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _unary(fn, name):
+    def op(x, name_arg=None):
+        return apply_op(fn, _t(x), name=name)
+
+    op.__name__ = name
+    return op
+
+
+# -- special functions -------------------------------------------------------
+digamma = _unary(jsp.digamma, "digamma")
+lgamma = _unary(jsp.gammaln, "lgamma")
+gammaln = _unary(jsp.gammaln, "gammaln")
+i0 = _unary(jsp.i0, "i0")
+i0e = _unary(jsp.i0e, "i0e")
+i1 = _unary(jsp.i1, "i1")
+i1e = _unary(jsp.i1e, "i1e")
+
+
+def gammainc(x, y):
+    return apply_op(jsp.gammainc, _t(x), _t(y), name="gammainc")
+
+
+def gammaincc(x, y):
+    return apply_op(jsp.gammaincc, _t(x), _t(y), name="gammaincc")
+
+
+def polygamma(x, n):
+    return apply_op(lambda v: jsp.polygamma(int(n), v), _t(x), name="polygamma")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=ax)
+
+    return apply_op(f, _t(x), name="logcumsumexp")
+
+
+# -- elementwise binary tail -------------------------------------------------
+def copysign(x, y):
+    return apply_op(jnp.copysign, _t(x), _t(y), name="copysign")
+
+
+def heaviside(x, y):
+    return apply_op(jnp.heaviside, _t(x), _t(y), name="heaviside")
+
+
+def nextafter(x, y):
+    return apply_op(jnp.nextafter, _t(x), _t(y), name="nextafter")
+
+
+def ldexp(x, y):
+    return apply_op(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), _t(x), _t(y),
+                    name="ldexp")
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True):
+    return apply_op(jnp.left_shift, _t(x), _t(y), name="bitwise_left_shift")
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True):
+    fn = jnp.right_shift if is_arithmetic else (
+        lambda a, b: jax.lax.shift_right_logical(a, b.astype(a.dtype)))
+    return apply_op(fn, _t(x), _t(y), name="bitwise_right_shift")
+
+
+# -- reductions / stats ------------------------------------------------------
+def nanmedian(x, axis=None, keepdim=False, mode="avg"):
+    def f(v):
+        if mode == "min":  # lower of the two middle elements
+            def med1d(a):
+                a = jnp.sort(a)
+                n = (~jnp.isnan(a)).sum()
+                return a[jnp.maximum((n - 1) // 2, 0)]
+
+            if axis is None:
+                return med1d(v.reshape(-1))
+            mv = jnp.apply_along_axis(med1d, axis, v)
+            return jnp.expand_dims(mv, axis) if keepdim else mv
+        return jnp.nanmedian(v, axis=axis, keepdims=keepdim)
+
+    return apply_op(f, _t(x), name="nanmedian")
+
+
+def renorm(x, p, axis, max_norm):
+    def f(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * factor[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return apply_op(f, _t(x), name="renorm")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, mode="sum"):
+    if x is not None:
+        return apply_op(lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis),
+                        _t(y), _t(x), name="trapezoid")
+    return apply_op(lambda yy: jnp.trapezoid(yy, dx=dx if dx is not None else 1.0,
+                                             axis=axis), _t(y), name="trapezoid")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    v = np.asarray(_t(x)._value)
+    w = None if weights is None else np.asarray(_t(weights)._value)
+    hist, edges = np.histogramdd(v, bins=bins, range=ranges, density=density,
+                                 weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+# -- creation / views --------------------------------------------------------
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num),
+                               base=float(base), dtype=to_jax_dtype(dtype)))
+
+
+def vander(x, n=None, increasing=False):
+    return apply_op(lambda v: jnp.vander(v, N=n, increasing=increasing), _t(x),
+                    name="vander")
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return apply_op(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+                    _t(x), name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return apply_op(
+        lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+        _t(x), name="diagonal")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def f(v):
+        k = v.shape[-1]
+        m = k + abs(offset)
+        r = jnp.arange(k) + max(-offset, 0)
+        c = jnp.arange(k) + max(offset, 0)
+        out = jnp.zeros(v.shape[:-1] + (m, m), v.dtype).at[..., r, c].set(v)
+        # place the two new axes at dim1/dim2
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+
+    return apply_op(f, _t(x), name="diag_embed")
+
+
+def fill_diagonal(x, value, offset=0, wrap=False):
+    def f(v):
+        k = min(v.shape[-2] - max(-offset, 0), v.shape[-1] - max(offset, 0))
+        r = jnp.arange(k) + max(-offset, 0)
+        c = jnp.arange(k) + max(offset, 0)
+        return v.at[..., r, c].set(value)
+
+    return apply_op(f, _t(x), name="fill_diagonal")
+
+
+def as_strided(x, shape, stride, offset=0):
+    def f(v):
+        flat = v.reshape(-1)
+        idx = jnp.asarray(offset)
+        for s, st in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(s) * st
+        return flat[idx.reshape(-1)].reshape(tuple(shape))
+
+    return apply_op(f, _t(x), name="as_strided")
+
+
+def broadcast_tensors(inputs):
+    ts = [_t(t) for t in inputs]
+    outs = apply_op(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *ts,
+                    name="broadcast_tensors")
+    return list(outs)
+
+
+def complex(real, imag):
+    return apply_op(lambda r, i: jax.lax.complex(r, i), _t(real), _t(imag),
+                    name="complex")
+
+
+# -- index mutations ---------------------------------------------------------
+def index_add(x, index, axis, value):
+    def f(v, idx, val):
+        moved = jnp.moveaxis(v, axis, 0)
+        vmoved = jnp.moveaxis(val, axis, 0)
+        out = moved.at[idx].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply_op(f, _t(x), _t(index), _t(value), name="index_add")
+
+
+def index_fill(x, index, axis, fill_value):
+    def f(v, idx):
+        moved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].set(fill_value)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply_op(f, _t(x), _t(index), name="index_fill")
+
+
+def index_put(x, indices, value, accumulate=False):
+    idx_ts = [_t(i) for i in indices]
+
+    def f(v, val, *idx):
+        if accumulate:
+            return v.at[tuple(idx)].add(val)
+        return v.at[tuple(idx)].set(val)
+
+    return apply_op(f, _t(x), _t(value), *idx_ts, name="index_put")
+
+
+def multiplex(inputs, index):
+    ts = [_t(t) for t in inputs]
+
+    def f(idx, *vs):
+        stacked = jnp.stack(vs)  # [K, N, ...]
+        rows = idx.reshape(-1).astype(jnp.int32)
+        return stacked[rows, jnp.arange(rows.shape[0])]
+
+    return apply_op(f, _t(index), *ts, name="multiplex")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return apply_op(lambda i, a, b: beta * i + alpha * (a @ b),
+                    _t(input), _t(x), _t(y), name="addmm")
+
+
+# -- dynamic-shape (host) ----------------------------------------------------
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64"):
+    v = np.asarray(_t(x)._value)
+    if axis is None:
+        flat = v.reshape(-1)
+        if flat.size == 0:
+            keep = np.zeros(0, bool)
+        else:
+            keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+        out = flat[keep]
+        outs = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, flat.size))
+            outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    else:
+        moved = np.moveaxis(v, axis, 0)
+        if moved.shape[0] == 0:
+            keep = np.zeros(0, bool)
+        else:
+            diff = (moved[1:] != moved[:-1]).reshape(moved.shape[0] - 1, -1).any(1)
+            keep = np.concatenate([[True], diff])
+        out = np.moveaxis(moved[keep], 0, axis)
+        outs = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            outs.append(Tensor(jnp.asarray((np.cumsum(keep) - 1).astype(np.int64))))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, moved.shape[0]))
+            outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    def f(v, seq):
+        side = "right" if right else "left"
+        out = jnp.searchsorted(seq, v, side=side)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply_op(f, _t(x), _t(sorted_sequence), name="bucketize")
+
+
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+
+    n = int(_t(x)._value.shape[0])
+    gen = itertools.combinations_with_replacement if with_replacement else \
+        itertools.combinations
+    idx = np.array(list(gen(range(n), r)), np.int32).reshape(-1, r)
+
+    return apply_op(lambda v: v[jnp.asarray(idx)], _t(x), name="combinations")
+
+
+# -- samplers ----------------------------------------------------------------
+def bernoulli(x, name=None):
+    key = default_generator.next_key()
+    return apply_op(lambda p, k: jax.random.bernoulli(k, p).astype(p.dtype),
+                    _t(x), key, name="bernoulli", rng_args=(1,))
+
+
+def poisson(x, name=None):
+    key = default_generator.next_key()
+    return apply_op(lambda lam, k: jax.random.poisson(k, lam).astype(lam.dtype),
+                    _t(x), key, name="poisson", rng_args=(1,))
+
+
+def standard_gamma(x, name=None):
+    key = default_generator.next_key()
+    return apply_op(lambda a, k: jax.random.gamma(k, a).astype(a.dtype),
+                    _t(x), key, name="standard_gamma", rng_args=(1,))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = default_generator.next_key()
+
+    def f(p, k):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            out = jax.random.categorical(k, logits, axis=-1,
+                                         shape=(num_samples,) + p.shape[:-1])
+            return jnp.moveaxis(out, 0, -1).astype(jnp.int64)
+        if p.ndim == 1:
+            return jax.random.choice(k, p.shape[0], (num_samples,),
+                                     replace=False, p=p / p.sum()).astype(jnp.int64)
+        keys = jax.random.split(k, p.shape[0])
+        return jax.vmap(
+            lambda kk, pp: jax.random.choice(kk, p.shape[-1], (num_samples,),
+                                             replace=False, p=pp / pp.sum())
+        )(keys, p).astype(jnp.int64)
+
+    return apply_op(f, _t(x), key, name="multinomial", rng_args=(1,))

@@ -275,6 +275,53 @@ case("dist", lambda a, b: paddle.dist(a, b, p=2),
      lambda a, b: np.linalg.norm((a - b).ravel()), A, B)
 case("tensordot", lambda a, b: paddle.tensordot(a, b, axes=1),
      lambda a, b: np.tensordot(a, b, axes=1), A, B.T)
+
+# ---- op-surface tail (ops/extras.py) --------------------------------------
+case("digamma", paddle.digamma, sps.digamma, POS + 0.5,
+     rtol=1e-3, atol=1e-4, gtol=(3e-2, 1e-2))
+case("lgamma", paddle.lgamma, sps.gammaln, POS + 0.5, rtol=1e-3, atol=1e-4)
+case("i0", paddle.i0, sps.i0, SAFE, rtol=1e-4, atol=1e-5)
+case("i0e", paddle.i0e, sps.i0e, SAFE, rtol=1e-4, atol=1e-5)
+case("i1", paddle.i1, sps.i1, SAFE, rtol=1e-4, atol=1e-5)
+case("i1e", paddle.i1e, sps.i1e, SAFE, rtol=1e-4, atol=1e-5)
+case("polygamma", lambda x: paddle.polygamma(x, 1),
+     lambda x: sps.polygamma(1, x), POS + 0.5,
+     rtol=1e-3, atol=1e-3, grad=False)
+case("gammaincc", paddle.gammaincc,
+     lambda a, x: sps.gammaincc(a, x), POS + 0.5, POS + 1.0, grad=False)
+case("logcumsumexp", lambda x: paddle.logcumsumexp(x, axis=1),
+     lambda x: np.logaddexp.accumulate(x, axis=1), A, rtol=1e-4, atol=1e-5)
+case("copysign", paddle.copysign, np.copysign, A, B, wrt=(0,))
+case("heaviside", paddle.heaviside, np.heaviside, A, np.abs(B) + 0.1,
+     grad=False)
+case("trace_op", lambda x: paddle.trace(x, offset=1),
+     lambda x: np.trace(x, offset=1), M33)
+case("diagonal", lambda x: paddle.diagonal(x, offset=-1),
+     lambda x: np.diagonal(x, offset=-1), M33)
+case("diag_embed", lambda x: paddle.diag_embed(x),
+     lambda x: np.stack([np.diag(r) for r in x]), A)
+case("addmm", lambda i, a, b: paddle.addmm(i, a, b, beta=0.5, alpha=2.0),
+     lambda i, a, b: 0.5 * i + 2.0 * (a @ b), M33, M33, M33)
+case("vander", lambda x: paddle.vander(x, 3, increasing=True),
+     lambda x: np.vander(x, 3, increasing=True), SAFE[0],
+     rtol=1e-4, atol=1e-4, grad=False)
+case("trapezoid", lambda y: paddle.trapezoid(y, dx=0.5),
+     lambda y: np.trapezoid(y, dx=0.5) if hasattr(np, "trapezoid")
+     else np.trapz(y, dx=0.5), SAFE[0])
+case("nanmedian", paddle.nanmedian, np.nanmedian, SAFE, grad=False)
+case("renorm", lambda x: paddle.renorm(x, 2.0, 0, 1.0),
+     lambda x: x * np.minimum(1.0, 1.0 / (np.sqrt((x ** 2).reshape(x.shape[0], -1)
+                                                  .sum(1)) + 1e-7))[:, None],
+     3 * np.abs(A) + 1, grad=False, rtol=1e-3, atol=1e-3)
+case("index_fill",
+     lambda x: paddle.index_fill(x, paddle.to_tensor(np.array([1], np.int32)),
+                                 0, -2.0),
+     lambda x: np.concatenate([x[:1], np.full_like(x[1:2], -2.0), x[2:]]),
+     A, grad=False)
+case("bucketize",
+     lambda x: paddle.bucketize(x, paddle.to_tensor(
+         np.array([-0.5, 0.0, 0.5], np.float32))),
+     lambda x: np.searchsorted(np.array([-0.5, 0.0, 0.5]), x), A, grad=False)
 case("einsum", lambda a, b: paddle.einsum("ij,jk->ik", a, b),
      lambda a, b: np.einsum("ij,jk->ik", a, b), A, B.T)
 case("cond_2", lambda x: paddle.cond(x, p=2),
