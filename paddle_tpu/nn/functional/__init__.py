@@ -1046,3 +1046,284 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     if return_softmax:
         return out, None
     return out, None
+
+
+# ---------------------------------------------------------------------------
+# functional tail (reference ops.yaml: huber_loss, log_loss, channel_shuffle,
+# pixel_unshuffle, temporal_shift, gumbel_softmax, swiglu, lp_pool2d,
+# max_pool2d_with_index/unpool, affine_grid, grid_sample, fold)
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    def f(x, y):
+        d = x - y
+        ad = jnp.abs(d)
+        return _reduce(jnp.where(ad <= delta, 0.5 * d * d,
+                                 delta * (ad - 0.5 * delta)), reduction)
+
+    return apply_op(f, _t(input), _t(label), name="huber_loss")
+
+
+def log_loss(input, label, epsilon=1e-4):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1.0 - y) * jnp.log(1.0 - p + epsilon)
+
+    return apply_op(f, _t(input), _t(label), name="log_loss")
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return v.reshape(n, groups, c // groups, h, w) \
+                    .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, groups, c // groups) \
+                .transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return apply_op(f, _t(x), name="channel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = int(downscale_factor)
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c, h // r, r, w // r, r)
+        return v.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+
+    return apply_op(f, _t(x), name="pixel_unshuffle")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    def f(v):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :fold_c],
+                                jnp.zeros_like(v[:, :1, :fold_c])], axis=1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, fold_c:2 * fold_c]),
+                               v[:, :-1, fold_c:2 * fold_c]], axis=1)
+        keep = v[:, :, 2 * fold_c:]
+        return jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+
+    return apply_op(f, _t(x), name="temporal_shift")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from paddle_tpu.ops.random_state import default_generator
+
+    key = default_generator.next_key()
+
+    def f(v, k):
+        u = jax.random.uniform(k, v.shape, v.dtype, 1e-20, 1.0)
+        g = -jnp.log(-jnp.log(u))
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            oh = jax.nn.one_hot(jnp.argmax(y, axis=axis), v.shape[axis],
+                                axis=axis, dtype=v.dtype)
+            return oh + y - jax.lax.stop_gradient(y)  # straight-through
+        return y
+
+    return apply_op(f, _t(x), key, name="gumbel_softmax", rng_args=(1,))
+
+
+def swiglu(x, y=None):
+    """reference ops.yaml swiglu: silu(x) * y, with y defaulting to the
+    second half of x split on the last axis (fused-FFN gate)."""
+    if y is not None:
+        return apply_op(lambda a, b: jax.nn.silu(a) * b, _t(x), _t(y),
+                        name="swiglu")
+
+    def f(v):
+        a, b = jnp.split(v, 2, axis=-1)
+        return jax.nn.silu(a) * b
+
+    return apply_op(f, _t(x), name="swiglu")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    p = float(norm_type)
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride if stride is not None else kernel_size, 2)
+    pd = _pair(padding, 2)
+
+    def f(v):
+        s = jax.lax.reduce_window(
+            jnp.abs(v) ** p, 0.0, jax.lax.add, (1, 1) + ks, (1, 1) + st,
+            ((0, 0), (0, 0)) + tuple((q, q) for q in pd))
+        return s ** (1.0 / p)
+
+    return apply_op(f, _t(x), name="lp_pool2d")
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          ceil_mode=False):
+    """Max pool returning flat (h*w) argmax indices per output cell
+    (reference ops.yaml max_pool2d_with_index; feeds max_unpool2d)."""
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride if stride is not None else kernel_size, 2)
+    pd = _pair(padding, 2)
+
+    def f(v):
+        n, c, h, w = v.shape
+        vpad = jnp.pad(v, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
+                       constant_values=-jnp.inf)
+        idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+        ipad = jnp.pad(idx, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
+                       constant_values=-1.0)
+        patches = jax.lax.conv_general_dilated_patches(
+            vpad, ks, st, "VALID")  # (N, C*kh*kw, OH, OW)
+        ipatches = jax.lax.conv_general_dilated_patches(ipad, ks, st, "VALID")
+        oh, ow = patches.shape[-2:]
+        pr = patches.reshape(n, c, ks[0] * ks[1], oh, ow)
+        ir = ipatches.reshape(1, 1, ks[0] * ks[1], oh, ow)
+        am = jnp.argmax(pr, axis=2)
+        out = jnp.take_along_axis(pr, am[:, :, None], axis=2)[:, :, 0]
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(ir, (n, c) + ir.shape[2:]), am[:, :, None],
+            axis=2)[:, :, 0]
+        return out, mask.astype(jnp.int32)
+
+    return apply_op(f, _t(x), name="max_pool2d_with_index")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride if stride is not None else kernel_size, 2)
+
+    def f(v, idx):
+        n, c, oh, ow = v.shape
+        if output_size is not None:
+            hh, ww = int(output_size[-2]), int(output_size[-1])
+        else:
+            hh = (oh - 1) * st[0] + ks[0] - 2 * _pair(padding, 2)[0]
+            ww = (ow - 1) * st[1] + ks[1] - 2 * _pair(padding, 2)[1]
+        flat = jnp.zeros((n, c, hh * ww), v.dtype)
+        out = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1),
+        ].set(v.reshape(n, c, -1))
+        return out.reshape(n, c, hh, ww)
+
+    return apply_op(f, _t(x), _t(indices), name="max_unpool2d")
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """reference ops.yaml affine_grid: sampling grid from 2x3 affine maps."""
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (H, W, 3)
+        # sampling coordinates must not go through the bf16 MXU default —
+        # a 1e-3 coordinate error visibly blurs the resample
+        return jnp.einsum("hwk,njk->nhwj", base.astype(th.dtype), th,
+                          precision=jax.lax.Precision.HIGHEST)
+
+    return apply_op(f, _t(theta), name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """reference ops.yaml grid_sample: NCHW bilinear/nearest sampling at
+    normalized grid locations with zeros/border/reflection padding."""
+
+    def f(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1.0) * 0.5 * (size - 1)
+            return ((coord + 1.0) * size - 1.0) * 0.5
+
+        ix = unnorm(gx, w)
+        iy = unnorm(gy, h)
+
+        def reflect(coord, size):
+            if align_corners:
+                span = 2.0 * (size - 1)
+                coord = jnp.abs(jnp.mod(coord, span))
+                return jnp.where(coord > size - 1, span - coord, coord)
+            span = 2.0 * size
+            coord = jnp.mod(coord + 0.5, span)
+            coord = jnp.abs(coord)
+            coord = jnp.where(coord > size, span - coord, coord) - 0.5
+            return jnp.clip(coord, 0, size - 1)
+
+        if padding_mode == "reflection":
+            ix = reflect(ix, w)
+            iy = reflect(iy, h)
+        elif padding_mode == "border":
+            ix = jnp.clip(ix, 0, w - 1)
+            iy = jnp.clip(iy, 0, h - 1)
+
+        def gather(yi, xi):
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            got = v[jnp.arange(n)[:, None, None], :, yc, xc]  # (N, Hg, Wg, C)
+            if padding_mode == "zeros":
+                ok = ((yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1))
+                got = got * ok[..., None].astype(got.dtype)
+            return got
+
+        if mode == "nearest":
+            out = gather(jnp.round(iy), jnp.round(ix))
+            return jnp.moveaxis(out, -1, 1)
+
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = ix - x0
+        wy = iy - y0
+        out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+               + gather(y0, x1) * (wx * (1 - wy))[..., None]
+               + gather(y1, x0) * ((1 - wx) * wy)[..., None]
+               + gather(y1, x1) * (wx * wy)[..., None])
+        return jnp.moveaxis(out, -1, 1)
+
+    return apply_op(f, _t(x), _t(grid), name="grid_sample")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im (reference ops.yaml fold): scatter-add unfolded columns back
+    into the spatial map — inverse of `unfold`."""
+    oh, ow = _pair(output_sizes, 2)
+    kh, kw = _pair(kernel_sizes, 2)
+    sh, sw = _pair(strides, 2)
+    ph, pw = _pair(paddings, 2)
+    dh, dw = _pair(dilations, 2)
+    lh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    lw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    def f(v):
+        n = v.shape[0]
+        c = v.shape[1] // (kh * kw)
+        cols = v.reshape(n, c, kh, kw, lh, lw)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :,
+                             i * dh: i * dh + lh * sh: sh,
+                             j * dw: j * dw + lw * sw: sw].add(cols[:, :, i, j])
+        return out[:, :, ph: ph + oh, pw: pw + ow]
+
+    return apply_op(f, _t(x), name="fold")
+
+
+__all__ += [
+    "huber_loss", "log_loss", "channel_shuffle", "pixel_unshuffle",
+    "temporal_shift", "gumbel_softmax", "swiglu", "lp_pool2d",
+    "max_pool2d_with_index", "max_unpool2d", "affine_grid", "grid_sample",
+    "fold",
+]

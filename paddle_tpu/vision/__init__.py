@@ -2,3 +2,4 @@
 from paddle_tpu.vision import models  # noqa: F401
 from paddle_tpu.vision import datasets  # noqa: F401
 from paddle_tpu.vision import transforms  # noqa: F401
+from paddle_tpu.vision import ops  # noqa: F401

@@ -1,0 +1,207 @@
+"""paddle.vision.ops — detection-model operators.
+
+Reference: python/paddle/vision/ops.py (nms, roi_align, roi_pool, box_coder
+backed by phi kernels paddle/phi/kernels/*roi_align*, *nms*, legacy
+box_coder op).
+
+TPU-native split: `roi_align` and `box_coder` are pure static-shape jax
+(gradients flow, jit/shard-compatible — roi_align is the hot op inside
+detector training). `nms` and `roi_pool` produce dynamically-shaped /
+dynamically-binned results, so they run on host numpy like `unique`
+(post-processing ops that live on CPU in deployment anyway).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Hard NMS; with `category_idxs`, suppression is per category
+    (reference vision/ops.py nms). Returns kept indices sorted by score."""
+    b = np.asarray(_t(boxes)._value, np.float64)
+    n = b.shape[0]
+    s = (np.arange(n, 0, -1, dtype=np.float64) if scores is None
+         else np.asarray(_t(scores)._value, np.float64))
+    cats = (np.zeros(n, np.int64) if category_idxs is None
+            else np.asarray(_t(category_idxs)._value))
+
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    areas = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        rest = order[~suppressed[order] & (order != i)]
+        rest = rest[cats[rest] == cats[i]]
+        if rest.size == 0:
+            continue
+        xx1 = np.maximum(x1[i], x1[rest])
+        yy1 = np.maximum(y1[i], y1[rest])
+        xx2 = np.minimum(x2[i], x2[rest])
+        yy2 = np.minimum(y2[i], y2[rest])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        iou = inter / (areas[i] + areas[rest] - inter + 1e-10)
+        suppressed[rest[iou > iou_threshold]] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (reference vision/ops.py roi_align / phi roi_align kernel).
+
+    Static-shape jax with gradients: every bin averages a fixed sampling
+    grid (sampling_ratio, defaulting to 2 when -1 — the adaptive count of
+    the CUDA kernel is data-dependent, which XLA cannot compile; 2 is its
+    value for typical FPN roi sizes). Bilinear samples gather from the
+    roi's own image, selected via the boxes_num partition."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = int(output_size[0]), int(output_size[1])
+    s = 2 if sampling_ratio is None or sampling_ratio <= 0 else int(sampling_ratio)
+    bn = np.asarray(_t(boxes_num)._value).astype(np.int64)
+    img_of_roi = np.repeat(np.arange(bn.size), bn)  # host: static partition
+
+    def f(feat, rois):
+        n, c, h, w = feat.shape
+        off = 0.5 if aligned else 0.0
+        coords = rois * spatial_scale - off  # (K, 4) x1 y1 x2 y2
+
+        def one(roi, img_i):
+            rx1, ry1, rx2, ry2 = roi[0], roi[1], roi[2], roi[3]
+            rw = rx2 - rx1
+            rh = ry2 - ry1
+            if not aligned:
+                rw = jnp.maximum(rw, 1.0)
+                rh = jnp.maximum(rh, 1.0)
+            bin_h = rh / ph
+            bin_w = rw / pw
+            # sample grid: bin (i,j), point (a,b) at the a-th of s offsets
+            iy = ry1 + (jnp.arange(ph)[:, None] + (jnp.arange(s)[None, :] + 0.5) / s) * bin_h
+            ix = rx1 + (jnp.arange(pw)[:, None] + (jnp.arange(s)[None, :] + 0.5) / s) * bin_w
+            yy = iy.reshape(-1)  # (ph*s,)
+            xx = ix.reshape(-1)  # (pw*s,)
+
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+            img = feat[img_i]  # (C, H, W)
+
+            def gather(yi, xi):
+                yc = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+                xc = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+                got = img[:, yc[:, None], xc[None, :]]  # (C, ph*s, pw*s)
+                oky = ((yi >= -1) & (yi <= h))[:, None]
+                okx = ((xi >= -1) & (xi <= w))[None, :]
+                return got * (oky & okx).astype(got.dtype)
+
+            val = (gather(y0, x0) * ((1 - wy)[:, None] * (1 - wx)[None, :])
+                   + gather(y0, x0 + 1) * ((1 - wy)[:, None] * wx[None, :])
+                   + gather(y0 + 1, x0) * (wy[:, None] * (1 - wx)[None, :])
+                   + gather(y0 + 1, x0 + 1) * (wy[:, None] * wx[None, :]))
+            val = val.reshape(c, ph, s, pw, s)
+            return val.mean(axis=(2, 4))  # (C, ph, pw)
+
+        return jax.vmap(one)(coords, jnp.asarray(img_of_roi))
+
+    return apply_op(f, _t(x), _t(boxes), name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """RoIPool with the reference's quantized (floor/ceil) bins — the bin
+    extents are data-dependent, so this legacy op evaluates on host numpy
+    (forward-only, like the deployment-time usage)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = int(output_size[0]), int(output_size[1])
+    feat = np.asarray(_t(x)._value)
+    rois = np.asarray(_t(boxes)._value)
+    bn = np.asarray(_t(boxes_num)._value).astype(np.int64)
+    img_of_roi = np.repeat(np.arange(bn.size), bn)
+    n, c, h, w = feat.shape
+    out = np.zeros((rois.shape[0], c, ph, pw), feat.dtype)
+    for k, (roi, img_i) in enumerate(zip(rois, img_of_roi)):
+        x1 = int(round(roi[0] * spatial_scale))
+        y1 = int(round(roi[1] * spatial_scale))
+        x2 = int(round(roi[2] * spatial_scale))
+        y2 = int(round(roi[3] * spatial_scale))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for i in range(ph):
+            for j in range(pw):
+                hs = min(max(y1 + int(np.floor(i * rh / ph)), 0), h)
+                he = min(max(y1 + int(np.ceil((i + 1) * rh / ph)), 0), h)
+                ws = min(max(x1 + int(np.floor(j * rw / pw)), 0), w)
+                we = min(max(x1 + int(np.ceil((j + 1) * rw / pw)), 0), w)
+                if he > hs and we > ws:
+                    out[k, :, i, j] = feat[img_i, :, hs:he, ws:we].max(axis=(1, 2))
+    return Tensor(jnp.asarray(out))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0):
+    """Encode/decode boxes against priors (reference legacy box_coder op;
+    fluid/operators/detection/box_coder_op). Pure jnp — fuses into the
+    surrounding detector head."""
+    norm = 0.0 if box_normalized else 1.0
+
+    def prior_wh(pb):
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph_ = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph_ * 0.5
+        return pw, ph_, pcx, pcy
+
+    if code_type == "encode_center_size":
+        def f(pb, pbv, tb):
+            pw, ph_, pcx, pcy = prior_wh(pb)
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            # every target against every prior: (T, P, 4)
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph_[None, :]
+            dw = jnp.log(tw[:, None] / pw[None, :])
+            dh = jnp.log(th[:, None] / ph_[None, :])
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)
+            return out / pbv[None, :, :]
+
+        return apply_op(f, _t(prior_box), _t(prior_box_var), _t(target_box),
+                        name="box_coder")
+
+    def f(pb, pbv, tb):  # decode_center_size
+        pw, ph_, pcx, pcy = prior_wh(pb)
+        if axis == 0:
+            pw, ph_, pcx, pcy = (a[:, None] for a in (pw, ph_, pcx, pcy))
+            var = pbv[:, None, :]
+        else:
+            pw, ph_, pcx, pcy = (a[None, :] for a in (pw, ph_, pcx, pcy))
+            var = pbv[None, :, :]
+        d = tb * var
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph_ + pcy
+        bw = jnp.exp(d[..., 2]) * pw
+        bh = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - norm, cy + bh * 0.5 - norm], axis=-1)
+
+    return apply_op(f, _t(prior_box), _t(prior_box_var), _t(target_box),
+                    name="box_coder")

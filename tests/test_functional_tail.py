@@ -1,0 +1,135 @@
+"""Functional-op tail (reference ops.yaml: huber_loss, log_loss,
+channel_shuffle, pixel_unshuffle, temporal_shift, gumbel_softmax, swiglu,
+lp_pool2d, max_pool2d_with_index/max_unpool2d, affine_grid, grid_sample,
+fold)."""
+import numpy as np
+import scipy.special as sps
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+RS = np.random.RandomState
+
+
+def test_huber_and_log_loss():
+    rs = RS(0)
+    x, y = rs.randn(8), rs.randn(8)
+    hl = F.huber_loss(t(x), t(y), delta=1.0, reduction="none")
+    d = x - y
+    ref = np.where(np.abs(d) <= 1, 0.5 * d * d, np.abs(d) - 0.5)
+    np.testing.assert_allclose(np.asarray(hl._value), ref, rtol=1e-4, atol=1e-6)
+    p, lbl = rs.rand(6), (rs.rand(6) > 0.5).astype(np.float32)
+    ll = F.log_loss(t(p), t(lbl))
+    np.testing.assert_allclose(
+        np.asarray(ll._value),
+        -lbl * np.log(p + 1e-4) - (1 - lbl) * np.log(1 - p + 1e-4),
+        rtol=2e-3, atol=1e-5)
+
+
+def test_shuffle_unshuffle_shift():
+    rs = RS(0)
+    cs = F.channel_shuffle(t(np.arange(8).reshape(1, 8, 1, 1)), 2)
+    np.testing.assert_array_equal(np.asarray(cs._value).ravel(),
+                                  [0, 4, 1, 5, 2, 6, 3, 7])
+    pu = F.pixel_unshuffle(t(rs.randn(1, 2, 4, 4)), 2)
+    assert pu.shape == [1, 8, 2, 2]
+    ps = F.pixel_shuffle(pu, 2)
+    assert ps.shape == [1, 2, 4, 4]
+    v = rs.randn(4, 8, 2, 2).astype(np.float32)
+    ts = F.temporal_shift(t(v), seg_num=2)
+    tv = np.asarray(ts._value).reshape(2, 2, 8, 2, 2)
+    vv = v.reshape(2, 2, 8, 2, 2)
+    # first fold shifted backward (t+1 -> t), second forward, rest unchanged
+    np.testing.assert_allclose(tv[:, 0, :2], vv[:, 1, :2])
+    np.testing.assert_allclose(tv[:, 1, :2], 0.0)
+    np.testing.assert_allclose(tv[:, 1, 2:4], vv[:, 0, 2:4])
+    np.testing.assert_allclose(tv[:, :, 4:], vv[:, :, 4:])
+
+
+def test_gumbel_softmax_hard_and_grad():
+    paddle.seed(0)
+    x = paddle.to_tensor(RS(0).randn(5, 10).astype(np.float32),
+                         stop_gradient=False)
+    g = F.gumbel_softmax(x, hard=True)
+    gv = np.asarray(g._value)
+    np.testing.assert_allclose(gv.sum(1), np.ones(5), rtol=1e-5)
+    # straight-through primal is one-hot up to the y - sg(y) rounding epsilon
+    assert (np.isclose(gv, 0, atol=1e-6) | np.isclose(gv, 1, atol=1e-6)).all()
+    g.sum().backward()  # straight-through: grads flow
+    assert x.grad is not None
+
+
+def test_swiglu_matches_silu_gate():
+    xx = RS(0).randn(3, 8).astype(np.float32)
+    sw = F.swiglu(t(xx))
+    a, b = xx[:, :4], xx[:, 4:]
+    np.testing.assert_allclose(np.asarray(sw._value), (a * sps.expit(a)) * b,
+                               rtol=1e-3, atol=1e-5)
+    sw2 = F.swiglu(t(a), t(b))
+    np.testing.assert_allclose(np.asarray(sw2._value), np.asarray(sw._value),
+                               rtol=1e-6)
+
+
+def test_lp_pool_is_p_norm_of_window():
+    v = np.abs(RS(0).randn(1, 1, 4, 4)).astype(np.float32)
+    lp = F.lp_pool2d(t(v), 2.0, 2)
+    ref = np.sqrt((v.reshape(1, 1, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5)
+                   ** 2).sum(axis=(4, 5)))
+    np.testing.assert_allclose(np.asarray(lp._value), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_max_pool_index_unpool_roundtrip():
+    v = RS(0).randn(2, 3, 6, 6).astype(np.float32)
+    out, idx = F.max_pool2d_with_index(t(v), 2)
+    assert out.shape == [2, 3, 3, 3] and idx.shape == [2, 3, 3, 3]
+    # indices address the flat 6x6 map: gathering at them returns the maxima
+    flat = v.reshape(2, 3, 36)
+    got = np.take_along_axis(flat, np.asarray(idx._value).reshape(2, 3, 9), 2)
+    np.testing.assert_allclose(got, np.asarray(out._value).reshape(2, 3, 9))
+    un = F.max_unpool2d(out, idx, 2)
+    uv = np.asarray(un._value)
+    assert un.shape == [2, 3, 6, 6]
+    np.testing.assert_allclose(uv.max(axis=(2, 3)),
+                               np.asarray(out._value).max(axis=(2, 3)))
+    assert (np.count_nonzero(uv, axis=(2, 3)) <= 9).all()
+
+
+def test_affine_grid_grid_sample_identity():
+    theta = np.tile(np.array([[1., 0., 0.], [0., 1., 0.]], np.float32),
+                    (2, 1, 1))
+    img = RS(0).randn(2, 3, 5, 5).astype(np.float32)
+    for ac in (True, False):
+        grid = F.affine_grid(t(theta), [2, 3, 5, 5], align_corners=ac)
+        samp = F.grid_sample(t(img), grid, align_corners=ac)
+        np.testing.assert_allclose(np.asarray(samp._value), img,
+                                   rtol=1e-3, atol=1e-4)
+    grid = F.affine_grid(t(theta), [2, 3, 5, 5], align_corners=True)
+    s2 = F.grid_sample(t(img), grid, mode="nearest", padding_mode="border")
+    np.testing.assert_allclose(np.asarray(s2._value), img, rtol=1e-3, atol=1e-4)
+    # translation by a full pixel with zeros padding shifts and zero-fills
+    theta_sh = np.tile(np.array([[1., 0., 0.5], [0., 1., 0.]], np.float32),
+                       (2, 1, 1))
+    gsh = F.affine_grid(t(theta_sh), [2, 3, 5, 5], align_corners=True)
+    ssh = np.asarray(F.grid_sample(t(img), gsh, align_corners=True)._value)
+    np.testing.assert_allclose(ssh[..., :4], img[..., 1:], rtol=1e-3, atol=1e-4)
+    # grads flow
+    gimg = paddle.to_tensor(img, stop_gradient=False)
+    F.grid_sample(gimg, grid).sum().backward()
+    assert gimg.grad is not None
+
+
+def test_fold_inverts_unfold_with_coverage():
+    img = RS(0).randn(2, 3, 5, 5).astype(np.float32)
+    u = F.unfold(t(img), 3, strides=1, paddings=1)
+    fo = F.fold(u, [5, 5], 3, strides=1, paddings=1)
+    ones = np.ones((2, 3, 5, 5), np.float32)
+    cov = F.fold(F.unfold(t(ones), 3, strides=1, paddings=1),
+                 [5, 5], 3, strides=1, paddings=1)
+    np.testing.assert_allclose(np.asarray(fo._value),
+                               img * np.asarray(cov._value),
+                               rtol=1e-3, atol=1e-5)

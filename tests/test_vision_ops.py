@@ -1,0 +1,81 @@
+"""paddle.vision.ops: nms, roi_align, roi_pool, box_coder
+(reference: python/paddle/vision/ops.py; phi roi_align/nms kernels)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def n32(a):
+    return paddle.to_tensor(np.asarray(a, np.int32))
+
+
+def test_nms_basic_and_per_category():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = np.asarray(vops.nms(t(boxes), 0.5, t(scores))._value)
+    np.testing.assert_array_equal(keep, [0, 2])
+    # same overlap but different categories: all survive
+    cats = paddle.to_tensor(np.array([0, 1, 0], np.int64))
+    keep2 = np.asarray(vops.nms(t(boxes), 0.5, t(scores), category_idxs=cats,
+                                categories=[0, 1])._value)
+    np.testing.assert_array_equal(np.sort(keep2), [0, 1, 2])
+    # top_k truncates after scoring order
+    keep3 = np.asarray(vops.nms(t(boxes), 0.5, t(scores), top_k=1)._value)
+    np.testing.assert_array_equal(keep3, [0])
+
+
+def test_roi_align_values_and_grad():
+    feat = np.ones((1, 2, 8, 8), np.float32)
+    rois = np.array([[1., 1., 5., 5.]], np.float32)
+    ra = vops.roi_align(t(feat), t(rois), n32([1]), 2)
+    assert ra.shape == [1, 2, 2, 2]
+    np.testing.assert_allclose(np.asarray(ra._value), 1.0, rtol=1e-5)
+
+    ramp = np.tile(np.arange(8, dtype=np.float32)[None, None, None, :],
+                   (1, 1, 8, 1))
+    ra2 = vops.roi_align(t(ramp), t(np.array([[2., 2., 6., 6.]], np.float32)),
+                         n32([1]), 2, aligned=True)
+    v = np.asarray(ra2._value)[0, 0]
+    assert v[0, 0] < v[0, 1]          # monotone along the ramp
+    assert abs(v[0, 0] - v[1, 0]) < 1e-4  # constant across it
+
+    g = paddle.to_tensor(feat, stop_gradient=False)
+    vops.roi_align(g, t(rois), n32([1]), 2).sum().backward()
+    assert g.grad is not None and float(np.abs(np.asarray(g.grad._value)).sum()) > 0
+
+
+def test_roi_align_multi_image_partition():
+    feat = np.stack([np.zeros((1, 4, 4), np.float32),
+                     np.ones((1, 4, 4), np.float32)])
+    rois = np.array([[0., 0., 3., 3.], [0., 0., 3., 3.]], np.float32)
+    ra = vops.roi_align(t(feat), t(rois), n32([1, 1]), 1)
+    v = np.asarray(ra._value).reshape(2)
+    np.testing.assert_allclose(v, [0.0, 1.0], atol=1e-6)
+
+
+def test_roi_pool_quantized_max():
+    ramp = np.tile(np.arange(8, dtype=np.float32)[None, None, None, :],
+                   (1, 1, 8, 1))
+    rp = vops.roi_pool(t(ramp), t(np.array([[0., 0., 7., 7.]], np.float32)),
+                       n32([1]), 2)
+    np.testing.assert_allclose(np.asarray(rp._value)[0, 0],
+                               [[3., 7.], [3., 7.]])
+
+
+def test_box_coder_encode_decode_roundtrip():
+    priors = np.array([[0., 0., 10., 10.], [5., 5., 15., 15.]], np.float32)
+    pvar = np.ones((2, 4), np.float32)
+    targets = np.array([[1., 1., 9., 9.]], np.float32)
+    enc = vops.box_coder(t(priors), t(pvar), t(targets), "encode_center_size")
+    assert enc.shape == [1, 2, 4]
+    codes = np.asarray(enc._value)[:, 0, :][None].transpose(1, 0, 2)
+    dec = vops.box_coder(t(priors), t(pvar), paddle.to_tensor(codes),
+                         "decode_center_size", axis=1)
+    np.testing.assert_allclose(np.asarray(dec._value)[0, 0], targets[0],
+                               rtol=1e-4, atol=1e-3)
