@@ -119,6 +119,16 @@ _METHODS = [
     "bitwise_not",
     # creation-ish
     "tril", "triu",
+    # tail (ops/extras.py)
+    "digamma", "lgamma", "i0", "i0e", "i1", "i1e", "polygamma",
+    "logcumsumexp", "copysign", "heaviside", "nextafter", "ldexp",
+    "nanmedian", "renorm", "trapezoid", "vander", "trace", "diagonal",
+    "diag_embed", "fill_diagonal", "index_add", "index_put", "index_fill",
+    "multiplex", "addmm", "as_strided", "unique_consecutive", "bucketize",
+    "combinations", "bernoulli", "multinomial",
+    "bitwise_left_shift", "bitwise_right_shift",
+    # linalg tail
+    "cholesky_solve", "matrix_exp", "corrcoef", "cov", "lu", "lu_unpack",
 ]
 
 _installed = False
