@@ -5,3 +5,4 @@ from paddle_tpu.incubate import asp  # noqa: F401
 from paddle_tpu.incubate import nn  # noqa: F401
 
 __all__ = ["MoELayer", "asp", "nn"]
+from paddle_tpu.incubate import optimizer  # noqa: F401

@@ -1,0 +1,112 @@
+"""paddle.incubate.optimizer (reference: python/paddle/incubate/optimizer/
+lookahead.py LookAhead, modelaverage.py ModelAverage).
+
+Both wrap an inner optimizer and keep auxiliary per-parameter state on
+device; the slow/averaged copies are plain jax arrays updated by tiny fused
+programs, so they add one elementwise pass per interval, not per step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k steps forward, 1 step back (reference lookahead.py:30)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._params = inner_optimizer._parameter_list()
+        # real copies: the inner optimizer donates parameter buffers,
+        # so an aliasing view would be deleted after its first step
+        self._slow = [jnp.array(p._value, copy=True) for p in self._params]
+        self._step_count = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for i, p in enumerate(self._params):
+                slow = self._slow[i] + self.alpha * (p._value - self._slow[i])
+                self._slow[i] = slow
+                p._set_value(slow.astype(p._value.dtype))
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        out = self.inner_optimizer.state_dict()
+        out["lookahead_step"] = self._step_count
+        out["slow_params"] = [np.asarray(s) for s in self._slow]
+        return out
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        self._step_count = int(state.pop("lookahead_step", 0))
+        slow = state.pop("slow_params", None)
+        if slow is not None:
+            self._slow = [jnp.asarray(s) for s in slow]
+        self.inner_optimizer.set_state_dict(state)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._params]
+
+
+class ModelAverage:
+    """Maintain a running average of parameters for evaluation
+    (reference modelaverage.py:33). `apply()` swaps the averaged weights in,
+    `restore()` swaps training weights back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided")
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._params = list(parameters)
+        self._sum = [jnp.zeros_like(p._value, jnp.float32) for p in self._params]
+        self._num = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current parameters into the running sum; restart
+        the window once it exceeds max(min_window, rate * num_updates)."""
+        self._num += 1
+        window = max(self.min_window, int(self.rate * self._num))
+        window = min(window, self.max_window)
+        for i, p in enumerate(self._params):
+            self._sum[i] = self._sum[i] + p._value.astype(jnp.float32)
+        if self._num > window:
+            for i in range(len(self._sum)):
+                self._sum[i] = self._sum[i] * (window / self._num)
+            self._num = window
+
+    def apply(self, executor=None, need_restore=True):
+        if self._num == 0:
+            return
+        self._backup = [jnp.array(p._value, copy=True) for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._set_value((s / self._num).astype(p._value.dtype))
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._set_value(b)
+        self._backup = None

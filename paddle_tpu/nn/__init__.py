@@ -38,3 +38,4 @@ from paddle_tpu.nn import initializer  # noqa: F401
 from paddle_tpu.nn.initializer import ParamAttr  # noqa: F401
 from paddle_tpu.nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from paddle_tpu.nn.utils_ import parameters_to_vector, vector_to_parameters  # noqa: F401
+from paddle_tpu.nn import utils  # noqa: F401

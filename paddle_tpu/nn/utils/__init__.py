@@ -1,0 +1,148 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/ — weight_norm_hook.py,
+spectral_norm_hook.py, transform_parameters.py, clip_grad_norm_.py).
+
+Reparameterizations install a forward-pre-hook that recomputes the weight
+from the decomposed parameters before every call — the same mechanism as the
+reference's hook objects; the recompute is a couple of elementwise/matmul ops
+that XLA fuses into the layer's own program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.nn.clip import clip_grad_norm_  # noqa: F401  (re-export)
+
+__all__ = [
+    "weight_norm", "remove_weight_norm", "spectral_norm",
+    "parameters_to_vector", "vector_to_parameters", "clip_grad_norm_",
+    "clip_grad_value_",
+]
+
+
+def _norm_except_dim(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """w = g * v / ||v||  (reference weight_norm_hook.py WeightNorm.apply)."""
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    dim = dim if dim >= 0 else w._value.ndim + dim
+    g = Tensor(np.asarray(_norm_except_dim(w._value, dim)), stop_gradient=False)
+    v = Tensor(w._value, stop_gradient=False)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    # the composed weight is derived state, not a trainable parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def recompute(lyr, inputs):
+        gg = getattr(lyr, name + "_g")
+        vv = getattr(lyr, name + "_v")
+        w_new = apply_op(
+            lambda gv, vv_: gv * vv_ / (_norm_except_dim(vv_, dim) + 1e-12),
+            gg, vv, name="weight_norm")
+        object.__setattr__(lyr, name, w_new)
+        return None
+
+    handle = layer.register_forward_pre_hook(recompute)
+    layer._weight_norm_handle = handle
+    recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    handle = getattr(layer, "_weight_norm_handle", None)
+    if handle is not None:
+        handle.remove()
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    w = Tensor(np.asarray(
+        g._value * v._value / (_norm_except_dim(v._value, 0) + 1e-12)),
+        stop_gradient=False)
+    for pname in (name + "_g", name + "_v"):
+        if pname in layer._parameters:
+            del layer._parameters[pname]
+        if hasattr(layer, pname):
+            object.__delattr__(layer, pname)
+    layer.add_parameter(name, w)
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int | None = None):
+    """W_sn = W / sigma_max(W) via power iteration on persistent u/v buffers
+    (reference spectral_norm_hook.py SpectralNorm)."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    mat = np.moveaxis(np.asarray(w._value), dim, 0)
+    h = mat.shape[0]
+    wdth = int(np.prod(mat.shape[1:])) if mat.ndim > 1 else 1
+    rs = np.random.RandomState(0)
+    layer.register_buffer(name + "_u", jnp.asarray(
+        rs.randn(h).astype(np.asarray(w._value).dtype)))
+    layer.register_buffer(name + "_v", jnp.asarray(
+        rs.randn(wdth).astype(np.asarray(w._value).dtype)))
+    orig = Tensor(w._value, stop_gradient=False)
+    layer.add_parameter(name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def recompute(lyr, inputs):
+        w0 = getattr(lyr, name + "_orig")
+        u = getattr(lyr, name + "_u")
+        v = getattr(lyr, name + "_v")
+
+        def f(wv, uv, vv):
+            m = jnp.moveaxis(wv, dim, 0).reshape(h, -1)
+            for _ in range(n_power_iterations):
+                vv = m.T @ uv
+                vv = vv / (jnp.linalg.norm(vv) + eps)
+                uv = m @ vv
+                uv = uv / (jnp.linalg.norm(uv) + eps)
+            sigma = uv @ m @ vv
+            return wv / sigma, uv, vv
+
+        w_sn, u_new, v_new = apply_op(f, w0, u, v, name="spectral_norm")
+        u._set_value(u_new.detach()._value)
+        v._set_value(v_new.detach()._value)
+        object.__setattr__(lyr, name, w_sn)
+        return None
+
+    layer.register_forward_pre_hook(recompute)
+    recompute(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    ps = list(parameters)
+    return apply_op(lambda *vs: jnp.concatenate([v.reshape(-1) for v in vs]),
+                    *ps, name="parameters_to_vector")
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    ps = list(parameters)
+    flat = np.asarray(vec._value if isinstance(vec, Tensor) else vec)
+    off = 0
+    for p in ps:
+        n = int(np.prod(p._value.shape)) if p._value.ndim else 1
+        p._set_value(jnp.asarray(flat[off:off + n]).reshape(p._value.shape)
+                     .astype(p._value.dtype))
+        off += n
+    if off != flat.size:
+        raise ValueError(f"vector has {flat.size} elements; parameters need {off}")
+    return ps
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    cv = float(clip_value)
+    for p in params:
+        if p.grad is not None:
+            p.grad._set_value(jnp.clip(p.grad._value, -cv, cv))
+    return params

@@ -1,0 +1,89 @@
+"""nn.utils reparameterizations + incubate.optimizer wrappers
+(reference: python/paddle/nn/utils/, python/paddle/incubate/optimizer/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import utils as U
+
+
+def test_weight_norm_decomposes_and_trains():
+    paddle.seed(0)
+    layer = nn.Linear(4, 3)
+    w0 = np.asarray(layer.weight._value).copy()
+    U.weight_norm(layer, dim=0)
+    names = dict(layer.named_parameters())
+    assert "weight_g" in names and "weight_v" in names and "weight" not in names
+    # composed weight equals the original
+    np.testing.assert_allclose(np.asarray(layer.weight._value), w0, rtol=1e-5)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    out = layer(x)
+    out.sum().backward()
+    assert layer.weight_g.grad is not None and layer.weight_v.grad is not None
+    U.remove_weight_norm(layer)
+    names = dict(layer.named_parameters())
+    assert "weight" in names and "weight_g" not in names
+    np.testing.assert_allclose(np.asarray(layer.weight._value), w0, rtol=1e-5)
+
+
+def test_spectral_norm_bounds_sigma():
+    paddle.seed(0)
+    layer = nn.Linear(6, 6)
+    # inflate the weight so sigma >> 1
+    layer.weight._set_value(np.asarray(layer.weight._value) * 10)
+    U.spectral_norm(layer, n_power_iterations=5)
+    x = paddle.to_tensor(np.eye(6, dtype=np.float32))
+    layer(x)  # power iteration refines u/v
+    layer(x)
+    w = np.asarray(layer.weight._value)
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.05, sigma
+
+
+def test_parameter_vector_roundtrip():
+    paddle.seed(0)
+    m = nn.Linear(3, 2)
+    vec = U.parameters_to_vector(m.parameters())
+    assert vec.shape == [3 * 2 + 2]
+    flat = np.asarray(vec._value)
+    U.vector_to_parameters(paddle.to_tensor(flat * 2), m.parameters())
+    np.testing.assert_allclose(
+        np.asarray(U.parameters_to_vector(m.parameters())._value), flat * 2,
+        rtol=1e-6)
+
+
+def test_clip_grad_value():
+    p = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+    (p * paddle.to_tensor(np.array([10., -10., 0.1], np.float32))).sum().backward()
+    U.clip_grad_value_([p], 1.0)
+    np.testing.assert_allclose(np.asarray(p.grad._value), [1., -1., 0.1])
+
+
+def test_lookahead_pulls_toward_slow_weights():
+    from paddle_tpu.incubate.optimizer import LookAhead
+
+    paddle.seed(0)
+    p = paddle.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    for i in range(2):
+        (p * paddle.to_tensor(np.ones(2, np.float32))).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    # fast weights after 2 sgd steps: -2; lookahead pulls to slow(0)+0.5*(-2-0)
+    np.testing.assert_allclose(np.asarray(p._value), [-1., -1.], rtol=1e-6)
+
+
+def test_model_average_apply_restore():
+    from paddle_tpu.incubate.optimizer import ModelAverage
+
+    p = paddle.to_tensor(np.zeros(1, np.float32), stop_gradient=False)
+    ma = ModelAverage(0.5, parameters=[p], min_average_window=100,
+                      max_average_window=100)
+    for v in (1.0, 2.0, 3.0):
+        p._set_value(np.array([v], np.float32))
+        ma.step()
+    ma.apply()
+    np.testing.assert_allclose(np.asarray(p._value), [2.0], rtol=1e-6)  # mean
+    ma.restore()
+    np.testing.assert_allclose(np.asarray(p._value), [3.0], rtol=1e-6)
