@@ -47,3 +47,7 @@ unique_name = _UniqueNameGenerator()
 from paddle_tpu.utils.log_writer import LogReader, LogWriter, VisualDLCallback  # noqa: F401,E402
 
 __all__ += ["LogWriter", "LogReader", "VisualDLCallback"]
+
+from paddle_tpu.utils import cpp_extension, dlpack  # noqa: E402,F401
+
+__all__ += ["cpp_extension", "dlpack"]

@@ -1,0 +1,75 @@
+"""paddle.utils.cpp_extension (reference: python/paddle/utils/cpp_extension/
+— jit `load` at cpp_extension.py, extension_utils build machinery).
+
+TPU-native split of the reference's custom-op story:
+- device kernels are Pallas (`paddle_tpu/ops/pallas/`) — the TPU analog of
+  the reference's CUDAExtension path;
+- HOST ops (pre/post-processing, tokenizers, samplers) compile here: `load`
+  builds C++ sources into a shared library with g++ (same flags family as
+  extension_utils) and returns a ctypes handle; `wrap_host_op` lifts any
+  host callable (native or Python) into a paddle op returning Tensors.
+
+No pybind11 in the image, so the ABI is plain C (`extern "C"`) + ctypes —
+document the expected signatures in the C source.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["load", "get_build_directory", "wrap_host_op"]
+
+
+def get_build_directory(verbose: bool = False) -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name: str, sources, extra_cxx_flags=None, build_directory=None,
+         verbose: bool = False):
+    """Compile C++ `sources` into `<build>/<name>.so` and return the
+    ctypes.CDLL handle (reference: cpp_extension.load). Recompiles only when
+    a source is newer than the library."""
+    if isinstance(sources, (str, os.PathLike)):
+        sources = [sources]
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    lib_path = os.path.join(build_dir, f"{name}.so")
+
+    needs_build = not os.path.exists(lib_path) or any(
+        os.path.getmtime(s) > os.path.getmtime(lib_path) for s in sources)
+    if needs_build:
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+               *(extra_cxx_flags or []), "-o", lib_path, *map(str, sources)]
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build of {name} failed:\n{proc.stderr}")
+    return ctypes.CDLL(lib_path)
+
+
+def wrap_host_op(fn, out_dtype=None):
+    """Lift a host callable `(np.ndarray, ...) -> np.ndarray` into a paddle
+    op: Tensors are materialized to numpy, the callable runs on host, the
+    result wraps back into a Tensor (forward-only — the reference's custom
+    host ops declare no grad kernel either unless one is registered)."""
+
+    def op(*tensors):
+        args = [np.asarray(t._value) if isinstance(t, Tensor) else np.asarray(t)
+                for t in tensors]
+        out = fn(*args)
+        arr = jnp.asarray(out if out_dtype is None else out.astype(out_dtype))
+        return Tensor(arr)
+
+    return op

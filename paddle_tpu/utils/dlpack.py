@@ -1,0 +1,24 @@
+"""paddle.utils.dlpack (reference: python/paddle/utils/dlpack.py).
+
+Zero-copy tensor exchange via the DLPack protocol. jax arrays implement
+`__dlpack__`, so `to_dlpack` returns the standard capsule and `from_dlpack`
+accepts capsules or any protocol-speaking object (torch tensors, numpy
+arrays, cupy, ...). On-host arrays exchange without a copy; device arrays
+follow jax's dlpack ownership rules.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x: Tensor):
+    v = x._value if isinstance(x, Tensor) else x
+    return v.__dlpack__()
+
+
+def from_dlpack(dlpack) -> Tensor:
+    return Tensor(jnp.from_dlpack(dlpack))

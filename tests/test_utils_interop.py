@@ -1,0 +1,50 @@
+"""utils.dlpack interop + utils.cpp_extension native custom-op loading
+(reference: python/paddle/utils/dlpack.py, utils/cpp_extension/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension, dlpack
+
+
+def test_dlpack_torch_roundtrip():
+    import torch
+
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    p = dlpack.from_dlpack(t)
+    assert p.shape == [2, 3]
+    np.testing.assert_allclose(np.asarray(p._value), t.numpy())
+    back = torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(p * 2))
+    np.testing.assert_allclose(back.numpy(), t.numpy() * 2)
+
+
+def test_cpp_extension_load_and_wrap(tmp_path):
+    src = tmp_path / "scale_op.cc"
+    src.write_text(
+        'extern "C" void scale2(const float* in, float* out, long n) {\n'
+        "  for (long i = 0; i < n; ++i) out[i] = 2.0f * in[i];\n"
+        "}\n")
+    lib = cpp_extension.load("scale_op", [src], build_directory=str(tmp_path),
+                             verbose=False)
+    import ctypes
+
+    lib.scale2.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_long]
+
+    def scale2(a):
+        a = np.ascontiguousarray(a, np.float32)
+        out = np.empty_like(a)
+        lib.scale2(a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   a.size)
+        return out
+
+    op = cpp_extension.wrap_host_op(scale2)
+    x = paddle.to_tensor(np.arange(5, dtype=np.float32))
+    y = op(x)
+    np.testing.assert_allclose(np.asarray(y._value), np.arange(5) * 2.0)
+
+    # cache: second load must not rebuild (mtime unchanged)
+    mtime = (tmp_path / "scale_op.so").stat().st_mtime
+    cpp_extension.load("scale_op", [src], build_directory=str(tmp_path))
+    assert (tmp_path / "scale_op.so").stat().st_mtime == mtime
