@@ -60,7 +60,9 @@ from paddle_tpu import optimizer  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import static  # noqa: F401
 from paddle_tpu import utils  # noqa: F401
+from paddle_tpu import version  # noqa: F401
 from paddle_tpu import vision  # noqa: F401
+from paddle_tpu.hapi import hub  # noqa: F401
 
 from paddle_tpu.framework.io_ import load, save  # noqa: F401
 from paddle_tpu.framework.inspection import flops, summary  # noqa: F401

@@ -1,0 +1,60 @@
+"""paddle.hub (reference: python/paddle/hapi/hub.py — list:172, help:218,
+load:261 over a repo's hubconf.py entrypoints).
+
+TPU build runs with zero egress, so source='local' is the first-class path:
+a directory containing `hubconf.py` whose public callables are the
+entrypoints (the reference's local branch). github/gitee sources raise a
+clear error pointing at the offline contract instead of half-downloading.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _require_local(source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"source={source!r} needs network access; this environment is "
+            "offline — clone the repo and use source='local'")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf."""
+    _require_local(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    _require_local(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _require_local(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(
+            f"entrypoint {model!r} not in {repo_dir}/{_HUBCONF}; "
+            f"available: {list(repo_dir)}")
+    return getattr(mod, model)(**kwargs)

@@ -1,0 +1,45 @@
+"""paddle.version (reference: generated python/paddle/version/__init__.py).
+
+The reference stamps cuda()/cudnn()/nccl() build metadata; the TPU build's
+analogs report the XLA/jax stack and the absence of the CUDA toolchain.
+"""
+from __future__ import annotations
+
+full_version = "0.1.0"
+major, minor, patch = (s for s in full_version.split("."))
+rc = 0
+commit = "unknown"
+with_gpu = False  # CUDA build flag; this is the TPU-native build
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "commit",
+           "show", "cuda", "cudnn", "nccl", "xla", "jax_version"]
+
+
+def show():
+    print(f"paddle_tpu {full_version} (TPU-native; XLA/jax backend)")
+    print(f"jax: {jax_version()}")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def nccl():
+    return 0
+
+
+def jax_version():
+    import jax
+
+    return jax.__version__
+
+
+def xla():
+    """PJRT platform of the default backend (initializes jax lazily)."""
+    import jax
+
+    return jax.devices()[0].platform

@@ -1,0 +1,31 @@
+"""paddle.hub local-source entrypoints (reference hapi/hub.py:172,218,261)
+and paddle.version metadata."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_hub_local_list_help_load(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "import paddle_tpu.nn as nn\n"
+        "def tiny_mlp(hidden=4):\n"
+        "    \"\"\"A tiny MLP entrypoint.\"\"\"\n"
+        "    return nn.Sequential(nn.Linear(2, hidden), nn.ReLU(), nn.Linear(hidden, 1))\n"
+        "def _private():\n"
+        "    pass\n")
+    assert paddle.hub.list(str(tmp_path), source="local") == ["tiny_mlp"]
+    assert "tiny MLP" in paddle.hub.help(str(tmp_path), "tiny_mlp", source="local")
+    m = paddle.hub.load(str(tmp_path), "tiny_mlp", source="local", hidden=8)
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    assert m(x).shape == [3, 1]
+    with pytest.raises(RuntimeError, match="offline"):
+        paddle.hub.load("user/repo", "tiny_mlp", source="github")
+    with pytest.raises(ValueError, match="entrypoint"):
+        paddle.hub.load(str(tmp_path), "nope", source="local")
+
+
+def test_version_metadata():
+    assert paddle.version.full_version == paddle.__version__
+    assert paddle.version.cuda() is False and paddle.version.nccl() == 0
+    assert isinstance(paddle.version.jax_version(), str)
