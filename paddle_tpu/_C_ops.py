@@ -1,0 +1,20 @@
+"""paddle._C_ops shim (reference: python/paddle/_C_ops.py re-exporting the
+pybind-generated per-op C functions).
+
+There is no generated C layer here — `apply_op` + jnp bodies ARE the kernel
+dispatch — but user code that calls `paddle._C_ops.<op>(...)` directly
+resolves to the same op functions, with trailing-underscore inplace aliases
+falling back to their out-of-place forms.
+"""
+from __future__ import annotations
+
+
+def __getattr__(name: str):
+    import paddle_tpu as _p
+
+    cand = name[:-1] if name.endswith("_") else name
+    for mod in (_p, _p.nn.functional, _p.linalg):
+        fn = getattr(mod, name, None) or getattr(mod, cand, None)
+        if fn is not None and callable(fn):
+            return fn
+    raise AttributeError(f"_C_ops has no op {name!r}")

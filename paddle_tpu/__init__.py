@@ -61,6 +61,13 @@ from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import static  # noqa: F401
 from paddle_tpu import utils  # noqa: F401
 from paddle_tpu import version  # noqa: F401
+from paddle_tpu import batch as _batch_mod  # noqa: F401
+from paddle_tpu.batch import batch  # noqa: F401
+from paddle_tpu import callbacks  # noqa: F401
+from paddle_tpu import inference  # noqa: F401
+from paddle_tpu import onnx  # noqa: F401
+from paddle_tpu import sysconfig  # noqa: F401
+from paddle_tpu import _C_ops  # noqa: F401
 from paddle_tpu import vision  # noqa: F401
 from paddle_tpu.hapi import hub  # noqa: F401
 

@@ -1,0 +1,21 @@
+"""paddle.batch (reference: python/paddle/batch.py) — wrap a sample reader
+into a batch reader. Legacy reader-decorator API kept for source parity;
+new code should use paddle.io.DataLoader."""
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -1,0 +1,9 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py —
+re-exports the hapi callback zoo)."""
+from paddle_tpu.hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+)
+from paddle_tpu.utils.log_writer import VisualDLCallback as VisualDL  # noqa: F401
+
+__all__ = ["Callback", "EarlyStopping", "LRScheduler", "ModelCheckpoint",
+           "ProgBarLogger", "VisualDL"]

@@ -1,0 +1,177 @@
+"""paddle.inference — deployment predictor API.
+
+Reference: python/paddle/inference (Config/Predictor/create_predictor over
+the C++ AnalysisPredictor, paddle/fluid/inference/api/analysis_predictor.cc).
+
+TPU-native design: the deployment artifact is `jit.save`'s serialized
+StableHLO + params (`jit/api.py`), so the Predictor is a thin session over
+`jit.load`'s TranslatedLayer — XLA is both the "analysis" pass stack and
+the executor, and one compiled program per input signature replaces the
+zero-copy tensor plumbing. The handle API (get_input_handle /
+copy_from_cpu / run / copy_to_cpu) is kept verbatim so reference serving
+code ports unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "DataType", "get_version",
+           "get_num_bytes_of_data_type"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3  # the TPU runs through this seam in the reference
+
+
+class DataType:
+    FLOAT32 = 0
+    FLOAT16 = 1
+    BFLOAT16 = 2
+    INT32 = 3
+    INT64 = 4
+    INT8 = 5
+    BOOL = 6
+
+
+_DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.BFLOAT16: 2,
+                DataType.INT32: 4, DataType.INT64: 8, DataType.INT8: 1,
+                DataType.BOOL: 1}
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    return _DTYPE_BYTES[dtype]
+
+
+def get_version() -> str:
+    from paddle_tpu.version import full_version
+
+    return f"paddle_tpu {full_version}"
+
+
+class Config:
+    """Model path + execution switches (reference inference Config).
+
+    Graph-level switches (ir optim, memory optim) are accepted for source
+    compatibility and recorded; XLA always applies its pass pipeline."""
+
+    def __init__(self, prog_file: str | None = None, params_file: str | None = None):
+        # jit.save writes `<prefix>.pdmodel` + `<prefix>.pdparams`; accept the
+        # prefix directly or either file path
+        prefix = prog_file or ""
+        for suf in (".pdmodel", ".pdparams"):
+            if prefix.endswith(suf):
+                prefix = prefix[: -len(suf)]
+        self._prefix = prefix
+        self._ir_optim = True
+        self._memory_optim = True
+        self._precision = PrecisionType.Float32
+        self._device = "tpu"
+
+    def model_path(self) -> str:
+        return self._prefix
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = bool(flag)
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = bool(flag)
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        pass  # XLA manages host threading
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._device = "gpu"
+        self._precision = precision
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_custom_device(self, device_type: str, device_id: int = 0):
+        self._device = device_type
+
+    def summary(self) -> str:
+        return (f"Config(model={self._prefix!r}, device={self._device}, "
+                f"precision={self._precision})")
+
+
+class Tensor:
+    """Input/output handle (reference wrapper.py Tensor —
+    copy_from_cpu:45 / copy_to_cpu)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._data = None
+
+    def copy_from_cpu(self, data):
+        if not isinstance(data, np.ndarray):
+            raise TypeError("copy_from_cpu expects a numpy ndarray")
+        self._data = data
+
+    def reshape(self, shape):
+        if self._data is not None:
+            self._data = self._data.reshape(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._data is None:
+            raise RuntimeError(f"output {self.name!r} not computed; call run()")
+        return np.asarray(self._data)
+
+    def shape(self):
+        return list(self._data.shape) if self._data is not None else []
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from paddle_tpu.jit.api import TranslatedLayer, load
+
+        loaded = load(config.model_path())
+        if not isinstance(loaded, TranslatedLayer):
+            raise ValueError(
+                f"{config.model_path()!r} has no exported program; re-save "
+                "with jit.save(layer, path, input_spec=[...])")
+        self._layer = loaded
+        n_in = max(len(loaded.in_shapes), 1)
+        self._inputs = {f"x{i}": Tensor(f"x{i}") for i in range(n_in)}
+        self._outputs: dict[str, Tensor] = {}
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def run(self):
+        missing = [n for n, h in self._inputs.items() if h._data is None]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        outs = self._layer(*[self._inputs[n]._data for n in self._inputs])
+        if not isinstance(outs, (tuple, list)):
+            outs = [outs]
+        self._outputs = {}
+        for i, o in enumerate(outs):
+            h = Tensor(f"out{i}")
+            h._data = np.asarray(getattr(o, "_value", o))
+            self._outputs[h.name] = h
+        return True
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

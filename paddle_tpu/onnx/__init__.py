@@ -1,0 +1,21 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py via paddle2onnx).
+
+The image ships no `onnx` package, so export is gated: with onnx installed
+this raises NotImplementedError pointing at the StableHLO artifact
+(`jit.save`), which is the TPU-native deployment format; without onnx the
+ImportError is surfaced directly.
+"""
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle.onnx.export requires the `onnx` package, which is not "
+            "installed in this environment; use paddle.jit.save for the "
+            "portable StableHLO deployment artifact instead") from e
+    raise NotImplementedError(
+        "ONNX conversion from StableHLO is not implemented; deploy with "
+        "paddle.jit.save / paddle.inference")

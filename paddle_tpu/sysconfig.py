@@ -1,0 +1,14 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """C headers directory (native runtime sources live in csrc/)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+
+
+def get_lib() -> str:
+    """Directory holding the built native core library."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")

@@ -1,0 +1,61 @@
+"""paddle.inference deployment predictor (reference: python/paddle/inference
+Config/Predictor/create_predictor over AnalysisPredictor) + namespace shims."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_predictor_end_to_end(tmp_path):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    m.eval()
+    prefix = str(tmp_path / "deploy")
+    paddle.jit.save(m, prefix,
+                    input_spec=[paddle.static.InputSpec([3, 4], "float32")])
+
+    cfg = paddle.inference.Config(prefix)
+    cfg.switch_ir_optim(True)
+    cfg.enable_memory_optim()
+    pred = paddle.inference.create_predictor(cfg)
+    names = pred.get_input_names()
+    assert names == ["x0"]
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    h = pred.get_input_handle("x0")
+    h.copy_from_cpu(x)
+    assert pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    ref = np.asarray(m(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(RuntimeError, match="inputs not set"):
+        paddle.inference.create_predictor(cfg).run()
+
+
+def test_inference_misc():
+    assert paddle.inference.get_num_bytes_of_data_type(
+        paddle.inference.DataType.FLOAT32) == 4
+    assert "paddle_tpu" in paddle.inference.get_version()
+
+
+def test_namespace_shims():
+    # paddle.batch
+    r = paddle.batch(lambda: iter(range(5)), batch_size=2)
+    assert list(r()) == [[0, 1], [2, 3], [4]]
+    r2 = paddle.batch(lambda: iter(range(5)), batch_size=2, drop_last=True)
+    assert list(r2()) == [[0, 1], [2, 3]]
+    # paddle.callbacks
+    assert hasattr(paddle.callbacks, "EarlyStopping")
+    # paddle._C_ops resolves ops incl. inplace aliases
+    x = paddle.to_tensor(np.array([1.0, 4.0], np.float32))
+    np.testing.assert_allclose(np.asarray(paddle._C_ops.sqrt(x)._value), [1, 2])
+    assert callable(paddle._C_ops.relu_)
+    with pytest.raises(AttributeError):
+        paddle._C_ops.not_a_real_op
+    # sysconfig paths exist
+    import os
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    # onnx gated
+    with pytest.raises(ImportError, match="jit.save"):
+        paddle.onnx.export(None, "x")
