@@ -129,6 +129,11 @@ _METHODS = [
     "bitwise_left_shift", "bitwise_right_shift",
     # linalg tail
     "cholesky_solve", "matrix_exp", "corrcoef", "cov", "lu", "lu_unpack",
+    # second tail batch
+    "masked_scatter", "take", "frexp", "cdist", "diff", "signbit", "sinc",
+    "isneginf", "isposinf", "isreal", "quantile", "nanquantile",
+    "cartesian_prod", "unflatten", "gcd", "lcm", "isin", "nanargmax",
+    "nanargmin", "select_scatter", "slice_scatter",
 ]
 
 _installed = False

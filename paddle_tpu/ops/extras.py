@@ -368,3 +368,250 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         )(keys, p).astype(jnp.int64)
 
     return apply_op(f, _t(x), key, name="multinomial", rng_args=(1,))
+
+
+# -- second tail batch: stacking/splitting, distance, nan-aware, misc --------
+def masked_scatter(x, mask, value):
+    def f(v, m, val):
+        flat_val = val.reshape(-1)
+        mf = m.reshape(-1).astype(bool)
+        # k-th True in mask takes value[k] (reference masked_scatter contract)
+        pos = jnp.cumsum(mf) - 1
+        picked = flat_val[jnp.clip(pos, 0, flat_val.shape[0] - 1)]
+        return jnp.where(mf, picked, v.reshape(-1)).reshape(v.shape)
+
+    return apply_op(f, _t(x), _t(mask), _t(value), name="masked_scatter")
+
+
+def take(x, index, mode="raise"):
+    def f(v, idx):
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx = jnp.mod(idx, n)
+        else:  # raise/clip: XLA cannot raise; both clamp like the clip mode
+            idx = jnp.clip(idx, -n, n - 1)
+        return flat[jnp.where(idx < 0, idx + n, idx)]
+
+    return apply_op(f, _t(x), _t(index), name="take")
+
+
+def frexp(x):
+    return apply_op(lambda v: tuple(jnp.frexp(v)), _t(x), name="frexp")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    def f(a, b):
+        d = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == float("inf"):
+            return d.max(-1)
+        return (d ** p).sum(-1) ** (1.0 / p)
+
+    return apply_op(f, _t(x), _t(y), name="cdist")
+
+
+def pdist(x, p=2.0):
+    def f(a):
+        n = a.shape[0]
+        d = jnp.abs(a[:, None, :] - a[None, :, :])
+        dist = d.max(-1) if p == float("inf") else (d ** p).sum(-1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return dist[iu]
+
+    return apply_op(f, _t(x), name="pdist")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    pre = None if prepend is None else _t(prepend)
+    app = None if append is None else _t(append)
+    args = [_t(x)] + [a for a in (pre, app) if a is not None]
+
+    def f(v, *rest):
+        i = 0
+        kw = {}
+        if pre is not None:
+            kw["prepend"] = rest[i]
+            i += 1
+        if app is not None:
+            kw["append"] = rest[i]
+        return jnp.diff(v, n=n, axis=axis, **kw)
+
+    return apply_op(f, *args, name="diff")
+
+
+def signbit(x):
+    return apply_op(jnp.signbit, _t(x), name="signbit")
+
+
+def sinc(x):
+    return apply_op(jnp.sinc, _t(x), name="sinc")
+
+
+def isneginf(x):
+    return apply_op(jnp.isneginf, _t(x), name="isneginf")
+
+
+def isposinf(x):
+    return apply_op(jnp.isposinf, _t(x), name="isposinf")
+
+
+def isreal(x):
+    return apply_op(jnp.isreal, _t(x), name="isreal")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    def f(v):
+        return jnp.quantile(v, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                            method=interpolation)
+
+    return apply_op(f, _t(x), name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    def f(v):
+        return jnp.nanquantile(v, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                               method=interpolation)
+
+    return apply_op(f, _t(x), name="nanquantile")
+
+
+def msort(x):
+    return apply_op(lambda v: jnp.sort(v, axis=0), _t(x), name="msort")
+
+
+def cartesian_prod(xs):
+    ts = [_t(t) for t in xs]
+
+    def f(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return apply_op(f, *ts, name="cartesian_prod")
+
+
+def block_diag(inputs):
+    ts = [_t(t) for t in inputs]
+
+    def f(*vs):
+        vs = [v.reshape(1, 1) if v.ndim == 0 else
+              (v.reshape(1, -1) if v.ndim == 1 else v) for v in vs]
+        rows = sum(v.shape[0] for v in vs)
+        cols = sum(v.shape[1] for v in vs)
+        out = jnp.zeros((rows, cols), vs[0].dtype)
+        r = c = 0
+        for v in vs:
+            out = jax.lax.dynamic_update_slice(out, v.astype(out.dtype), (r, c))
+            r += v.shape[0]
+            c += v.shape[1]
+        return out
+
+    return apply_op(f, *ts, name="block_diag")
+
+
+def unflatten(x, axis, shape):
+    def f(v):
+        ax = axis % v.ndim
+        new = v.shape[:ax] + tuple(shape) + v.shape[ax + 1:]
+        return v.reshape(new)
+
+    return apply_op(f, _t(x), name="unflatten")
+
+
+def positive(x):
+    return apply_op(lambda v: +v, _t(x), name="positive")
+
+
+def negative(x):
+    return apply_op(lambda v: -v, _t(x), name="negative")
+
+
+def gcd(x, y):
+    return apply_op(jnp.gcd, _t(x), _t(y), name="gcd")
+
+
+def lcm(x, y):
+    return apply_op(jnp.lcm, _t(x), _t(y), name="lcm")
+
+
+def isin(x, test_x, assume_unique=False, invert=False):
+    return apply_op(lambda v, s: jnp.isin(v, s, invert=invert), _t(x),
+                    _t(test_x), name="isin")
+
+
+def nanargmax(x, axis=None, keepdim=False):
+    def f(v):
+        out = jnp.nanargmax(v, axis=axis)
+        return jnp.expand_dims(out, axis) if (keepdim and axis is not None) else out
+
+    return apply_op(f, _t(x), name="nanargmax")
+
+
+def nanargmin(x, axis=None, keepdim=False):
+    def f(v):
+        out = jnp.nanargmin(v, axis=axis)
+        return jnp.expand_dims(out, axis) if (keepdim and axis is not None) else out
+
+    return apply_op(f, _t(x), name="nanargmin")
+
+
+def _stack_family(fn, name):
+    def op(inputs):
+        ts = [_t(t) for t in inputs]
+        return apply_op(lambda *vs: fn(vs), *ts, name=name)
+
+    op.__name__ = name
+    return op
+
+
+column_stack = _stack_family(jnp.column_stack, "column_stack")
+row_stack = _stack_family(jnp.vstack, "row_stack")
+hstack = _stack_family(jnp.hstack, "hstack")
+vstack = _stack_family(jnp.vstack, "vstack")
+dstack = _stack_family(jnp.dstack, "dstack")
+
+
+def _split_family(axis_name, name):
+    def op(x, num_or_indices, name_arg=None):
+        def f(v):
+            return tuple(jnp.array_split(v, num_or_indices, axis=axis_name)
+                         if isinstance(num_or_indices, int)
+                         else jnp.split(v, num_or_indices, axis=axis_name))
+
+        return list(apply_op(f, _t(x), name=name))
+
+    op.__name__ = name
+    return op
+
+
+hsplit = _split_family(1, "hsplit")
+vsplit = _split_family(0, "vsplit")
+dsplit = _split_family(2, "dsplit")
+
+
+def select_scatter(x, values, axis, index):
+    def f(v, val):
+        idx = [slice(None)] * v.ndim
+        idx[axis] = index
+        return v.at[tuple(idx)].set(val)
+
+    return apply_op(f, _t(x), _t(values), name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    def f(v, val):
+        idx = [slice(None)] * v.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sr)
+        return v.at[tuple(idx)].set(val)
+
+    return apply_op(f, _t(x), _t(value), name="slice_scatter")
+
+
+__all__ += [
+    "masked_scatter", "take", "frexp", "cdist", "pdist", "diff", "signbit",
+    "sinc", "isneginf", "isposinf", "isreal", "quantile", "nanquantile",
+    "msort", "cartesian_prod", "block_diag", "unflatten", "positive",
+    "negative", "gcd", "lcm", "isin", "nanargmax", "nanargmin",
+    "column_stack", "row_stack", "hstack", "vstack", "dstack", "hsplit",
+    "vsplit", "dsplit", "select_scatter", "slice_scatter",
+]

@@ -322,6 +322,34 @@ case("bucketize",
      lambda x: paddle.bucketize(x, paddle.to_tensor(
          np.array([-0.5, 0.0, 0.5], np.float32))),
      lambda x: np.searchsorted(np.array([-0.5, 0.0, 0.5]), x), A, grad=False)
+case("diff", lambda x: paddle.diff(x, axis=1),
+     lambda x: np.diff(x, axis=1), A)
+case("sinc", paddle.sinc, np.sinc, SAFE, rtol=1e-4, atol=1e-5)
+case("signbit", paddle.signbit, np.signbit, A, grad=False)
+case("cdist", paddle.cdist,
+     lambda a, b: np.sqrt(((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)),
+     A, B, grad=False, rtol=1e-3, atol=1e-3)
+case("pdist", paddle.pdist,
+     lambda a: __import__("scipy.spatial.distance",
+                          fromlist=["pdist"]).pdist(a),
+     A, grad=False, rtol=1e-3, atol=1e-3)
+case("quantile", lambda x: paddle.quantile(x, 0.25, axis=1),
+     lambda x: np.quantile(x, 0.25, axis=1), A, grad=False)
+case("msort", paddle.msort, lambda x: np.sort(x, axis=0), A)
+case("take", lambda x: paddle.take(x, paddle.to_tensor(
+         np.array([0, 5, -1], np.int64))),
+     lambda x: np.take(x, [0, 5, -1]), A, grad=False)
+case("gcd", paddle.gcd, np.gcd,
+     np.array([12, 30], np.int32), np.array([18, 12], np.int32), grad=False)
+case("hstack", lambda a, b: paddle.hstack([a, b]),
+     lambda a, b: np.hstack([a, b]), A, B)
+case("block_diag",
+     lambda a, b: paddle.block_diag([a, b]),
+     lambda a, b: __import__("scipy.linalg",
+                             fromlist=["block_diag"]).block_diag(a, b),
+     M33, A)
+case("unflatten", lambda x: paddle.unflatten(x, 1, [2, 3]),
+     lambda x: x.reshape(x.shape[0], 2, 3), np.ascontiguousarray(r.randn(4, 6)))
 case("einsum", lambda a, b: paddle.einsum("ij,jk->ik", a, b),
      lambda a, b: np.einsum("ij,jk->ik", a, b), A, B.T)
 case("cond_2", lambda x: paddle.cond(x, p=2),
