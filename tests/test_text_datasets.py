@@ -1,0 +1,50 @@
+"""Text dataset zoo (reference: python/paddle/text/datasets/)."""
+import numpy as np
+
+from paddle_tpu import text
+from paddle_tpu.io import DataLoader
+
+
+def test_imikolov_from_file(tmp_path):
+    f = tmp_path / "corpus.txt"
+    f.write_text("a b c d e f\n" "a b c d e g\n")
+    ds = text.Imikolov(data_file=str(f), window_size=5)
+    # 2 windows per 6-token line
+    assert len(ds) == 4
+    first = ds[0]
+    assert first.shape == (5,)
+    # vocab built from the file: 7 distinct words
+    assert len(ds.word_idx) == 7
+
+
+def test_ucihousing_file_and_synthetic(tmp_path):
+    rows = np.random.RandomState(0).randn(10, 14)
+    f = tmp_path / "housing.data"
+    np.savetxt(f, rows)
+    tr = text.UCIHousing(data_file=str(f), mode="train")
+    te = text.UCIHousing(data_file=str(f), mode="test")
+    assert len(tr) == 8 and len(te) == 2
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,) and x.dtype == np.float32
+    # normalized features
+    xs = np.stack([tr[i][0] for i in range(len(tr))])
+    assert abs(xs.mean()) < 0.2
+    syn = text.UCIHousing()
+    assert len(syn) > 0
+
+
+def test_remaining_datasets_shapes():
+    srl = text.Conll05st(samples=4)
+    row = srl[0]
+    assert len(row) == 7 and all(r.shape == (24,) for r in row)
+    ml = text.Movielens(samples=4)
+    u = ml[0]
+    assert len(u) == 8 and u[5].shape == (3,)
+    wmt = text.WMT16(samples=3)
+    src, trg_in, trg_next = wmt[0]
+    assert trg_in[0] == text.WMT16.BOS and trg_next[-1] == text.WMT16.EOS
+    assert len(trg_in) == len(trg_next)
+    # integrates with DataLoader
+    loader = DataLoader(text.UCIHousing(), batch_size=4, shuffle=False)
+    xb, yb = next(iter(loader))
+    assert xb.shape[0] == 4 and xb.shape[1] == 13
