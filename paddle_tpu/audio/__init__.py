@@ -133,3 +133,113 @@ class features:
 
             return apply_op(f, m, name="log_mel")
 
+
+
+# ---------------------------------------------------------------------------
+# datasets (reference: python/paddle/audio/datasets — dataset.py base,
+# esc50.py, tess.py). Zero-egress: with `files`/`labels` the datasets read
+# real audio-feature arrays from disk (np.load-able); without, deterministic
+# synthetic waveforms with the real label taxonomy + feature pipeline.
+
+from paddle_tpu.io import Dataset as _IODataset  # noqa: E402
+
+
+class AudioClassificationDataset(_IODataset):
+    """Base: files + labels -> (feature, label) rows
+    (reference audio/datasets/dataset.py:29)."""
+
+    def __init__(self, files=None, labels=None, feat_type="raw",
+                 sample_rate=16000, n_samples=128, n_classes=10, duration=1.0,
+                 seed=0, **feat_kwargs):
+        import numpy as _np
+
+        self.feat_type = feat_type
+        self.sample_rate = int(sample_rate)
+        self.feat_kwargs = feat_kwargs
+        if files is not None:
+            self.files = list(files)
+            self.labels = list(labels)
+            self._synth = None
+        else:
+            rng = _np.random.RandomState(seed)
+            n = int(self.sample_rate * duration)
+            t = _np.arange(n) / self.sample_rate
+            waves, labs = [], []
+            for i in range(n_samples):
+                lab = i % n_classes
+                freq = 110.0 * (2.0 ** (lab / 2.0))
+                w = _np.sin(2 * _np.pi * freq * t) + 0.05 * rng.randn(n)
+                waves.append(w.astype(_np.float32))
+                labs.append(lab)
+            self.files = waves
+            self.labels = labs
+            self._synth = True
+
+    def _waveform(self, idx):
+        import numpy as _np
+
+        item = self.files[idx]
+        if isinstance(item, str):
+            return _np.load(item).astype(_np.float32)
+        return item
+
+    def __getitem__(self, idx):
+        import numpy as _np
+
+        w = self._waveform(idx)
+        if self.feat_type == "raw":
+            feat = w
+        elif self.feat_type == "mfcc":
+            feat = _np.asarray(features.MFCC(
+                sr=self.sample_rate, **self.feat_kwargs)(w)._value)
+        elif self.feat_type == "melspectrogram":
+            feat = _np.asarray(features.MelSpectrogram(
+                sr=self.sample_rate, **self.feat_kwargs)(w)._value)
+        elif self.feat_type == "logmelspectrogram":
+            feat = _np.asarray(features.LogMelSpectrogram(
+                sr=self.sample_rate, **self.feat_kwargs)(w)._value)
+        else:
+            raise ValueError(f"unknown feat_type {self.feat_type!r}")
+        import numpy as _np2
+
+        return feat, _np2.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """Environmental sounds, 50 classes x 5 folds
+    (reference audio/datasets/esc50.py:26)."""
+
+    label_list = [f"class_{i}" for i in range(50)]
+
+    def __init__(self, mode="train", split=1, feat_type="raw", **kw):
+        n_classes = 50
+        super().__init__(feat_type=feat_type, n_classes=n_classes,
+                         n_samples=200, seed=split, **kw)
+        if self._synth:
+            # fold `split` is the eval fold, as in the reference's 5-fold CSV
+            idx = [i for i in range(len(self.files))
+                   if (i % 5 == split - 1) == (mode != "train")]
+            self.files = [self.files[i] for i in idx]
+            self.labels = [self.labels[i] for i in idx]
+
+
+class TESS(AudioClassificationDataset):
+    """Emotional speech, 7 emotions (reference audio/datasets/tess.py)."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral",
+                  "pleasant_surprise", "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw", **kw):
+        super().__init__(feat_type=feat_type, n_classes=7, n_samples=140,
+                         seed=split, **kw)
+        if self._synth:
+            idx = [i for i in range(len(self.files))
+                   if (i % n_folds == split - 1) == (mode != "train")]
+            self.files = [self.files[i] for i in idx]
+            self.labels = [self.labels[i] for i in idx]
+
+
+__all__ += ["AudioClassificationDataset", "ESC50", "TESS"]

@@ -1,0 +1,29 @@
+"""Audio dataset zoo (reference: python/paddle/audio/datasets/)."""
+import os
+
+import numpy as np
+
+from paddle_tpu import audio
+
+
+def test_esc50_folds_and_features():
+    tr = audio.ESC50(mode="train", split=1)
+    te = audio.ESC50(mode="dev", split=1)
+    assert len(tr) + len(te) == 200 and len(te) == 40
+    w, lab = tr[0]
+    assert w.ndim == 1 and 0 <= int(lab) < 50
+    assert len(audio.ESC50.label_list) == 50
+
+
+def test_tess_mfcc_feature_pipeline():
+    ds = audio.TESS(mode="train", feat_type="mfcc", n_mfcc=13)
+    feat, lab = ds[0]
+    assert feat.shape[0] == 13 and 0 <= int(lab) < 7
+
+
+def test_file_backed_rows(tmp_path):
+    p = str(tmp_path / "a.npy")
+    np.save(p, np.zeros(800, np.float32))
+    ds = audio.AudioClassificationDataset(files=[p], labels=[3])
+    f, lab = ds[0]
+    assert f.shape == (800,) and int(lab) == 3 and len(ds) == 1
