@@ -49,8 +49,50 @@ def get_strategy() -> DistributedStrategy:
     return _strategy[0] or DistributedStrategy()
 
 
+def _apply_strategy_passes(model, strategy):
+    """Honor DistributedStrategy model-side toggles (the dygraph analog of
+    the reference's amp/recompute meta-optimizers, fleet/meta_optimizers/):
+    `strategy.amp` decorates the model to the configured dtype;
+    `strategy.recompute` wraps sublayers matching
+    recompute_configs['checkpoints'] name substrings with activation
+    recomputation."""
+    if strategy is None:
+        return model
+    if getattr(strategy, "amp", False) and strategy.amp_configs.get("use_pure_fp16"):
+        # O2: params cast to the amp dtype here; O1 stays runtime-autocast
+        # (the user's amp.auto_cast context), as in the reference's dygraph amp
+        from paddle_tpu import amp as _amp
+
+        model = _amp.decorate(model, level="O2",
+                              dtype=strategy.amp_configs.get("dtype", "bfloat16"))
+    if getattr(strategy, "recompute", False):
+        from paddle_tpu.distributed.fleet.recompute import recompute as _rc
+
+        class _RCTarget:
+            """Bound-forward shim exposing the layer's parameters so
+            recompute records weight gradients."""
+
+            def __init__(self, layer, fwd):
+                self._layer, self._fwd = layer, fwd
+
+            def parameters(self):
+                return self._layer.parameters()
+
+            def __call__(self, *a, **k):
+                return self._fwd(*a, **k)
+
+        patterns = [p for p in strategy.recompute_configs.get("checkpoints", [])]
+        for name, sub in model.named_sublayers():
+            if any(p in name for p in patterns):
+                target = _RCTarget(sub, sub.forward)
+                sub.forward = (lambda t: lambda *a, **k: _rc(t, *a, **k))(target)
+                sub._recompute_wrapped = True
+    return model
+
+
 def distributed_model(model):
-    """reference: fleet/model.py:140 — wrap by ParallelMode."""
+    """reference: fleet/model.py:140 — wrap by ParallelMode, after applying
+    the strategy's amp/recompute passes."""
     from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import PipelineParallel
     from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers import PipelineLayer
     from paddle_tpu.distributed.fleet.meta_parallel.tensor_parallel import TensorParallel
@@ -58,6 +100,7 @@ def distributed_model(model):
 
     hcg = get_hybrid_communicate_group()
     mode = hcg.get_parallel_mode()
+    model = _apply_strategy_passes(model, get_strategy())
     if isinstance(model, PipelineLayer):
         return PipelineParallel(model, hcg, get_strategy())
     if mode == ParallelMode.TENSOR_PARALLEL:

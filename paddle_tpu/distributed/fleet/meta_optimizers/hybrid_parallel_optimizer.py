@@ -51,6 +51,15 @@ class HybridParallelOptimizer:
         self._hcg = hcg
         self._strategy = strategy
         self._sharding = (strategy is not None and strategy.hybrid_configs.get("sharding_degree", 1) > 1)
+        # gradient merge (reference meta_optimizers/gradient_merge_optimizer):
+        # accumulate k_steps of grads, apply one update with the merged grad
+        self._gm_steps = 1
+        self._gm_avg = True
+        if strategy is not None and getattr(strategy, "gradient_merge", False):
+            self._gm_steps = int(strategy.gradient_merge_configs.get("k_steps", 1))
+            self._gm_avg = bool(strategy.gradient_merge_configs.get("avg", True))
+        self._gm_buf = {}
+        self._gm_count = 0
         if self._sharding:
             from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_sharding_optimizer import (
                 DygraphShardingOptimizer,
@@ -69,6 +78,26 @@ class HybridParallelOptimizer:
         # dp grad sync (reference :493 fused_allreduce_gradients) is implicit in
         # the global-SPMD view / compiled psum; sharding reduce (:488) handled by
         # the sharded optimizer state placement.
+        if self._gm_steps > 1:
+            self._gm_count += 1
+            params = self._inner_opt._parameter_list()
+            for p in params:
+                if p.grad is None:
+                    continue
+                buf = self._gm_buf.get(id(p))
+                self._gm_buf[id(p)] = (p.grad._value if buf is None
+                                       else buf + p.grad._value)
+            if self._gm_count < self._gm_steps:
+                # swallow this micro step; grads restart from zero
+                self._inner_opt.clear_grad()
+                return
+            scale = (1.0 / self._gm_steps) if self._gm_avg else 1.0
+            for p in params:
+                buf = self._gm_buf.get(id(p))
+                if buf is not None:
+                    p.grad._set_value(buf * scale)
+            self._gm_buf = {}
+            self._gm_count = 0
         self._inner_opt.step()
 
     def clear_grad(self, *a, **k):

@@ -17,28 +17,41 @@ __all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]
 
 
 def recompute(function, *args, **kwargs):
-    """reference: fleet/recompute/recompute.py recompute(fn, *args)."""
+    """reference: fleet/recompute/recompute.py recompute(fn, *args).
+
+    When `function` is a Layer (or exposes .parameters()), its parameters
+    enter the checkpointed pure function as explicit arguments, so the tape
+    records gradients w.r.t. BOTH the inputs and the layer's weights — the
+    reference's primary pattern `recompute(block, x)` inside a model."""
     preserve = kwargs.pop("preserve_rng_state", True)
     use_reentrant = kwargs.pop("use_reentrant", True)
 
     tensor_args = [a for a in args if isinstance(a, Tensor)]
     other = [(i, a) for i, a in enumerate(args) if not isinstance(a, Tensor)]
+    params = list(function.parameters()) if hasattr(function, "parameters") else []
+    n_in = len(tensor_args)
 
     def pure(*vals):
-        rebuilt = list(vals)
+        in_vals, param_vals = vals[:n_in], vals[n_in:]
         full = []
         vi = 0
         for i in range(len(args)):
             if any(i == oi for oi, _ in other):
                 full.append(dict(other)[i])
             else:
-                full.append(Tensor(rebuilt[vi]))
+                full.append(Tensor(in_vals[vi]))
                 vi += 1
-        out = function(*full, **kwargs)
+        if params:
+            from paddle_tpu.parallel import functional_call
+
+            out = functional_call(function, list(param_vals), tuple(full),
+                                  kwargs or None)
+        else:
+            out = function(*full, **kwargs)
         return out._value if isinstance(out, Tensor) else tuple(o._value for o in out)
 
     ck = jax.checkpoint(pure)
-    return apply_op(ck, *tensor_args, name="recompute")
+    return apply_op(ck, *tensor_args, *params, name="recompute")
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):
