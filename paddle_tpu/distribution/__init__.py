@@ -10,8 +10,16 @@ from paddle_tpu.distribution.distributions import (  # noqa: F401
     Geometric, Gumbel, Laplace, LogNormal, Multinomial, Normal, Poisson,
     Uniform, kl_divergence, register_kl,
 )
+from paddle_tpu.distribution.extra import (  # noqa: F401
+    AffineTransform, Binomial, Cauchy, Chi2, ContinuousBernoulli,
+    ExpTransform, Independent, MultivariateNormal, SigmoidTransform,
+    StudentT, Transform, TransformedDistribution,
+)
 
 __all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
            "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
            "Laplace", "LogNormal", "Multinomial", "Poisson", "kl_divergence",
-           "register_kl"]
+           "register_kl",
+           "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli", "StudentT",
+           "MultivariateNormal", "Independent", "Transform", "AffineTransform",
+           "ExpTransform", "SigmoidTransform", "TransformedDistribution"]

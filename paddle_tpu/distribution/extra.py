@@ -1,0 +1,376 @@
+"""Distribution zoo, second shelf (reference: python/paddle/distribution/ —
+binomial.py, cauchy.py, chi2.py, continuous_bernoulli.py, student_t.py,
+multivariate_normal.py, independent.py, transform.py,
+transformed_distribution.py).
+
+Same design as distributions.py: jax.random draws keyed from the framework
+generator (reparameterized where the reference is), closed-form jnp
+log_prob/entropy through apply_op so gradients reach Tensor parameters.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.distribution.distributions import (
+    Distribution, _key, _shape, _v,
+)
+
+__all__ = [
+    "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli", "StudentT",
+    "MultivariateNormal", "Independent", "Transform", "AffineTransform",
+    "ExpTransform", "SigmoidTransform", "TransformedDistribution",
+]
+
+
+class Binomial(Distribution):
+    """reference binomial.py: counts in [0, total_count]."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = jnp.asarray(_v(total_count), jnp.int32)
+        self._probs_t = probs if isinstance(probs, Tensor) else Tensor(_v(probs))
+        self.probs = self._probs_t._value
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.total_count, self.probs)
+        n = jnp.broadcast_to(self.total_count, shp)
+        p = jnp.broadcast_to(self.probs, shp)
+        return Tensor(jax.random.binomial(_key(), n.astype(jnp.float32), p))
+
+    def log_prob(self, value):
+        def f(x, p):
+            n = self.total_count.astype(p.dtype)
+            logc = (jsp.gammaln(n + 1) - jsp.gammaln(x + 1)
+                    - jsp.gammaln(n - x + 1))
+            return logc + x * jnp.log(p) + (n - x) * jnp.log1p(-p)
+
+        return apply_op(f, value, self._probs_t, name="binomial_log_prob")
+
+
+class Cauchy(Distribution):
+    """reference cauchy.py."""
+
+    def __init__(self, loc, scale):
+        self._loc_t = loc if isinstance(loc, Tensor) else Tensor(_v(loc))
+        self._scale_t = scale if isinstance(scale, Tensor) else Tensor(_v(scale))
+        self.loc = self._loc_t._value
+        self.scale = self._scale_t._value
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.loc, self.scale)
+        u = jax.random.uniform(_key(), shp, jnp.float32, 1e-6, 1.0 - 1e-6)
+        return apply_op(
+            lambda l, s: l + s * jnp.tan(math.pi * (u - 0.5)),
+            self._loc_t, self._scale_t, name="cauchy_rsample")
+
+    def log_prob(self, value):
+        def f(x, l, s):
+            return (-math.log(math.pi) - jnp.log(s)
+                    - jnp.log1p(((x - l) / s) ** 2))
+
+        return apply_op(f, value, self._loc_t, self._scale_t,
+                        name="cauchy_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.log(4 * math.pi * self.scale), self.batch_shape))
+
+
+class Chi2(Distribution):
+    """reference chi2.py: Gamma(df/2, rate=1/2)."""
+
+    def __init__(self, df):
+        self._df_t = df if isinstance(df, Tensor) else Tensor(_v(df))
+        self.df = self._df_t._value
+        super().__init__(self.df.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.df)
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.df)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.df)
+        return Tensor(2.0 * jax.random.gamma(
+            _key(), jnp.broadcast_to(self.df / 2.0, shp)))
+
+    def log_prob(self, value):
+        def f(x, df):
+            k = df / 2.0
+            return ((k - 1) * jnp.log(x) - x / 2.0
+                    - k * math.log(2.0) - jsp.gammaln(k))
+
+        return apply_op(f, value, self._df_t, name="chi2_log_prob")
+
+
+class ContinuousBernoulli(Distribution):
+    """reference continuous_bernoulli.py: density C(p) p^x (1-p)^(1-x) on
+    [0, 1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self._probs_t = probs if isinstance(probs, Tensor) else Tensor(_v(probs))
+        self.probs = self._probs_t._value
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_norm(self, p):
+        # log C(p); the p ~ 0.5 singularity uses the taylor value log(2)
+        safe = jnp.where((p > self._lims[0]) & (p < self._lims[1]), 0.25, p)
+        ln = jnp.log(jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+                     / jnp.abs(1.0 - 2.0 * safe))
+        return jnp.where((p > self._lims[0]) & (p < self._lims[1]),
+                         jnp.log(2.0), ln)
+
+    @property
+    def mean(self):
+        p = self.probs
+        safe = jnp.where((p > self._lims[0]) & (p < self._lims[1]), 0.25, p)
+        m = safe / (2.0 * safe - 1.0) + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+        return Tensor(jnp.where((p > self._lims[0]) & (p < self._lims[1]),
+                                0.5, m))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.probs)
+        u = jax.random.uniform(_key(), shp, jnp.float32, 1e-6, 1.0 - 1e-6)
+        p = jnp.broadcast_to(self.probs, shp)
+        mid = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(mid, 0.25, p)
+        x = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor(jnp.where(mid, u, x))
+
+    def log_prob(self, value):
+        def f(x, p):
+            return (x * jnp.log(p) + (1.0 - x) * jnp.log1p(-p)
+                    + self._log_norm(p))
+
+        return apply_op(f, value, self._probs_t, name="cb_log_prob")
+
+
+class StudentT(Distribution):
+    """reference student_t.py."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self._df_t = df if isinstance(df, Tensor) else Tensor(_v(df))
+        self._loc_t = loc if isinstance(loc, Tensor) else Tensor(_v(loc))
+        self._scale_t = scale if isinstance(scale, Tensor) else Tensor(_v(scale))
+        self.df = self._df_t._value
+        self.loc = self._loc_t._value
+        self.scale = self._scale_t._value
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2, self.scale ** 2 * self.df / (self.df - 2),
+                      jnp.inf)
+        return Tensor(jnp.where(self.df > 1, v, jnp.nan))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.df, self.loc, self.scale)
+        t = jax.random.t(_key(), jnp.broadcast_to(self.df, shp), shp)
+        return Tensor(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        def f(x, df, l, s):
+            z = (x - l) / s
+            return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+
+        return apply_op(f, value, self._df_t, self._loc_t, self._scale_t,
+                        name="student_t_log_prob")
+
+
+class MultivariateNormal(Distribution):
+    """reference multivariate_normal.py (loc + one of covariance_matrix /
+    scale_tril)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self._loc_t = loc if isinstance(loc, Tensor) else Tensor(_v(loc))
+        self.loc = self._loc_t._value
+        if scale_tril is not None:
+            self._tril = _v(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_v(covariance_matrix))
+        else:
+            raise ValueError("provide covariance_matrix or scale_tril")
+        d = self.loc.shape[-1]
+        super().__init__(self.loc.shape[:-1], (d,))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(_key(), shp, jnp.float32)
+        return apply_op(
+            lambda l: l + jnp.einsum("...ij,...j->...i", self._tril, eps),
+            self._loc_t, name="mvn_rsample")
+
+    def log_prob(self, value):
+        def f(x, l):
+            d = x.shape[-1]
+            diff = x - l
+            z = jax.scipy.linalg.solve_triangular(self._tril, diff[..., None],
+                                                  lower=True)[..., 0]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                                  axis2=-1)), -1)
+            return (-0.5 * jnp.sum(z ** 2, -1) - logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+
+        return apply_op(f, value, self._loc_t, name="mvn_log_prob")
+
+    def entropy(self):
+        d = self.event_shape[0]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2, axis2=-1)), -1)
+        return Tensor(0.5 * d * (1 + math.log(2 * math.pi)) + logdet)
+
+
+class Independent(Distribution):
+    """reference independent.py: reinterpret batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self.rank], bs[len(bs) - self.rank:]
+                         + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+
+        def f(v):
+            return v.sum(axis=tuple(range(v.ndim - self.rank, v.ndim)))
+
+        return apply_op(f, lp, name="independent_log_prob")
+
+    def entropy(self):
+        ent = self.base.entropy()
+
+        def f(v):
+            return v.sum(axis=tuple(range(v.ndim - self.rank, v.ndim)))
+
+        return apply_op(f, ent, name="independent_entropy")
+
+
+# -- transforms (reference transform.py) -------------------------------------
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def forward(self, x):
+        return apply_op(lambda v: self.loc + self.scale * v, x, name="affine_fwd")
+
+    def inverse(self, y):
+        return apply_op(lambda v: (v - self.loc) / self.scale, y, name="affine_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(lambda v: jnp.broadcast_to(
+            jnp.log(jnp.abs(self.scale)), v.shape), x, name="affine_ldj")
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return apply_op(jnp.exp, x, name="exp_fwd")
+
+    def inverse(self, y):
+        return apply_op(jnp.log, y, name="exp_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(lambda v: v, x, name="exp_ldj")
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return apply_op(jax.nn.sigmoid, x, name="sigmoid_fwd")
+
+    def inverse(self, y):
+        return apply_op(lambda v: jnp.log(v) - jnp.log1p(-v), y,
+                        name="sigmoid_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v),
+                        x, name="sigmoid_ldj")
+
+
+class TransformedDistribution(Distribution):
+    """reference transformed_distribution.py: push base samples through
+    transforms; log_prob via the change-of-variables formula."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    rsample = sample
+
+    def log_prob(self, value):
+        y = value
+        ldj_terms = []
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ldj_terms.append(t.forward_log_det_jacobian(x))
+            y = x
+        lp = self.base.log_prob(y)
+        out = lp
+        for term in ldj_terms:
+            out = apply_op(lambda a, b: a - b, out, term, name="td_log_prob")
+        return out

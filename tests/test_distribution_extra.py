@@ -1,0 +1,97 @@
+"""Distribution zoo second shelf (reference: python/paddle/distribution/ —
+binomial/cauchy/chi2/continuous_bernoulli/student_t/multivariate_normal/
+independent/transform)."""
+import numpy as np
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def setup_module(_):
+    paddle.seed(1234)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def test_binomial_moments_and_logprob():
+    d = D.Binomial(10, t(0.3))
+    s = np.asarray(d.sample((4000,))._value)
+    assert abs(s.mean() - 3.0) < 0.15
+    lp = float(d.log_prob(t(4.0))._value)
+    np.testing.assert_allclose(lp, st.binom.logpmf(4, 10, 0.3), rtol=1e-4)
+
+
+def test_cauchy_logprob_entropy():
+    d = D.Cauchy(t(1.0), t(2.0))
+    np.testing.assert_allclose(float(d.log_prob(t(0.0))._value),
+                               st.cauchy.logpdf(0.0, 1.0, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()._value),
+                               st.cauchy.entropy(1.0, 2.0), rtol=1e-5)
+    s = np.asarray(d.sample((5000,))._value)
+    np.testing.assert_allclose(np.median(s), 1.0, atol=0.3)
+
+
+def test_chi2_and_student_t_against_scipy():
+    c = D.Chi2(t(5.0))
+    np.testing.assert_allclose(float(c.log_prob(t(3.0))._value),
+                               st.chi2.logpdf(3.0, 5.0), rtol=1e-4)
+    s = np.asarray(c.sample((4000,))._value)
+    assert abs(s.mean() - 5.0) < 0.4
+    d = D.StudentT(t(7.0), t(1.0), t(2.0))
+    np.testing.assert_allclose(float(d.log_prob(t(0.5))._value),
+                               st.t.logpdf(0.5, 7.0, 1.0, 2.0), rtol=1e-4)
+
+
+def test_continuous_bernoulli_density_integrates():
+    d = D.ContinuousBernoulli(t(0.3))
+    xs = np.linspace(1e-4, 1 - 1e-4, 2001, dtype=np.float32)
+    lp = np.asarray(d.log_prob(t(xs))._value)
+    integral = np.trapezoid(np.exp(lp), xs)
+    np.testing.assert_allclose(integral, 1.0, rtol=1e-3)
+    # p = 0.5 limit is the uniform density
+    u = D.ContinuousBernoulli(t(0.5))
+    np.testing.assert_allclose(np.asarray(u.log_prob(t(0.7))._value), 0.0,
+                               atol=1e-4)
+
+
+def test_multivariate_normal():
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    d = D.MultivariateNormal(t([1.0, -1.0]), covariance_matrix=t(cov))
+    np.testing.assert_allclose(
+        float(d.log_prob(t([0.0, 0.0]))._value),
+        st.multivariate_normal.logpdf([0, 0], [1, -1], cov), rtol=1e-4)
+    s = np.asarray(d.sample((6000,))._value)
+    np.testing.assert_allclose(s.mean(0), [1.0, -1.0], atol=0.1)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.15)
+    np.testing.assert_allclose(float(d.entropy()._value),
+                               st.multivariate_normal([1, -1], cov).entropy(),
+                               rtol=1e-4)
+
+
+def test_independent_sums_event_dims():
+    base = D.Normal(t(np.zeros((3, 4))), t(np.ones((3, 4))))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+    x = t(np.zeros((3, 4)))
+    np.testing.assert_allclose(
+        np.asarray(ind.log_prob(x)._value),
+        np.asarray(base.log_prob(x)._value).sum(-1), rtol=1e-6)
+
+
+def test_transformed_distribution_lognormal():
+    base = D.Normal(t(0.2), t(0.5))
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ref = D.LogNormal(t(0.2), t(0.5))
+    for v in (0.5, 1.0, 2.5):
+        np.testing.assert_allclose(float(td.log_prob(t(v))._value),
+                                   float(ref.log_prob(t(v))._value), rtol=1e-5)
+    # affine chain: y = 2x + 1 over a standard normal
+    td2 = D.TransformedDistribution(D.Normal(t(0.0), t(1.0)),
+                                    [D.AffineTransform(1.0, 2.0)])
+    np.testing.assert_allclose(float(td2.log_prob(t(1.5))._value),
+                               st.norm.logpdf(1.5, 1.0, 2.0), rtol=1e-5)
+    s = np.asarray(td2.sample((4000,))._value)
+    assert abs(s.mean() - 1.0) < 0.15 and abs(s.std() - 2.0) < 0.2
