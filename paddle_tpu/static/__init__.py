@@ -16,8 +16,12 @@ from paddle_tpu.static.graph import (  # noqa: F401
     program_guard,
 )
 from paddle_tpu.static import nn  # noqa: F401
+from paddle_tpu.static.io import (  # noqa: F401
+    load, load_inference_model, save, save_inference_model,
+)
 
 __all__ = [
     "InputSpec", "Program", "program_guard", "data", "Executor",
     "default_main_program", "default_startup_program", "nn",
+    "save", "load", "save_inference_model", "load_inference_model",
 ]

@@ -1,0 +1,145 @@
+"""Static-graph persistence + deployment export.
+
+Reference: python/paddle/static/io.py — save/load (program parameters),
+save_inference_model/load_inference_model (pruned inference program +
+persistables served by AnalysisPredictor).
+
+TPU-native: `save_inference_model` lowers the Program's replay function
+(fixed to the given feeds → fetches) through jax.export and writes the SAME
+`.pdmodel/.pdparams` artifact as `jit.save`, so `paddle.inference` and
+`jit.load` serve static-built programs with no extra machinery; "pruning"
+is inherent (only instructions reachable from the fetches are traced —
+XLA dead-code-eliminates the rest).
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.static.graph import Program
+
+__all__ = ["save", "load", "save_inference_model", "load_inference_model"]
+
+
+def save(program: Program, model_path: str):
+    """Persist the program's parameter/state values (reference static.save)."""
+    from paddle_tpu.framework.io_ import save as _save
+
+    blob = {f"var_{vid}": t for vid, t in program.params.items()}
+    _save(blob, model_path + ".pdparams")
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    """Restore parameter/state values into the live tensors."""
+    from paddle_tpu.framework.io_ import load as _load
+
+    blob = _load(model_path + ".pdparams")
+    for vid, t in program.params.items():
+        key = f"var_{vid}"
+        if key in blob:
+            v = blob[key]
+            t._set_value(jnp.asarray(np.asarray(v._value if isinstance(v, Tensor) else v)))
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         program: Program | None = None, **kwargs):
+    """Export feeds→fetches of a static Program as a runnable deployment
+    artifact (reference static/io.py save_inference_model)."""
+    from jax import export as jexport
+
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    if program is None:
+        tag = getattr(feed_vars[0], "_static_var", None)
+        if tag is None:
+            raise ValueError("feed_vars must be static Program variables")
+        program = tag[0]
+    prog = program
+
+    feed_ids, fetch_ids = [], []
+    for fv in feed_vars:
+        tag = getattr(fv, "_static_var", None)
+        if tag is None or tag[0]._graph_id is not prog._graph_id:
+            raise ValueError("feed_vars must belong to the exported program")
+        feed_ids.append(tag[1])
+    for fv in fetch_vars:
+        tag = getattr(fv, "_static_var", None)
+        if tag is None or tag[0]._graph_id is not prog._graph_id:
+            raise ValueError("fetch_vars must belong to the exported program")
+        fetch_ids.append(tag[1])
+
+    param_ids = list(prog.params)
+    param_vals = [np.asarray(prog.params[i]._value) for i in param_ids]
+
+    def pure(pv, xs):
+        env = prog._replay_env(feed_ids, param_ids, list(xs), list(pv),
+                               jnp.asarray(0, jnp.int32))
+        return [env[i] for i in fetch_ids]
+
+    # feed abstract shapes come from the declared feed vars (placeholder
+    # batch dims export as symbolic dims when the program allows)
+    name_of = {vid: n for n, (vid, _, _) in prog.feed_vars.items()}
+    abstracts = []
+    for fid, fv in zip(feed_ids, feed_vars):
+        decl = prog.feed_vars.get(name_of.get(fid), (None, None, None))
+        shape = decl[1] if decl[0] is not None else tuple(fv._value.shape)
+        dims = [None if (d is None or (isinstance(d, int) and d < 0)) else int(d)
+                for d in shape]
+        try:
+            if any(d is None for d in dims):
+                sym = jexport.symbolic_shape(
+                    ",".join(f"b{fid}_{i}" if d is None else str(d)
+                             for i, d in enumerate(dims)))
+            else:
+                sym = tuple(dims)
+            abstracts.append(jax.ShapeDtypeStruct(sym, fv._value.dtype))
+        except Exception:
+            abstracts.append(jax.ShapeDtypeStruct(
+                tuple(1 if d is None else d for d in dims), fv._value.dtype))
+
+    from paddle_tpu.jit.api import _export
+
+    p_abs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in param_vals]
+    try:
+        exported = _export(jax.jit(pure), p_abs, abstracts)
+    except Exception:
+        abstracts = [jax.ShapeDtypeStruct(
+            tuple(1 if not isinstance(d, int) else d for d in a.shape), a.dtype)
+            for a in abstracts]
+        exported = _export(jax.jit(pure), p_abs, abstracts)
+
+    blob = {
+        "stablehlo": exported.serialize(),
+        "params": param_vals,
+        "class": "static.Program",
+        "in_shapes": [(tuple(d if isinstance(d, int) else str(d)
+                             for d in a.shape), str(a.dtype))
+                      for a in abstracts],
+        "feed_names": [name_of.get(fid, f"x{k}")
+                       for k, fid in enumerate(feed_ids)],
+        "fetch_count": len(fetch_ids),
+    }
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(blob, f)
+    from paddle_tpu.framework.io_ import save as _save
+
+    _save({"state_dict": {f"var_{i}": Tensor(jnp.asarray(v))
+                          for i, v in zip(param_ids, param_vals)},
+           "class": "static.Program"}, path_prefix + ".pdparams")
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Returns [runnable, feed_target_names, fetch_targets-count] matching the
+    reference's [program, feed_names, fetch_targets] triple; the runnable is
+    a TranslatedLayer taking the feeds positionally."""
+    from paddle_tpu.jit.api import load as _jit_load
+
+    translated = _jit_load(path_prefix)
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        blob = pickle.load(f)
+    return [translated, blob.get("feed_names", []),
+            list(range(blob.get("fetch_count", 1)))]

@@ -202,3 +202,51 @@ def test_fetch_foreign_var_rejected():
         exe.run(main, feed={"x": np.zeros((2, 2), "float32")}, fetch_list=[out2])
     with pytest.raises(ValueError, match="missing feeds"):
         exe.run(main, feed={}, fetch_list=[out])
+
+
+def test_save_load_program_params(tmp_path):
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        out = static.nn.fc(x, 2)
+    exe = static.Executor()
+    feed = {"x": np.ones((2, 4), "float32")}
+    before, = exe.run(main, feed=feed, fetch_list=[out])
+    static.save(main, str(tmp_path / "ckpt"))
+    # clobber params, reload, outputs restored
+    for t in main.params.values():
+        t._set_value(np.zeros_like(np.asarray(t._value)))
+    zeroed, = exe.run(main, feed=feed, fetch_list=[out])
+    assert not np.allclose(zeroed, before)
+    static.load(main, str(tmp_path / "ckpt"))
+    after, = exe.run(main, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_save_inference_model_roundtrip(tmp_path):
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        h = static.nn.fc(x, 8, activation="relu")
+        out = static.nn.fc(h, 3)
+    exe = static.Executor()
+    prefix = str(tmp_path / "infer")
+    static.save_inference_model(prefix, [x], [out], exe)
+
+    runnable, feed_names, fetch_targets = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    xb = np.random.RandomState(0).randn(5, 4).astype("float32")
+    got = runnable(xb)
+    got0 = np.asarray((got[0] if isinstance(got, (list, tuple)) else got)._value)
+    ref, = exe.run(main, feed={"x": xb}, fetch_list=[out])
+    np.testing.assert_allclose(got0, ref, rtol=1e-5, atol=1e-6)
+
+    # the same artifact serves through paddle.inference
+    pred = paddle.inference.create_predictor(paddle.inference.Config(prefix))
+    h0 = pred.get_input_handle(pred.get_input_names()[0])
+    h0.copy_from_cpu(xb)
+    pred.run()
+    out_np = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out_np, ref, rtol=1e-5, atol=1e-6)
