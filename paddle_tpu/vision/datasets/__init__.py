@@ -87,3 +87,135 @@ class Cifar10(_Cifar):
 class Cifar100(_Cifar):
     def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
         super().__init__(100, mode, transform)
+
+
+class DatasetFolder(Dataset):
+    """Directory-of-class-subdirs dataset (reference:
+    vision/datasets/folder.py DatasetFolder). `loader` maps a path to an
+    array; the default reads .npy (no image codecs in this environment —
+    supply a loader for other formats)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or (lambda p: np.load(p))
+        self.transform = transform
+        exts = tuple(extensions) if extensions else (".npy",)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(exts))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root} (extensions {exts})")
+
+    def __getitem__(self, i):
+        path, label = self.samples[i]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """reference folder.py ImageFolder: images only, no labels returned."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        # accept a flat directory too
+        flat = [f for f in sorted(os.listdir(root))
+                if os.path.isfile(os.path.join(root, f))]
+        self.root = root
+        self.loader = loader or (lambda p: np.load(p))
+        self.transform = transform
+        exts = tuple(extensions) if extensions else (".npy",)
+        if flat:
+            self.samples = [(os.path.join(root, f), 0) for f in flat
+                            if f.lower().endswith(exts)]
+            self.classes = []
+            self.class_to_idx = {}
+        else:
+            super().__init__(root, loader, extensions, transform, is_valid_file)
+
+    def __getitem__(self, i):
+        path, _ = self.samples[i]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+
+class Flowers(Dataset):
+    """reference vision/datasets/flowers.py: 102-class flowers. Synthetic
+    HWC images with the real label range unless local arrays are given."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, backend=None, samples=256):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.transform = transform
+        if data_file is not None:
+            blob = np.load(data_file)
+            self.images, self.labels = blob["images"], blob["labels"]
+        else:
+            self.labels = rng.randint(0, 102, samples).astype(np.int64)
+            base = rng.rand(102, 32, 32, 3).astype(np.float32)
+            self.images = np.stack([
+                np.clip(base[l] + 0.05 * rng.randn(32, 32, 3), 0, 1)
+                for l in self.labels]).astype(np.float32)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[i])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """reference vision/datasets/voc2012.py: (image, segmentation-mask)
+    pairs; synthetic shapes-on-canvas masks keep the 21-class contract."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend=None, samples=128, size=64):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.transform = transform
+        self.items = []
+        for _ in range(samples):
+            img = rng.rand(size, size, 3).astype(np.float32)
+            mask = np.zeros((size, size), np.int64)
+            for _ in range(rng.randint(1, 4)):
+                cls = rng.randint(1, self.NUM_CLASSES)
+                x0, y0 = rng.randint(0, size // 2, 2)
+                ww, hh = rng.randint(size // 8, size // 2, 2)
+                mask[y0:y0 + hh, x0:x0 + ww] = cls
+                img[y0:y0 + hh, x0:x0 + ww] += cls / self.NUM_CLASSES
+            self.items.append((np.clip(img, 0, 2), mask))
+
+    def __getitem__(self, i):
+        img, mask = self.items[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.items)
+
+
+__all__ += ["DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

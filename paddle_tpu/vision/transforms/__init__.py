@@ -97,3 +97,236 @@ class CenterCrop:
         th, tw = self.size
         i, j = (h - th) // 2, (w - tw) // 2
         return arr[:, i : i + th, j : j + tw]
+
+
+# ---------------------------------------------------------------------------
+# class-transform zoo + functional (reference transforms/transforms.py)
+from paddle_tpu.vision.transforms import functional  # noqa: E402,F401
+from paddle_tpu.vision.transforms.functional import (  # noqa: E402,F401
+    adjust_brightness, adjust_contrast, adjust_hue, adjust_saturation,
+    affine, center_crop, crop, erase, hflip, normalize, pad, perspective,
+    resize, rotate, to_grayscale, to_tensor, vflip,
+)
+
+
+class BaseTransform:
+    """reference transforms.py BaseTransform: _apply_image hook."""
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return functional.vflip(img)
+        return np.asarray(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                patch = functional.crop(arr, top, left, ch, cw)
+                return functional.resize(patch, self.size, self.interpolation)
+        return functional.resize(functional.center_crop(arr, min(h, w)),
+                                 self.size, self.interpolation)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return functional.adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return functional.adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return functional.adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = np.random.uniform(-self.value, self.value)
+        return functional.adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = [BrightnessTransform(brightness), ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        for t in np.random.permutation(self.ts):
+            img = t(img)
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return functional.pad(img, self.padding, self.fill, self.mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        self.degrees = ((-degrees, degrees) if isinstance(degrees, numbers_Real)
+                        else tuple(degrees))
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return functional.rotate(img, angle, self.interpolation, self.expand,
+                                 self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        self.degrees = ((-degrees, degrees) if isinstance(degrees, numbers_Real)
+                        else tuple(degrees))
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = np.asarray(img).shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = np.random.uniform(-self.shear, self.shear) if isinstance(
+            self.shear, numbers_Real) else 0.0
+        return functional.affine(img, angle, (tx, ty), sc, sh,
+                                 self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return np.asarray(img)
+        h, w = np.asarray(img).shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1), h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1), h - 1 - np.random.randint(0, dy + 1))]
+        return functional.perspective(img, start, end, self.interpolation,
+                                      self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return functional.to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return np.asarray(img)
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3)
+        h, w = arr.shape[1:3] if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh, ew = int(round(np.sqrt(target * ar))), int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                return functional.erase(arr, i, j, eh, ew, self.value,
+                                        self.inplace)
+        return arr
+
+
+import numbers as _numbers  # noqa: E402
+
+numbers_Real = _numbers.Real
+
+__all__ += [
+    "BaseTransform", "RandomVerticalFlip", "RandomResizedCrop",
+    "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+    "HueTransform", "ColorJitter", "Pad", "RandomRotation", "RandomAffine",
+    "RandomPerspective", "Grayscale", "RandomErasing", "functional",
+    "to_tensor", "hflip", "vflip", "resize", "pad", "crop", "center_crop",
+    "adjust_brightness", "adjust_contrast", "adjust_saturation", "adjust_hue",
+    "normalize", "erase", "rotate", "affine", "perspective", "to_grayscale",
+]

@@ -1,0 +1,265 @@
+"""Vision transform functionals (reference: python/paddle/vision/transforms/
+functional.py + functional_cv2.py — here on the numpy/scipy backend: images
+are HWC uint8/float arrays; geometric warps use scipy.ndimage, which matches
+the reference's cv2 semantics for the orders used).
+
+Host-side preprocessing by design: augmentation runs in DataLoader worker
+processes, the TPU sees ready batches.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+import scipy.ndimage as ndi
+
+__all__ = [
+    "to_tensor", "hflip", "vflip", "resize", "pad", "crop", "center_crop",
+    "adjust_brightness", "adjust_contrast", "adjust_saturation", "adjust_hue",
+    "normalize", "erase", "rotate", "affine", "perspective", "to_grayscale",
+]
+
+
+def _f32(img):
+    return np.asarray(img, np.float32)
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = _f32(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if np.asarray(img).dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    from paddle_tpu.core.tensor import Tensor
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(arr))
+
+
+def hflip(img):
+    return np.ascontiguousarray(np.asarray(img)[:, ::-1])
+
+
+def vflip(img):
+    return np.ascontiguousarray(np.asarray(img)[::-1])
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, numbers.Number):
+        # reference semantics: scale the SHORT side to `size`, keep ratio
+        if h < w:
+            oh, ow = int(size), max(1, int(round(w * size / h)))
+        else:
+            oh, ow = max(1, int(round(h * size / w))), int(size)
+    else:
+        oh, ow = int(size[0]), int(size[1])
+    order = {"nearest": 0, "bilinear": 1, "bicubic": 3}.get(interpolation, 1)
+    zoom = (oh / h, ow / w) + (1,) * (arr.ndim - 2)
+    out = ndi.zoom(arr.astype(np.float32), zoom, order=order, mode="nearest",
+                   grid_mode=True)
+    return out.astype(arr.dtype) if arr.dtype == np.uint8 else out
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, numbers.Number):
+        pl = pt = pr = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = int(padding[0]), int(padding[1])
+        pr, pb = pl, pt
+    else:
+        pl, pt, pr, pb = (int(p) for p in padding)
+    pads = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, pads, mode="constant", constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    return np.pad(arr, pads, mode=mode)
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = np.asarray(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    return crop(arr, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _f32(img) * float(brightness_factor)
+    return _clip_like(arr, img)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _f32(img)
+    gray = arr.mean() if arr.ndim == 2 else _rgb_to_gray(arr).mean()
+    out = gray + float(contrast_factor) * (arr - gray)
+    return _clip_like(out, img)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _f32(img)
+    gray = _rgb_to_gray(arr)[..., None]
+    out = gray + float(saturation_factor) * (arr - gray)
+    return _clip_like(out, img)
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _f32(img)
+    scale = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    hsv = _rgb_to_hsv(arr / scale)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    out = _hsv_to_rgb(hsv) * scale
+    return _clip_like(out, img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _f32(img)
+    shape = ([-1, 1, 1] if data_format == "CHW" else [1, 1, -1])
+    m = np.asarray(mean, np.float32).reshape(shape)
+    s = np.asarray(std, np.float32).reshape(shape)
+    return (arr - m) / s
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = np.asarray(img) if inplace else np.asarray(img).copy()
+    if arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3):
+        arr[:, i:i + h, j:j + w] = v  # CHW
+    else:
+        arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = _f32(img)
+    order = {"nearest": 0, "bilinear": 1, "bicubic": 3}.get(interpolation, 0)
+    # positive angle rotates counter-clockwise (reference/cv2 convention)
+    out = ndi.rotate(arr, float(angle), axes=(1, 0), reshape=expand,
+                     order=order, mode="constant", cval=fill)
+    return _clip_like(out, img)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    arr = _f32(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else (center[1], center[0])
+    a = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in (shear if isinstance(shear, (list, tuple))
+                                      else (shear, 0.0)))
+    # forward matrix: rotate(+shear)·scale about center, then translate
+    m = np.array([
+        [np.cos(a + sy) * scale, -np.sin(a + sx) * scale],
+        [np.sin(a + sy) * scale, np.cos(a + sx) * scale],
+    ])
+    minv = np.linalg.inv(m)
+    offset = np.array([cy, cx]) - minv @ np.array(
+        [cy + translate[1], cx + translate[0]])
+    order = {"nearest": 0, "bilinear": 1}.get(interpolation, 0)
+    if arr.ndim == 2:
+        out = ndi.affine_transform(arr, minv, offset=offset, order=order,
+                                   mode="constant", cval=fill)
+    else:
+        out = np.stack([
+            ndi.affine_transform(arr[..., c], minv, offset=offset, order=order,
+                                 mode="constant", cval=fill)
+            for c in range(arr.shape[-1])], axis=-1)
+    return _clip_like(out, img)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    arr = _f32(img)
+    mat = _homography(np.asarray(endpoints, np.float64),
+                      np.asarray(startpoints, np.float64))
+    h, w = arr.shape[:2]
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float64)
+    denom = mat[2, 0] * xs + mat[2, 1] * ys + mat[2, 2]
+    # snap DLT float noise (~1e-16) so border pixels don't fall epsilon
+    # outside the image and pick up the constant fill
+    sx = np.round((mat[0, 0] * xs + mat[0, 1] * ys + mat[0, 2]) / denom, 6)
+    sy = np.round((mat[1, 0] * xs + mat[1, 1] * ys + mat[1, 2]) / denom, 6)
+    order = {"nearest": 0, "bilinear": 1}.get(interpolation, 0)
+
+    def warp(ch):
+        return ndi.map_coordinates(ch, [sy, sx], order=order, mode="constant",
+                                   cval=fill)
+
+    if arr.ndim == 2:
+        out = warp(arr)
+    else:
+        out = np.stack([warp(arr[..., c]) for c in range(arr.shape[-1])], -1)
+    return _clip_like(out, img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _f32(img)
+    gray = _rgb_to_gray(arr)
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _clip_like(out, img)
+
+
+# -- helpers -----------------------------------------------------------------
+def _clip_like(arr, ref):
+    if np.asarray(ref).dtype == np.uint8:
+        return np.clip(np.round(arr), 0, 255).astype(np.uint8)
+    return arr.astype(np.float32)
+
+
+def _rgb_to_gray(arr):
+    if arr.ndim == 2:
+        return arr
+    return arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+
+
+def _rgb_to_hsv(rgb):
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = np.max(rgb, -1)
+    minc = np.min(rgb, -1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    rc = (maxc - r) / np.maximum(delta, 1e-12)
+    gc = (maxc - g) / np.maximum(delta, 1e-12)
+    bc = (maxc - b) / np.maximum(delta, 1e-12)
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(delta == 0, 0.0, h)
+    h = (h / 6.0) % 1.0
+    return np.stack([h, s, v], -1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    conds = [i == k for k in range(6)]
+    r = np.select(conds, [v, q, p, p, t, v])
+    g = np.select(conds, [t, v, v, q, p, p])
+    b = np.select(conds, [p, p, t, v, v, q])
+    return np.stack([r, g, b], -1)
+
+
+def _homography(src, dst):
+    """3x3 mapping src->dst from 4 point pairs (DLT)."""
+    a = []
+    for (x, y), (u, v) in zip(src, dst):
+        a.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        a.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+    b = dst.reshape(-1)
+    sol = np.linalg.lstsq(np.asarray(a, np.float64), b, rcond=None)[0]
+    return np.append(sol, 1.0).reshape(3, 3)

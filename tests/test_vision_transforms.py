@@ -1,0 +1,112 @@
+"""Vision transforms functional + class zoo and folder datasets
+(reference: python/paddle/vision/transforms, vision/datasets/folder.py)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import datasets, transforms as T
+from paddle_tpu.vision.transforms import functional as F
+
+
+def _img(h=8, w=8, c=3, seed=0):
+    return (np.random.RandomState(seed).rand(h, w, c) * 255).astype(np.uint8)
+
+
+def test_flips_crops_pads():
+    img = _img()
+    np.testing.assert_array_equal(F.hflip(img), img[:, ::-1])
+    np.testing.assert_array_equal(F.vflip(img), img[::-1])
+    assert F.crop(img, 1, 2, 3, 4).shape == (3, 4, 3)
+    assert F.center_crop(img, 4).shape == (4, 4, 3)
+    padded = F.pad(img, (1, 2), fill=7)
+    assert padded.shape == (12, 10, 3) and padded[0, 0, 0] == 7
+    refl = F.pad(img, 1, padding_mode="reflect")
+    np.testing.assert_array_equal(refl[0, 1], img[1, 0])
+
+
+def test_resize_short_side_and_exact():
+    img = _img(8, 16)
+    out = F.resize(img, 4)  # short side -> 4, keep ratio
+    assert out.shape == (4, 8, 3)
+    assert F.resize(img, (5, 6)).shape == (5, 6, 3)
+    # constant image stays constant under bilinear resize
+    const = np.full((8, 8, 3), 100, np.uint8)
+    np.testing.assert_array_equal(F.resize(const, (4, 4)), 100)
+
+
+def test_color_adjustments():
+    img = _img()
+    np.testing.assert_array_equal(F.adjust_brightness(img, 1.0), img)
+    dark = F.adjust_brightness(img, 0.5)
+    assert dark.mean() < img.mean()
+    # contrast 0 collapses to the gray mean
+    flat = F.adjust_contrast(img, 0.0)
+    assert flat.std() < 2
+    # saturation 0 == grayscale
+    gray = F.adjust_saturation(img, 0.0)
+    assert np.abs(gray[..., 0].astype(int) - gray[..., 1].astype(int)).max() <= 1
+    # hue shift of 0 is identity (within rounding)
+    same = F.adjust_hue(img, 0.0)
+    assert np.abs(same.astype(int) - img.astype(int)).max() <= 1
+    g1 = F.to_grayscale(img, 3)
+    assert g1.shape == img.shape
+
+
+def test_rotate_affine_perspective_identity():
+    img = _img(9, 9)
+    np.testing.assert_array_equal(F.rotate(img, 0.0), img)
+    ident = F.affine(img, 0.0, (0, 0), 1.0, 0.0)
+    np.testing.assert_array_equal(ident, img)
+    # 90-degree rotation is an exact permutation at order 0
+    rot = F.rotate(img.astype(np.float32), 90.0)
+    np.testing.assert_allclose(rot, np.rot90(img.astype(np.float32)),
+                               atol=1e-4)
+    pts = [(0, 0), (8, 0), (8, 8), (0, 8)]
+    same = F.perspective(img, pts, pts)
+    np.testing.assert_array_equal(same, img)
+
+
+def test_class_transforms_run_and_compose():
+    np.random.seed(0)
+    img = _img(16, 16)
+    pipeline = T.Compose([
+        T.RandomResizedCrop(8),
+        T.RandomVerticalFlip(0.5),
+        T.ColorJitter(0.2, 0.2, 0.2, 0.1),
+        T.RandomRotation(10),
+        T.RandomErasing(prob=1.0),
+        T.Grayscale(3),
+    ])
+    out = pipeline(img)
+    assert out.shape == (8, 8, 3)
+    pers = T.RandomPerspective(prob=1.0)(img)
+    assert pers.shape == img.shape
+    aff = T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1), shear=5)(img)
+    assert aff.shape == img.shape
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / "root" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            np.save(d / f"{i}.npy", np.full((4, 4, 3), i, np.float32))
+    ds = datasets.DatasetFolder(str(tmp_path / "root"))
+    assert ds.classes == ["cat", "dog"] and len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (4, 4, 3) and label in (0, 1)
+    flat = tmp_path / "flat"
+    flat.mkdir()
+    np.save(flat / "a.npy", np.zeros((2, 2), np.float32))
+    imf = datasets.ImageFolder(str(flat))
+    (only,) = imf[0]
+    assert only.shape == (2, 2) and len(imf) == 1
+
+
+def test_flowers_voc_contracts():
+    fl = datasets.Flowers(mode="train", samples=8)
+    img, lab = fl[0]
+    assert img.shape == (32, 32, 3) and 0 <= int(lab) < 102
+    voc = datasets.VOC2012(samples=4, size=32)
+    img, mask = voc[0]
+    assert img.shape == (32, 32, 3) and mask.shape == (32, 32)
+    assert mask.max() < datasets.VOC2012.NUM_CLASSES
