@@ -886,6 +886,118 @@ case("eigvals_abs", lambda x: paddle.sort(paddle.abs(paddle.eigvals(x))),
      rtol=1e-4, atol=1e-4)
 
 
+# ---- session-2 functional tail: forward AND gradients ----------------------
+def _np_huber(x, y):
+    d = x - y
+    return np.where(np.abs(d) <= 1.0, 0.5 * d * d, np.abs(d) - 0.5)
+
+
+case("huber_loss", lambda x, y: F.huber_loss(x, y, reduction="none"),
+     _np_huber, A, B)
+case("log_loss",
+     lambda p, y: F.log_loss(p, y),
+     lambda p, y: -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4),
+     np.abs(A) % 0.8 + 0.1, (A > 0).astype(np.float64), wrt=(0,),
+     rtol=2e-3, atol=1e-4)
+case("swiglu_split", F.swiglu,
+     lambda x: (lambda a, b: a / (1 + np.exp(-a)) * b)(
+         *np.split(x, 2, axis=-1)),
+     np.ascontiguousarray(r.randn(3, 8)), rtol=1e-4, atol=1e-5)
+case("channel_shuffle_f",
+     lambda x: F.channel_shuffle(x, 2),
+     lambda x: x.reshape(x.shape[0], 2, x.shape[1] // 2, *x.shape[2:])
+                .transpose(0, 2, 1, 3, 4).reshape(x.shape),
+     np.ascontiguousarray(r.randn(2, 4, 3, 3)))
+case("pixel_unshuffle_f",
+     lambda x: F.pixel_unshuffle(x, 2),
+     lambda x: x.reshape(x.shape[0], x.shape[1], x.shape[2] // 2, 2,
+                         x.shape[3] // 2, 2)
+                .transpose(0, 1, 3, 5, 2, 4)
+                .reshape(x.shape[0], x.shape[1] * 4, x.shape[2] // 2,
+                         x.shape[3] // 2),
+     np.ascontiguousarray(r.randn(2, 3, 4, 4)))
+case("lp_pool2d_f",
+     lambda x: F.lp_pool2d(x, 2.0, 2),
+     lambda x: np.sqrt((x.reshape(1, 1, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5)
+                        ** 2).sum(axis=(4, 5))),
+     np.abs(r.randn(1, 1, 4, 4)) + 0.1, rtol=1e-4, atol=1e-5)
+
+
+def _np_grid_sample_identity(x):
+    return x
+
+
+_theta_id = np.tile(np.array([[1., 0., 0.], [0., 1., 0.]], np.float32),
+                    (2, 1, 1))
+case("grid_sample_identity",
+     lambda x: F.grid_sample(
+         x, F.affine_grid(paddle.to_tensor(_theta_id), [2, 3, 5, 5],
+                          align_corners=True), align_corners=True),
+     _np_grid_sample_identity, np.ascontiguousarray(r.randn(2, 3, 5, 5)),
+     rtol=1e-3, atol=1e-4, gtol=(3e-2, 3e-3))
+
+
+def _np_fold_of_unfold(x):
+    # fold(unfold(x)) == x * coverage for 3x3/stride1/pad1
+    cov = np.zeros_like(x)
+    n, c, h, w = x.shape
+    ones = np.ones((h + 2, w + 2))
+    acc = np.zeros((h + 2, w + 2))
+    for i in range(3):
+        for j in range(3):
+            acc[i:i + h, j:j + w] += ones[i:i + h, j:j + w] * 0 + 1
+    # coverage equals the number of windows covering each pixel
+    cov2 = np.zeros((h + 2, w + 2))
+    for i in range(3):
+        for j in range(3):
+            cov2[i:i + h, j:j + w] += 1
+    return x * cov2[1:1 + h, 1:1 + w]
+
+
+case("fold_unfold",
+     lambda x: F.fold(F.unfold(x, 3, strides=1, paddings=1), [5, 5], 3,
+                      strides=1, paddings=1),
+     _np_fold_of_unfold, np.ascontiguousarray(r.randn(2, 3, 5, 5)),
+     rtol=1e-3, atol=1e-4)
+
+
+class TestRandomOpsDistributional:
+    """Statistical checks for the RNG op family (reference
+    test_uniform_random_op-style moments/range assertions)."""
+
+    def setup_method(self):
+        paddle.seed(1234)
+
+    def test_randn_moments(self):
+        x = np.asarray(paddle.randn([20000])._value)
+        assert abs(x.mean()) < 0.05 and abs(x.std() - 1) < 0.05
+
+    def test_uniform_range_and_mean(self):
+        x = np.asarray(paddle.uniform([20000], min=-2.0, max=4.0)._value)
+        assert x.min() >= -2.0 and x.max() < 4.0
+        assert abs(x.mean() - 1.0) < 0.1
+
+    def test_randint_range(self):
+        x = np.asarray(paddle.randint(3, 9, [5000])._value)
+        assert x.min() >= 3 and x.max() <= 8
+        assert len(np.unique(x)) == 6
+
+    def test_randperm_is_permutation(self):
+        x = np.asarray(paddle.randperm(100)._value)
+        np.testing.assert_array_equal(np.sort(x), np.arange(100))
+
+    def test_normal_moments(self):
+        x = np.asarray(paddle.normal(mean=2.0, std=3.0, shape=[20000])._value)
+        assert abs(x.mean() - 2.0) < 0.1 and abs(x.std() - 3.0) < 0.1
+
+    def test_seed_reproducibility(self):
+        paddle.seed(7)
+        a = np.asarray(paddle.randn([16])._value)
+        paddle.seed(7)
+        b = np.asarray(paddle.randn([16])._value)
+        np.testing.assert_array_equal(a, b)
+
+
 @pytest.mark.parametrize("c", CASES, ids=[c.name for c in CASES])
 def test_forward_f32(c):
     _run_forward(c, "float32")
@@ -944,40 +1056,3 @@ def test_harness_catches_wrong_forward():
     planted = OpCase("bad_exp", paddle.exp, lambda x: np.exp(x) + 0.01, (A,))
     with pytest.raises(AssertionError):
         _run_forward(planted)
-
-
-class TestRandomOpsDistributional:
-    """Statistical checks for the RNG op family (reference
-    test_uniform_random_op-style moments/range assertions)."""
-
-    def setup_method(self):
-        paddle.seed(1234)
-
-    def test_randn_moments(self):
-        x = np.asarray(paddle.randn([20000])._value)
-        assert abs(x.mean()) < 0.05 and abs(x.std() - 1) < 0.05
-
-    def test_uniform_range_and_mean(self):
-        x = np.asarray(paddle.uniform([20000], min=-2.0, max=4.0)._value)
-        assert x.min() >= -2.0 and x.max() < 4.0
-        assert abs(x.mean() - 1.0) < 0.1
-
-    def test_randint_range(self):
-        x = np.asarray(paddle.randint(3, 9, [5000])._value)
-        assert x.min() >= 3 and x.max() <= 8
-        assert len(np.unique(x)) == 6
-
-    def test_randperm_is_permutation(self):
-        x = np.asarray(paddle.randperm(100)._value)
-        np.testing.assert_array_equal(np.sort(x), np.arange(100))
-
-    def test_normal_moments(self):
-        x = np.asarray(paddle.normal(mean=2.0, std=3.0, shape=[20000])._value)
-        assert abs(x.mean() - 2.0) < 0.1 and abs(x.std() - 3.0) < 0.1
-
-    def test_seed_reproducibility(self):
-        paddle.seed(7)
-        a = np.asarray(paddle.randn([16])._value)
-        paddle.seed(7)
-        b = np.asarray(paddle.randn([16])._value)
-        np.testing.assert_array_equal(a, b)
