@@ -1,0 +1,14 @@
+"""Shared example plumbing: small-by-env sizing + CPU-mesh bootstrap."""
+import os
+
+import jax
+
+
+def env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def ensure_cpu_mesh():
+    """Examples default to the virtual CPU mesh when no TPU is attached."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,48 @@
+"""config[4]: GPT-MoE expert parallel — sparse capacity-bucketed dispatch
+via all_to_all over the ep axis (reference MoELayer/global_scatter).
+"""
+import numpy as np
+
+from _common import env_int, ensure_cpu_mesh
+
+ensure_cpu_mesh()
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh  # noqa: E402
+from paddle_tpu.models import GptMoeForCausalLM, gpt_moe_tiny_config  # noqa: E402
+from paddle_tpu.parallel import CompiledTrainStep  # noqa: E402
+
+
+def main():
+    import jax
+
+    steps = env_int("STEPS", 6)
+    ndev = len(jax.devices())
+    ep = 4 if ndev % 4 == 0 else 1
+    mesh = build_mesh({"dp": ndev // ep, "ep": ep})
+    paddle.seed(0)
+    cfg = gpt_moe_tiny_config()
+    model = GptMoeForCausalLM(cfg)
+    model.eval()
+
+    class Wrap:
+        def parameters(self):
+            return model.parameters()
+
+        def __call__(self, ids, labels):
+            return model(ids, labels)
+
+    opt = paddle.optimizer.AdamW(3e-3, parameters=model.parameters())
+    step = CompiledTrainStep(Wrap(), lambda out, lab: out, optimizer=opt,
+                             mesh=mesh)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 256, (ndev, 16)).astype(np.int64))
+    labels = paddle.to_tensor(rng.randint(0, 256, (ndev, 16)).astype(np.int64))
+    losses = [float(step(ids, labels, labels)) for _ in range(steps)]
+    set_mesh(None)
+    print(f"gpt-moe ep[{ep}]: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+    assert losses[-1] < losses[0]
+
+
+if __name__ == "__main__":
+    main()

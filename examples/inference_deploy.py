@@ -1,0 +1,39 @@
+"""Deployment path: jit.save (StableHLO artifact) -> paddle.inference
+predictor, no model class needed at serving time.
+"""
+import os
+import tempfile
+
+import numpy as np
+
+from _common import ensure_cpu_mesh
+
+ensure_cpu_mesh()
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+
+
+def main():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    model.eval()
+    prefix = os.path.join(tempfile.mkdtemp(), "deploy")
+    paddle.jit.save(model, prefix,
+                    input_spec=[paddle.static.InputSpec([None, 16], "float32")])
+
+    config = paddle.inference.Config(prefix)
+    predictor = paddle.inference.create_predictor(config)
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    handle = predictor.get_input_handle(predictor.get_input_names()[0])
+    handle.copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0]).copy_to_cpu()
+    ref = np.asarray(model(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    print(f"inference: served batch {out.shape}, max |err| "
+          f"{np.abs(out - ref).max():.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""config[0]: LeNet-5 on MNIST (reference vision/models/lenet.py workload).
+
+Eager training loop + accuracy eval; the dataset synthesizes MNIST-shaped
+data offline (pass image_path/label_path for real IDX files).
+"""
+import numpy as np
+
+from _common import env_int, ensure_cpu_mesh
+
+ensure_cpu_mesh()
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.io import DataLoader  # noqa: E402
+from paddle_tpu.vision.datasets import MNIST  # noqa: E402
+from paddle_tpu.vision.models import LeNet  # noqa: E402
+
+
+def main():
+    steps = env_int("STEPS", 60)
+    paddle.seed(0)
+    train = MNIST(mode="train", samples=env_int("SAMPLES", 1024))
+    loader = DataLoader(train, batch_size=64, shuffle=True)
+    model = LeNet(num_classes=10)
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    first = last = None
+    it = iter(loader)
+    for step in range(steps):
+        try:
+            x, y = next(it)
+        except StopIteration:
+            it = iter(loader)
+            x, y = next(it)
+        loss = loss_fn(model(x.reshape([-1, 1, 28, 28])), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    print(f"lenet: loss {first:.3f} -> {last:.3f}")
+    assert last < first
+    # accuracy on a held-out batch
+    model.eval()
+    xe, ye = next(iter(DataLoader(MNIST(mode="test", samples=256), batch_size=256)))
+    pred = np.asarray(model(xe.reshape([-1, 1, 28, 28]))._value).argmax(-1)
+    print(f"lenet: eval acc {(pred == np.asarray(ye._value)).mean():.2f}")
+
+
+if __name__ == "__main__":
+    main()

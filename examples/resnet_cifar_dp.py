@@ -1,0 +1,49 @@
+"""config[1]: ResNet-18 data-parallel training (reference Fleet DP
+allreduce workload) — the dp mesh axis shards the batch; XLA inserts the
+gradient psum (the EagerReducer's job) inside one compiled step.
+"""
+import numpy as np
+
+from _common import env_int, ensure_cpu_mesh
+
+ensure_cpu_mesh()
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh  # noqa: E402
+from paddle_tpu.parallel import CompiledTrainStep  # noqa: E402
+from paddle_tpu.vision.models import resnet18  # noqa: E402
+
+
+def main():
+    import jax
+
+    steps = env_int("STEPS", 8)
+    ndev = len(jax.devices())
+    mesh = build_mesh({"dp": ndev})
+    paddle.seed(0)
+    model = resnet18(num_classes=10)
+    model.eval()  # deterministic BN under jit
+    loss_fn = nn.CrossEntropyLoss()
+
+    class Wrap:
+        def parameters(self):
+            return model.parameters()
+
+        def __call__(self, x, y):
+            return loss_fn(model(x), y)
+
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    step = CompiledTrainStep(Wrap(), lambda out, lab: out, optimizer=opt,
+                             mesh=mesh)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(ndev * 2, 3, 32, 32).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, ndev * 2).astype(np.int64))
+    losses = [float(step(x, y, y)) for _ in range(steps)]
+    set_mesh(None)
+    print(f"resnet dp[{ndev}]: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+    assert min(losses[1:]) < losses[0]
+
+
+if __name__ == "__main__":
+    main()

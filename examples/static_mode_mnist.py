@@ -1,0 +1,47 @@
+"""Static-graph mode end to end: Program/program_guard/data/Executor with
+minimize -> donated jitted train step, then an eval clone.
+"""
+import numpy as np
+
+from _common import env_int, ensure_cpu_mesh
+
+ensure_cpu_mesh()
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+from paddle_tpu import static  # noqa: E402
+
+
+def main():
+    steps = env_int("STEPS", 40)
+    paddle.seed(0)
+    main_prog, startup = static.Program(), static.Program()
+    with static.program_guard(main_prog, startup):
+        x = static.data("x", [None, 784], "float32")
+        y = static.data("y", [None, 1], "int64")
+        h = static.nn.fc(x, 128, activation="relu")
+        out = static.nn.fc(h, 10)
+        loss = F.cross_entropy(out, y).mean()
+        params = [t for t in main_prog.params.values() if not t.stop_gradient]
+        opt = paddle.optimizer.Adam(1e-3, parameters=params)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    protos = rng.randn(10, 784).astype(np.float32)
+    yb = rng.randint(0, 10, (256, 1)).astype(np.int64)
+    xb = protos[yb[:, 0]] + 0.3 * rng.randn(256, 784).astype(np.float32)
+    losses = [float(exe.run(main_prog, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])[0]) for _ in range(steps)]
+    print(f"static mnist: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+    assert losses[-1] < losses[0]
+
+    test_prog = main_prog.clone(for_test=True)
+    logits, = exe.run(test_prog, feed={"x": xb, "y": yb}, fetch_list=[out])
+    acc = (logits.argmax(-1) == yb[:, 0]).mean()
+    print(f"static mnist: train-batch acc {acc:.2f}")
+
+
+if __name__ == "__main__":
+    main()

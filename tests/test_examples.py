@@ -1,0 +1,29 @@
+"""Every examples/ script runs end to end (shrunk via env)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+@pytest.mark.parametrize("script", [
+    "mnist_lenet.py", "resnet_cifar_dp.py", "bert_mlm_zero2.py",
+    "llama_tp_pp.py", "gpt_moe_ep.py", "static_mode_mnist.py",
+    "inference_deploy.py",
+])
+def test_example_runs(script):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=8"])
+    env["STEPS"] = "6"
+    env["SAMPLES"] = "256"
+    env["PYTHONPATH"] = os.path.dirname(_EXAMPLES)
+    proc = subprocess.run([sys.executable, script], cwd=_EXAMPLES, env=env,
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "->" in proc.stdout or "served" in proc.stdout
