@@ -266,3 +266,68 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp = amp * (self.exp_gamma ** step)
         return self.base_lr + amp * pct
+
+
+class MultiplicativeDecay(LRScheduler):
+    """reference lr.py MultiplicativeDecay (:1821): lr multiplies by
+    lr_lambda(epoch) cumulatively each epoch."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        lr = self.base_lr
+        for e in range(1, max(self.last_epoch, 0) + 1):
+            lr *= self.lr_lambda(e)
+        return lr
+
+
+class LinearLR(LRScheduler):
+    """reference lr.py LinearLR (:2355): linearly anneal the multiplier from
+    start_factor to end_factor over total_steps."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        e = max(self.last_epoch, 0)
+        if e >= self.total_steps:
+            return self.base_lr * self.end_factor
+        frac = e / self.total_steps
+        factor = self.start_factor + (self.end_factor - self.start_factor) * frac
+        return self.base_lr * factor
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    """reference lr.py CosineAnnealingWarmRestarts (:2474): SGDR cosine
+    cycles restarting every T_i epochs with T_{i+1} = T_i * T_mult."""
+
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0.0,
+                 last_epoch=-1, verbose=False):
+        if T_0 <= 0 or T_mult < 1:
+            raise ValueError("T_0 must be positive and T_mult >= 1")
+        self.T_0 = T_0
+        self.T_mult = T_mult
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        import math as _m
+
+        e = max(self.last_epoch, 0)
+        t_i, t_cur = self.T_0, e
+        while t_cur >= t_i:
+            t_cur -= t_i
+            t_i *= self.T_mult
+        return (self.eta_min + (self.base_lr - self.eta_min)
+                * (1 + _m.cos(_m.pi * t_cur / t_i)) / 2)
+
+
+__all__ += ["MultiplicativeDecay", "LinearLR", "CosineAnnealingWarmRestarts"]

@@ -309,3 +309,39 @@ def test_l1decay_applies_sign_regularization():
     w2.grad = paddle.to_tensor(g_np)
     opt2.step()
     np.testing.assert_allclose(w2.numpy(), w_np * 0.9, rtol=1e-6)
+
+
+def test_lr_scheduler_tail():
+    """MultiplicativeDecay, LinearLR, CosineAnnealingWarmRestarts
+    (reference optimizer/lr.py:1821,2355,2474)."""
+    import math
+
+    from paddle_tpu.optimizer.lr import (
+        CosineAnnealingWarmRestarts, LinearLR, MultiplicativeDecay,
+    )
+
+    m = MultiplicativeDecay(1.0, lambda e: 0.5)
+    vals = []
+    for _ in range(3):
+        vals.append(m())
+        m.step()
+    assert vals == [1.0, 0.5, 0.25]
+
+    lin = LinearLR(2.0, total_steps=4, start_factor=0.5, end_factor=1.0)
+    seq = []
+    for _ in range(6):
+        seq.append(lin())
+        lin.step()
+    assert abs(seq[0] - 1.0) < 1e-9 and abs(seq[2] - 1.5) < 1e-9
+    assert seq[4] == 2.0 and seq[5] == 2.0
+
+    c = CosineAnnealingWarmRestarts(1.0, T_0=2, T_mult=2, eta_min=0.0)
+    got = []
+    for _ in range(7):
+        got.append(c())
+        c.step()
+    assert got[0] == 1.0 and abs(got[1] - 0.5) < 1e-9  # first cycle T=2
+    assert got[2] == 1.0  # restart
+    # second cycle has T=4: lr at its midpoint is 0.5
+    assert abs(got[4] - 0.5) < 1e-9
+    assert got[6] == 1.0  # next restart at epoch 6
