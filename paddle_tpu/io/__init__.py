@@ -24,9 +24,10 @@ from paddle_tpu.ops.random_state import default_generator
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
-    "ChainDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
-    "RandomSampler", "BatchSampler", "DistributedBatchSampler", "DataLoader",
-    "default_collate_fn",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split", "Sampler",
+    "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "SubsetRandomSampler", "BatchSampler", "DistributedBatchSampler",
+    "DataLoader", "default_collate_fn", "get_worker_info",
 ]
 
 
@@ -140,6 +141,75 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    """reference io/dataloader/sampler.py WeightedRandomSampler: draw indices
+    with probability proportional to `weights`."""
+
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, np.float64)
+        if (self.weights < 0).any():
+            raise ValueError("weights must be non-negative")
+        self.num_samples = int(num_samples)
+        self.replacement = bool(replacement)
+        if not self.replacement and self.num_samples > len(self.weights):
+            raise ValueError("num_samples exceeds population without replacement")
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        return iter(np.random.permutation(self.indices).tolist())
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class ConcatDataset(Dataset):
+    """reference ConcatDataset: datasets glued end to end."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = np.cumsum([len(d) for d in self.datasets]).tolist()
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += self.cum[-1]
+        di = int(np.searchsorted(self.cum, idx, side="right"))
+        prev = 0 if di == 0 else self.cum[di - 1]
+        return self.datasets[di][idx - prev]
+
+    def __len__(self):
+        return self.cum[-1]
+
+
+class _WorkerInfo:
+    def __init__(self, id_, num_workers, dataset=None):
+        self.id = id_
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process returns (id, num_workers); None in
+    the main process (reference io/dataloader/worker.py get_worker_info)."""
+    return _worker_info
+
 
 
 class BatchSampler(Sampler):
@@ -427,9 +497,11 @@ def _shm_release(tree):
 
 
 def _worker_loop(dataset, index_q, result_q, collate, worker_init_fn, wid,
-                 use_shared_memory=False, shm_prefix=""):
+                 use_shared_memory=False, shm_prefix="", num_workers_total=1):
     """Child process: fetch+transform+collate — the Python-heavy work that
     would serialize on the parent's GIL (reference io/dataloader/worker.py)."""
+    global _worker_info
+    _worker_info = _WorkerInfo(wid, num_workers_total, dataset)
     if worker_init_fn is not None:
         worker_init_fn(wid)
     seq = [0]
@@ -482,7 +554,7 @@ class _MultiprocessIter:
                 target=_worker_loop,
                 args=(loader.dataset, self._index_q, self._result_q, collate,
                       loader.worker_init_fn, wid, self._use_shm,
-                      self._shm_prefix),
+                      self._shm_prefix, loader.num_workers),
                 daemon=True)
             w.start()
             self._workers.append(w)

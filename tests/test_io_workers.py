@@ -156,3 +156,55 @@ def test_shared_memory_nested_and_early_stop_no_leaks():
     out = [np.asarray(b._value) for b in it2]
     np.testing.assert_array_equal(out[1][1], np.full((32, 32), 3.0))
     assert not glob.glob(f"/dev/shm/{it2._shm_prefix}*")
+
+
+def test_io_api_tail():
+    """ConcatDataset, WeightedRandomSampler, SubsetRandomSampler,
+    get_worker_info (reference io/dataloader/)."""
+    from paddle_tpu.io import (
+        ConcatDataset, SubsetRandomSampler, WeightedRandomSampler,
+        get_worker_info,
+    )
+
+    class Rng(Dataset):
+        def __init__(self, lo, n):
+            self.lo, self.n = lo, n
+
+        def __getitem__(self, i):
+            return self.lo + i
+
+        def __len__(self):
+            return self.n
+
+    cat = ConcatDataset([Rng(0, 3), Rng(100, 2)])
+    assert len(cat) == 5
+    assert [cat[i] for i in range(5)] == [0, 1, 2, 100, 101]
+    assert cat[-1] == 101
+
+    np.random.seed(0)
+    w = WeightedRandomSampler([0.0, 0.0, 1.0], num_samples=8)
+    assert list(w) == [2] * 8
+    s = SubsetRandomSampler([5, 7, 9])
+    assert sorted(s) == [5, 7, 9] and len(s) == 3
+    assert get_worker_info() is None  # main process
+
+
+def test_get_worker_info_in_child():
+    from paddle_tpu.io import get_worker_info
+
+    class WidDataset(Dataset):
+        def __getitem__(self, i):
+            info = get_worker_info()
+            return np.array([info.id if info else -1,
+                             info.num_workers if info else -1], np.int64)
+
+        def __len__(self):
+            return 8
+
+    loader = DataLoader(WidDataset(), batch_size=2, num_workers=2,
+                        shuffle=False)
+    rows = np.concatenate([np.asarray(b._value) if hasattr(b, "_value")
+                           else np.asarray(b) for b in loader])
+    rows = rows.reshape(-1, 2)
+    assert set(rows[:, 0]) <= {0, 1}
+    assert (rows[:, 1] == 2).all()
