@@ -142,6 +142,9 @@ def main():
                           num_key_value_heads=16, max_position_embeddings=4096,
                           use_parallel_cross_entropy=False)
         batch, seq, iters = 2, 4096, 20
+        # config sweeps without editing the file (same fori_loop timing)
+        batch = int(os.environ.get("BENCH_BATCH", batch))
+        seq = int(os.environ.get("BENCH_SEQ", seq))
     else:  # CPU smoke (CI)
         cfg = LlamaConfig(vocab_size=1024, hidden_size=128, intermediate_size=256,
                           num_hidden_layers=2, num_attention_heads=4,
