@@ -68,6 +68,8 @@ from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import onnx  # noqa: F401
 from paddle_tpu import sysconfig  # noqa: F401
 from paddle_tpu import _C_ops  # noqa: F401
+from paddle_tpu import reader  # noqa: F401
+from paddle_tpu import cost_model  # noqa: F401
 from paddle_tpu import vision  # noqa: F401
 from paddle_tpu.hapi import hub  # noqa: F401
 

@@ -1,0 +1,42 @@
+"""paddle.cost_model (reference: python/paddle/cost_model/cost_model.py —
+profile-based per-op cost measurement for the auto-parallel planner).
+
+TPU-native: static costs come from XLA's own cost analysis over the
+compiled program (`flops`, bytes accessed); measured costs time the jitted
+callable. The auto-tuner (distributed/auto_tuner) consumes the same
+numbers."""
+from __future__ import annotations
+
+import time
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def static_cost(self, fn, *example_args):
+        """XLA cost analysis of `fn` on the example inputs:
+        {'flops': float, 'bytes accessed': float, ...}."""
+        import jax
+
+        arrs = [a._value if hasattr(a, "_value") else a for a in example_args]
+        compiled = jax.jit(fn).lower(*arrs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return dict(ca)
+
+    def profile_measure(self, fn, *example_args, iters: int = 10):
+        """Wall-time the jitted callable (compile excluded):
+        {'time_ms': per-iter milliseconds, 'iters': n}."""
+        import jax
+
+        arrs = [a._value if hasattr(a, "_value") else a for a in example_args]
+        jfn = jax.jit(fn)
+        out = jfn(*arrs)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jfn(*arrs)
+        jax.block_until_ready(out)
+        return {"time_ms": (time.perf_counter() - t0) * 1e3 / iters,
+                "iters": iters}

@@ -1,0 +1,105 @@
+"""paddle.reader (reference: legacy python reader decorators — map_readers,
+buffered, compose, chain, shuffle, firstn). Kept for source parity with
+older training scripts; paddle.io.DataLoader is the modern path."""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
+           "cache"]
+
+
+def map_readers(func, *readers):
+    def reader():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        return itertools.chain(*[r() for r in readers])
+
+    return chained
+
+
+def compose(*readers, check_alignment=True):
+    def composed():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+
+    return composed
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` items on a background thread."""
+
+    class _End:
+        pass
+
+    def buffered_reader():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _End:
+                break
+            yield item
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def cache(reader):
+    all_items = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            all_items.extend(reader())
+            filled[0] = True
+        return iter(all_items)
+
+    return cached

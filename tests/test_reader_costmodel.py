@@ -1,0 +1,34 @@
+"""paddle.reader decorators + paddle.cost_model (reference: legacy reader
+API; cost_model/cost_model.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_reader_decorators():
+    r = paddle.reader
+    base = lambda: iter(range(6))
+    assert list(r.firstn(base, 3)()) == [0, 1, 2]
+    assert list(r.map_readers(lambda a, b: a + b, base, base)()) == [0, 2, 4, 6, 8, 10]
+    assert list(r.chain(base, lambda: iter([99]))()) == [0, 1, 2, 3, 4, 5, 99]
+    assert sorted(r.shuffle(base, 4)()) == [0, 1, 2, 3, 4, 5]
+    assert list(r.buffered(base, 2)()) == [0, 1, 2, 3, 4, 5]
+    comp = r.compose(lambda: iter([(1, 2), (3, 4)]), lambda: iter([5, 6]))
+    assert list(comp()) == [(1, 2, 5), (3, 4, 6)]
+    cached = r.cache(base)
+    assert list(cached()) == list(cached())
+
+
+def test_cost_model_static_and_measured():
+    import jax.numpy as jnp
+
+    cm = paddle.cost_model.CostModel()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(64, 64).astype(np.float32))
+
+    def f(a):
+        return jnp.tanh(a @ a)
+
+    static = cm.static_cost(f, x)
+    assert static.get("flops", 0) >= 2 * 64 * 64 * 64 * 0.9
+    measured = cm.profile_measure(f, x, iters=3)
+    assert measured["time_ms"] > 0
