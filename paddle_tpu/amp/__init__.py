@@ -235,3 +235,6 @@ class GradScaler:
         self._scale = state["scale"]
         self._good_steps = state["good_steps"]
         self._bad_steps = state["bad_steps"]
+
+
+from paddle_tpu.amp import debugging  # noqa: E402,F401

@@ -1,0 +1,112 @@
+"""paddle.amp.debugging (reference: python/paddle/amp/debugging.py —
+collect_operator_stats, check_numerics, TensorCheckerConfig,
+enable/disable_tensor_checker).
+
+TPU-native: operator stats count (op, input-dtype) pairs at the apply_op
+dispatch seam (the analog of the reference's op-stats pass over the
+imperative tracer); the tensor checker is the FLAGS_check_nan_inf dispatch
+hook that validates every op output.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import tensor as _tensor_mod
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "collect_operator_stats", "enable_operator_stats_collection",
+    "disable_operator_stats_collection", "check_numerics",
+    "TensorCheckerConfig", "enable_tensor_checker", "disable_tensor_checker",
+    "DebugMode",
+]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+_active_stats = None
+
+
+def enable_operator_stats_collection():
+    global _active_stats
+    _active_stats = {}
+    _tensor_mod.set_op_stats_sink(_active_stats)
+
+
+def disable_operator_stats_collection():
+    """Stop collecting and print the per-dtype op table (reference prints
+    the four float columns)."""
+    global _active_stats
+    stats = _active_stats or {}
+    _tensor_mod.set_op_stats_sink(None)
+    _active_stats = None
+    by_op: dict = {}
+    for (name, dtype), n in stats.items():
+        by_op.setdefault(name, {})[dtype] = n
+    cols = ["float32", "bfloat16", "float16", "other"]
+    print(f"{'op':<28}" + "".join(f"{c:>10}" for c in cols) + f"{'calls':>8}")
+    for name in sorted(by_op):
+        row = by_op[name]
+        other = sum(v for k, v in row.items()
+                    if k not in ("float32", "bfloat16", "float16"))
+        out = [row.get("float32", 0), row.get("bfloat16", 0),
+               row.get("float16", 0), other]
+        print(f"{name:<28}" + "".join(f"{v:>10}" for v in out)
+              + f"{sum(row.values()):>8}")
+    return by_op
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def check_numerics(tensors, op_type="", var_name="", debug_mode=None):
+    """Raise on nan/inf in the given tensors (reference check_numerics op)."""
+    ts = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    for i, t in enumerate(ts):
+        v = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            arr = np.asarray(v)
+            if not np.isfinite(arr).all():
+                n_nan = int(np.isnan(arr).sum())
+                n_inf = int(np.isinf(arr).sum())
+                raise FloatingPointError(
+                    f"check_numerics failed for {op_type or 'tensor'}"
+                    f"[{var_name or i}]: {n_nan} nan, {n_inf} inf "
+                    f"in shape {list(arr.shape)}")
+    return True
+
+
+class TensorCheckerConfig:
+    """reference debugging.py TensorCheckerConfig: which mode + op scope the
+    dispatch-seam checker enforces."""
+
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def enable_tensor_checker(config: TensorCheckerConfig | None = None):
+    if config is None or config.enable:
+        set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": False})

@@ -265,6 +265,17 @@ _jit_cache: dict = {}
 # installed by paddle_tpu.amp: (op_name, vals) -> vals with autocast applied
 _amp_hook = None
 
+# op-dispatch statistics sink (paddle.amp.debugging.collect_operator_stats);
+# when set to a dict, apply_op counts (op_name, input_dtype) occurrences
+_op_stats = None
+
+
+def set_op_stats_sink(sink):
+    global _op_stats
+    prev = _op_stats
+    _op_stats = sink
+    return prev
+
 
 def _unwrap(x):
     return x._value if isinstance(x, Tensor) else x
@@ -314,6 +325,14 @@ def apply_op(fn: Callable, *tensor_args, name: str | None = None, n_outputs: int
     vals = tuple(_unwrap(a) for a in tensor_args)
     if _amp_hook is not None:
         vals = _amp_hook(name, vals)
+    if _op_stats is not None:
+        for v in vals:
+            if hasattr(v, "dtype"):
+                key = (name, str(v.dtype))
+                _op_stats[key] = _op_stats.get(key, 0) + 1
+                break
+        else:
+            _op_stats[(name, "-")] = _op_stats.get((name, "-"), 0) + 1
 
     if static_kwargs:
         import functools

@@ -1,0 +1,35 @@
+"""paddle.amp.debugging (reference: python/paddle/amp/debugging.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.amp import debugging as dbg
+
+
+def test_operator_stats_collection(capsys):
+    with dbg.collect_operator_stats():
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        y = x @ x
+        (y + 1.0).sum()
+    out = capsys.readouterr().out
+    assert "matmul" in out and "float32" in out
+
+
+def test_check_numerics():
+    ok = paddle.to_tensor(np.ones(4, np.float32))
+    assert dbg.check_numerics(ok)
+    bad = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+    with pytest.raises(FloatingPointError, match="1 nan"):
+        dbg.check_numerics([ok, bad], op_type="softmax", var_name="probs")
+
+
+def test_tensor_checker_flags():
+    from paddle_tpu.core.flags import flag
+
+    dbg.enable_tensor_checker(dbg.TensorCheckerConfig())
+    assert flag("check_nan_inf")
+    # with the checker armed, an op producing nan aborts at dispatch
+    with pytest.raises(FloatingPointError):
+        paddle.log(paddle.to_tensor(np.array([-1.0], np.float32))).sum()
+    dbg.disable_tensor_checker()
+    assert not flag("check_nan_inf")
