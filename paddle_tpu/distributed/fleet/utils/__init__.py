@@ -2,3 +2,6 @@ from paddle_tpu.distributed.fleet.utils import hybrid_parallel_util  # noqa: F40
 from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils  # noqa: F401
 from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401
 from paddle_tpu.distributed.fleet.recompute import recompute  # noqa: F401
+
+from paddle_tpu.distributed.fleet.utils import fs  # noqa: E402,F401
+from paddle_tpu.distributed.fleet.utils.fs import FS, HDFSClient, LocalFS  # noqa: E402,F401

@@ -470,3 +470,24 @@ class TestWatchdogDump:
         assert dump_file.exists()
         state = json.loads(dump_file.read_text())
         assert state["hangs"] and state["hangs"][0]["name"] == "stuck_allreduce"
+
+
+def test_fleet_fs_localfs(tmp_path):
+    """fleet.utils.fs LocalFS (reference fleet/utils/fs.py)."""
+    import pytest
+
+    from paddle_tpu.distributed.fleet.utils.fs import HDFSClient, LocalFS
+
+    fs = LocalFS()
+    d = tmp_path / "ckpt"
+    fs.mkdirs(str(d / "sub"))
+    fs.touch(str(d / "a.txt"))
+    dirs, files = fs.ls_dir(str(d))
+    assert dirs == ["sub"] and files == ["a.txt"]
+    assert fs.is_dir(str(d)) and fs.is_file(str(d / "a.txt"))
+    fs.mv(str(d / "a.txt"), str(d / "b.txt"))
+    assert fs.is_exist(str(d / "b.txt")) and not fs.is_exist(str(d / "a.txt"))
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+    with pytest.raises(RuntimeError, match="hadoop"):
+        HDFSClient()
