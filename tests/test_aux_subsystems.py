@@ -491,3 +491,30 @@ def test_fleet_fs_localfs(tmp_path):
     assert not fs.is_exist(str(d))
     with pytest.raises(RuntimeError, match="hadoop"):
         HDFSClient()
+
+
+def test_framework_tail_apis():
+    """is_compiled_with_*, iinfo/finfo, rng-state round trip, LazyGuard
+    (reference: paddle framework namespace)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    assert not paddle.is_compiled_with_cuda()
+    assert paddle.is_compiled_with_custom_device("tpu")
+    fi = paddle.finfo("bfloat16")
+    assert fi.bits == 16 and fi.max > 3e38 and fi.dtype == "bfloat16"
+    assert paddle.finfo("float32").eps < 1e-6
+    ii = paddle.iinfo("int32")
+    assert ii.min == -2 ** 31 and ii.max == 2 ** 31 - 1
+    paddle.seed(5)
+    s = paddle.get_rng_state()
+    a = np.asarray(paddle.randn([4])._value)
+    paddle.set_rng_state(s)
+    b = np.asarray(paddle.randn([4])._value)
+    np.testing.assert_array_equal(a, b)
+    with paddle.LazyGuard():
+        import paddle_tpu.nn as nn
+
+        m = nn.Linear(2, 2)
+    assert m.weight.shape == [2, 2]
