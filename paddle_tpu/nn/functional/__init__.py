@@ -540,8 +540,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, axis=-1):
-    """RMSNorm (LLaMA-family); fused by XLA, with a Pallas kernel available via
-    paddle_tpu.ops.pallas.rmsnorm for long rows."""
+    """RMSNorm (LLaMA-family). The composite form is the DEFAULT on purpose:
+    XLA fuses it into the surrounding ops and measures ~3x faster than the
+    standalone Pallas kernel (`paddle_tpu.ops.pallas.rmsnorm`, kept for
+    isolated-norm workloads — see its docstring for the numbers)."""
 
     def f(v, *w):
         var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=axis, keepdims=True)

@@ -1,0 +1,1 @@
+from paddle_tpu.ops.pallas.rmsnorm_kernel import rmsnorm  # noqa: F401

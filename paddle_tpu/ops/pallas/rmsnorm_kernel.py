@@ -1,0 +1,122 @@
+"""Pallas fused RMSNorm for TPU (forward + backward).
+
+Reference analog: the fused normalization kernels the reference keeps in
+phi/kernels/fusion (fused_rms_norm; fused attention/FFN epilogues).
+
+TPU-native design: one row-block per grid step — the row loads into VMEM
+once, the fp32 mean-square reduction, rsqrt and scale all happen in
+registers, and the output stores once.
+
+MEASURED (v5e, [8192, 2048] bf16, fwd+bwd): XLA's fused composite runs
+~3x faster (~72us vs ~230us) because it fuses the norm into the
+SURROUNDING ops, eliminating whole tensor round-trips a standalone kernel
+must pay. This is why `nn.functional.rms_norm` defaults to the composite
+(the CINN-replacement thesis of SURVEY §7.1) and Pallas is reserved for
+attention, where XLA cannot avoid the [S, S] materialization. The kernel
+stays as the guaranteed-fused form for isolated-norm workloads and as the
+reference point for that measurement.
+
+Backward recomputes rstd from x (cheaper than storing it for typical d) and
+emits dx and a per-row-block partial dw that the caller sums — gradients
+match the composite formula:
+    dx = rstd * (dy*w - x * rstd^2/d * sum(dy*w*x, axis=-1))
+    dw = sum over rows of dy * x * rstd
+
+Falls back to interpreter mode off-TPU (fake-device pattern, SURVEY §4.4).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["rmsnorm"]
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _rmsnorm_fwd_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    o_ref[...] = (x * rstd * w_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _rmsnorm_bwd_kernel(x_ref, w_ref, dy_ref, dx_ref, dw_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    d = x.shape[-1]
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    dyw = dy * w
+    proj = jnp.sum(dyw * x, axis=-1, keepdims=True) / d
+    dx = rstd * (dyw - x * (rstd * rstd) * proj)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    # dw accumulates across the (sequential on TPU) row-block grid into one
+    # (8, d) buffer — row 0 carries the sum, 8 rows satisfy tiling
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    dw_ref[0, :] = dw_ref[0, :] + jnp.sum(dy * x * rstd, axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def rmsnorm(x, w, eps: float = 1e-6, block_rows: int = 256):
+    """y = x * rsqrt(mean(x^2, -1) + eps) * w over the trailing axis.
+    x: [rows, d] (callers flatten leading dims), w: [d]."""
+    return _fwd(x, w, eps, block_rows)[0]
+
+
+def _fwd(x, w, eps, block_rows):
+    rows, d = x.shape
+    br = min(block_rows, rows)
+    interpret = not _on_tpu()
+    # x64 mode (paddle int64 parity, enabled at package import) makes index
+    # maps emit i64 constants Mosaic can't legalize — same guard as flash
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_rmsnorm_fwd_kernel, eps=eps),
+            grid=(pl.cdiv(rows, br),),
+            in_specs=[pl.BlockSpec((br, d), lambda i: (i, 0)),
+                      pl.BlockSpec((1, d), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+            interpret=interpret,
+        )(x, w.reshape(1, d))
+    return out, (x, w)
+
+
+def _bwd(eps, block_rows, res, dy):
+    x, w = res
+    rows, d = x.shape
+    br = min(block_rows, rows)
+    n_blocks = pl.cdiv(rows, br)
+    interpret = not _on_tpu()
+    with jax.enable_x64(False):
+        dx, dw_acc = pl.pallas_call(
+            functools.partial(_rmsnorm_bwd_kernel, eps=eps),
+            grid=(n_blocks,),
+            in_specs=[pl.BlockSpec((br, d), lambda i: (i, 0)),
+                      pl.BlockSpec((1, d), lambda i: (0, 0)),
+                      pl.BlockSpec((br, d), lambda i: (i, 0))],
+            out_specs=[pl.BlockSpec((br, d), lambda i: (i, 0)),
+                       pl.BlockSpec((8, d), lambda i: (0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((rows, d), x.dtype),
+                       jax.ShapeDtypeStruct((8, d), jnp.float32)],
+            interpret=interpret,
+        )(x, w.reshape(1, d), dy)
+    return dx, dw_acc[0].astype(w.dtype)
+
+
+rmsnorm.defvjp(_fwd, _bwd)
