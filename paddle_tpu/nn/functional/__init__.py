@@ -950,10 +950,32 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, red
     return apply_op(f, *args, name="sigmoid_focal_loss")
 
 
-def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean"):
-    raise NotImplementedError(
-        "ctc_loss: planned via optax.ctc_loss integration; not yet wired"
-    )
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference: warpctc-backed paddle.nn.functional.ctc_loss).
+
+    TPU-native: optax's pure-jax forward-algorithm CTC — a lax.scan over
+    time, fully differentiable and jit/shard-compatible (no warpctc
+    binary). log_probs: [T, N, C] (paddle layout), labels: [N, S]."""
+    import optax
+
+    def f(lp, lab, in_len, lab_len):
+        logits = jnp.transpose(lp, (1, 0, 2))  # [N, T, C]
+        n, t, _ = logits.shape
+        s = lab.shape[1]
+        logit_pad = (jnp.arange(t)[None, :] >= in_len[:, None]).astype(jnp.float32)
+        label_pad = (jnp.arange(s)[None, :] >= lab_len[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, logit_pad, lab.astype(jnp.int32),
+                                 label_pad, blank_id=blank)
+        if norm_by_times:
+            per_seq = per_seq / jnp.maximum(in_len.astype(per_seq.dtype), 1)
+        if reduction == "mean":
+            # paddle/torch 'mean': divide by label length, then batch-mean
+            per_seq = per_seq / jnp.maximum(lab_len.astype(per_seq.dtype), 1)
+        return _reduce(per_seq, reduction)
+
+    return apply_op(f, _t(log_probs), _t(labels), _t(input_lengths),
+                    _t(label_lengths), name="ctc_loss")
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1):

@@ -133,3 +133,33 @@ def test_fold_inverts_unfold_with_coverage():
     np.testing.assert_allclose(np.asarray(fo._value),
                                img * np.asarray(cov._value),
                                rtol=1e-3, atol=1e-5)
+
+
+def test_ctc_loss_matches_torch():
+    """optax-backed ctc_loss reproduces torch.nn.functional.ctc_loss for
+    all reductions (reference: warpctc-backed paddle ctc_loss)."""
+    import torch
+    import torch.nn.functional as TF
+
+    rs = RS(0)
+    T, N, C, S = 12, 3, 6, 4
+    logits = rs.randn(T, N, C).astype(np.float32)
+    log_probs = torch.log_softmax(torch.tensor(logits), dim=-1)
+    labels = rs.randint(1, C, (N, S)).astype(np.int64)
+    in_len = np.array([12, 10, 8], np.int64)
+    lab_len = np.array([4, 3, 2], np.int64)
+    import paddle_tpu as paddle
+
+    for red in ("mean", "sum", "none"):
+        t_loss = TF.ctc_loss(log_probs, torch.tensor(labels),
+                             torch.tensor(in_len), torch.tensor(lab_len),
+                             blank=0, reduction=red)
+        p_loss = F.ctc_loss(paddle.to_tensor(log_probs.numpy()),
+                            paddle.to_tensor(labels), paddle.to_tensor(in_len),
+                            paddle.to_tensor(lab_len), blank=0, reduction=red)
+        np.testing.assert_allclose(np.asarray(p_loss._value), t_loss.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+    x = paddle.to_tensor(log_probs.numpy(), stop_gradient=False)
+    F.ctc_loss(x, paddle.to_tensor(labels), paddle.to_tensor(in_len),
+               paddle.to_tensor(lab_len)).backward()
+    assert x.grad is not None
