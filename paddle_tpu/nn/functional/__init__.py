@@ -1351,3 +1351,85 @@ __all__ += [
     "max_pool2d_with_index", "max_unpool2d", "affine_grid", "grid_sample",
     "fold",
 ]
+
+
+# ---------------------------------------------------------------------------
+# loss tail (reference nn/functional/loss.py: gaussian_nll_loss,
+# poisson_nll_loss, multi_label_soft_margin_loss, soft_margin_loss,
+# triplet_margin_with_distance_loss)
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        val = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            val = val + 0.5 * math.log(2 * math.pi)
+        return _reduce(val, reduction)
+
+    return apply_op(f, _t(input), _t(label), _t(variance),
+                    name="gaussian_nll_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean"):
+    def f(x, y):
+        if log_input:
+            val = jnp.exp(x) - y * x
+        else:
+            val = x - y * jnp.log(x + epsilon)
+        if full:
+            # stirling term for y > 1
+            stir = y * jnp.log(y) - y + 0.5 * jnp.log(2 * math.pi * y)
+            val = val + jnp.where(y > 1, stir, 0.0)
+        return _reduce(val, reduction)
+
+    return apply_op(f, _t(input), _t(label), name="poisson_nll_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean"):
+    def f(x, y, *w):
+        val = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            val = val * w[0]
+        return _reduce(val.mean(axis=-1), reduction)
+
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+    return apply_op(f, *args, name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+
+    return apply_op(f, _t(input), _t(label), name="soft_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    dist = distance_function or (
+        lambda a, b: paddle_pairwise_distance(a, b))
+
+    d_ap = dist(_t(input), _t(positive))
+    d_an = dist(_t(input), _t(negative))
+    if swap:
+        d_pn = dist(_t(positive), _t(negative))
+        d_an = apply_op(jnp.minimum, d_an, d_pn, name="triplet_swap")
+
+    def f(ap, an):
+        return _reduce(jnp.maximum(ap - an + margin, 0.0), reduction)
+
+    return apply_op(f, d_ap, d_an, name="triplet_margin_with_distance_loss")
+
+
+def paddle_pairwise_distance(x, y, p=2.0, epsilon=1e-6):
+    return apply_op(
+        lambda a, b: ((jnp.abs(a - b) + epsilon) ** p).sum(-1) ** (1.0 / p),
+        _t(x), _t(y), name="pairwise_distance")
+
+
+__all__ += [
+    "gaussian_nll_loss", "poisson_nll_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "triplet_margin_with_distance_loss",
+]

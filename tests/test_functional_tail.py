@@ -163,3 +163,59 @@ def test_ctc_loss_matches_torch():
     F.ctc_loss(x, paddle.to_tensor(labels), paddle.to_tensor(in_len),
                paddle.to_tensor(lab_len)).backward()
     assert x.grad is not None
+
+
+def test_loss_tail_matches_torch():
+    """gaussian_nll, poisson_nll, multi_label_soft_margin, soft_margin,
+    triplet_margin_with_distance vs torch (reference nn/functional/loss.py)."""
+    import torch
+    import torch.nn.functional as TF
+
+    rs = RS(3)
+    mu, y, var = rs.randn(8), rs.randn(8), np.abs(rs.randn(8)) + 0.1
+    got = F.gaussian_nll_loss(t(mu), t(y), t(var))
+    ref = TF.gaussian_nll_loss(torch.tensor(mu), torch.tensor(y),
+                               torch.tensor(var))
+    np.testing.assert_allclose(float(got._value), float(ref), rtol=1e-4)
+
+    x = rs.randn(8)
+    lam = np.abs(rs.randn(8)) + 0.5
+    got = F.poisson_nll_loss(t(x), t(lam))
+    ref = TF.poisson_nll_loss(torch.tensor(x), torch.tensor(lam))
+    np.testing.assert_allclose(float(got._value), float(ref), rtol=1e-4)
+
+    logits = rs.randn(4, 5)
+    labels = (rs.rand(4, 5) > 0.5).astype(np.float32)
+    got = F.multi_label_soft_margin_loss(t(logits), t(labels))
+    ref = TF.multilabel_soft_margin_loss(torch.tensor(logits),
+                                         torch.tensor(labels))
+    np.testing.assert_allclose(float(got._value), float(ref), rtol=1e-4)
+
+    sm_x = rs.randn(6)
+    sm_y = np.where(rs.rand(6) > 0.5, 1.0, -1.0)
+    got = F.soft_margin_loss(t(sm_x), t(sm_y))
+    ref = TF.soft_margin_loss(torch.tensor(sm_x), torch.tensor(sm_y))
+    np.testing.assert_allclose(float(got._value), float(ref), rtol=1e-4)
+
+    a, p, n = rs.randn(4, 8), rs.randn(4, 8), rs.randn(4, 8)
+    got = F.triplet_margin_with_distance_loss(t(a), t(p), t(n), margin=0.5)
+    ref = TF.triplet_margin_with_distance_loss(
+        torch.tensor(a, dtype=torch.float32), torch.tensor(p, dtype=torch.float32),
+        torch.tensor(n, dtype=torch.float32), margin=0.5)
+    np.testing.assert_allclose(float(got._value), float(ref), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_loss_layer_tail_constructs_and_runs():
+    import paddle_tpu.nn as nn
+
+    rs = RS(4)
+    assert float(nn.HuberLoss()(t(rs.randn(4)), t(rs.randn(4)))._value) >= 0
+    assert float(nn.SoftMarginLoss()(t(rs.randn(4)),
+                                     t(np.ones(4)))._value) >= 0
+    ctc = nn.CTCLoss(blank=0)
+    lp = np.log(np.full((6, 2, 4), 0.25, np.float32))
+    out = ctc(t(lp), paddle.to_tensor(np.array([[1, 2], [2, 3]], np.int64)),
+              paddle.to_tensor(np.array([6, 6], np.int64)),
+              paddle.to_tensor(np.array([2, 2], np.int64)))
+    assert np.isfinite(float(out._value))
