@@ -3,9 +3,11 @@ from paddle_tpu.nn.layer.layers import (  # noqa: F401
     Identity, Layer, LayerDict, LayerList, Parameter, ParameterList, Sequential,
 )
 from paddle_tpu.nn.layer.common import (  # noqa: F401
-    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Embedding,
-    Flatten, Linear, Pad1D, Pad2D, Unfold, Upsample, UpsamplingBilinear2D,
-    UpsamplingNearest2D,
+    AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
+    Dropout2D, Dropout3D, Embedding, Flatten, Fold, Linear, LpPool2D,
+    MaxUnPool2D, Pad1D, Pad2D, PairwiseDistance, PixelShuffle, PixelUnshuffle,
+    Softmax2D, Unflatten, Unfold, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D, ZeroPad2D,
 )
 from paddle_tpu.nn.layer.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D  # noqa: F401
 from paddle_tpu.nn.layer.norm import (  # noqa: F401

@@ -169,3 +169,143 @@ class Unfold(Layer):
 
     def forward(self, x):
         return F.unfold(x, *self.args)
+
+
+class Unflatten(Layer):
+    """reference nn/layer/common.py Unflatten."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from paddle_tpu.ops.extras import unflatten
+
+        return unflatten(x, self.axis, self.shape)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from paddle_tpu.nn.functional import paddle_pairwise_distance
+
+        out = paddle_pairwise_distance(x, y, self.p, self.epsilon)
+        if self.keepdim:
+            out = out.unsqueeze(-1)
+        return out
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return F.pixel_shuffle(x, self.upscale_factor)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups, self.data_format = groups, data_format
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return F.fold(x, *self.args)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        import paddle_tpu.nn.functional as F
+
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)
+
+
+class Softmax2D(Layer):
+    """Softmax over channels of NCHW maps (reference nn Softmax2D)."""
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return F.softmax(x, axis=-3)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return F.dropout(x, self.p, axis=(0, 1), training=self.training)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+
+    def forward(self, x):
+        from paddle_tpu.ops.manipulation import pad as _pad
+
+        p = self.padding
+        if isinstance(p, int):
+            p = [p, p, p, p]
+        # paddle pad2d order: [left, right, top, bottom]
+        return _pad(x, [0, 0, 0, 0, p[2], p[3], p[0], p[1]])
+
+
+class LpPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return F.lp_pool2d(x, *self.args)
+
+
+__all__ += ["Unflatten", "PairwiseDistance", "PixelShuffle", "PixelUnshuffle",
+            "ChannelShuffle", "Fold", "MaxUnPool2D", "Softmax2D", "Dropout3D",
+            "ZeroPad2D", "LpPool2D"]

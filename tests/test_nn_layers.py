@@ -248,3 +248,34 @@ class TestAttention:
         v = t(np.random.randn(1, 4, 2, 8), sg=False)
         F.scaled_dot_product_attention(q, k, v, is_causal=True).sum().backward()
         assert q.grad is not None and k.grad is not None and v.grad is not None
+
+
+def test_layer_class_tail():
+    """Unflatten/PairwiseDistance/Pixel(Un)Shuffle/ChannelShuffle/Fold/
+    MaxUnPool2D/Softmax2D/ZeroPad2D/LpPool2D/Dropout3D layer classes
+    (reference nn/layer/common.py)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    t = lambda a: paddle.to_tensor(np.asarray(a, np.float32))
+    rs = np.random.RandomState(0)
+    assert nn.Unflatten(1, [2, 3])(t(np.zeros((2, 6)))).shape == [2, 2, 3]
+    pd_ = nn.PairwiseDistance()(t(np.zeros((3, 4))), t(np.ones((3, 4))))
+    np.testing.assert_allclose(np.asarray(pd_._value), [2.0] * 3, rtol=1e-3)
+    x = t(rs.randn(1, 8, 4, 4))
+    assert nn.PixelShuffle(2)(x).shape == [1, 2, 8, 8]
+    assert nn.PixelUnshuffle(2)(t(rs.randn(1, 2, 4, 4))).shape == [1, 8, 2, 2]
+    assert nn.ChannelShuffle(2)(x).shape == [1, 8, 4, 4]
+    img = t(rs.randn(2, 3, 5, 5))
+    u = F.unfold(img, 3, strides=1, paddings=1)
+    assert nn.Fold([5, 5], 3, strides=1, paddings=1)(u).shape == [2, 3, 5, 5]
+    out, idx = F.max_pool2d_with_index(img, 2, stride=2)
+    assert nn.MaxUnPool2D(2, stride=2)(out, idx).shape == [2, 3, 4, 4]
+    sm = nn.Softmax2D()(img)
+    np.testing.assert_allclose(np.asarray(sm._value).sum(1),
+                               np.ones((2, 5, 5)), rtol=1e-5)
+    assert nn.ZeroPad2D([1, 2, 3, 4])(img).shape == [2, 3, 12, 8]
+    assert nn.LpPool2D(2.0, 2)(t(np.abs(rs.randn(1, 1, 4, 4)))).shape == [1, 1, 2, 2]
