@@ -6,3 +6,6 @@ from paddle_tpu.incubate import nn  # noqa: F401
 
 __all__ = ["MoELayer", "asp", "nn"]
 from paddle_tpu.incubate import optimizer  # noqa: F401
+from paddle_tpu.geometric import (  # noqa: F401  (reference incubate.segment_*)
+    segment_max, segment_mean, segment_min, segment_sum,
+)

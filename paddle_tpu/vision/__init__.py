@@ -3,3 +3,25 @@ from paddle_tpu.vision import models  # noqa: F401
 from paddle_tpu.vision import datasets  # noqa: F401
 from paddle_tpu.vision import transforms  # noqa: F401
 from paddle_tpu.vision import ops  # noqa: F401
+
+_image_backend = "numpy"
+
+
+def get_image_backend():
+    """reference vision/image.py: the in-memory image format. This build is
+    codec-free, so arrays are the one backend ('numpy' ~ the cv2 branch)."""
+    return _image_backend
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("numpy", "cv2", "pil"):
+        raise ValueError(f"unsupported backend {backend!r}")
+    _image_backend = backend
+
+
+def image_load(path, backend=None):
+    """Load an image array (.npy in this codec-free environment)."""
+    import numpy as _np
+
+    return _np.load(path)
