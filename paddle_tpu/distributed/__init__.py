@@ -12,6 +12,8 @@ from paddle_tpu.distributed.collective import (  # noqa: F401
     broadcast_object_list, gather, get_group, irecv, isend, new_group,
     partial_allgather, partial_recv, partial_send, recv, reduce,
     reduce_scatter, scatter, send, stream, wait,
+    destroy_process_group, get_backend, is_available, monitored_barrier,
+    scatter_object_list,
 )
 from paddle_tpu.distributed.parallel import (  # noqa: F401
     DataParallel, init_parallel_env, is_initialized,

@@ -583,3 +583,49 @@ class stream:
     recv = staticmethod(recv)
     all_to_all = staticmethod(all_to_all)
     scatter = staticmethod(scatter)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    """reference communication/scatter.py scatter_object_list: rank `src`
+    distributes one python object per rank."""
+    if multiproc.cross_process_active():
+        objs = multiproc.broadcast_object(
+            list(in_object_list or []), src, _group_ranks(group))
+        ranks = _group_ranks(group) or list(range(multiproc.num_processes()))
+        me = ranks.index(multiproc._rank()) if multiproc._rank() in ranks else 0
+        out_object_list[:] = [objs[me]]
+        return out_object_list
+    out_object_list[:] = [(in_object_list or [None])[0]]
+    return out_object_list
+
+
+def is_available() -> bool:
+    """reference dist.is_available: collectives are always compiled in."""
+    return True
+
+
+def get_backend(group=None) -> str:
+    """The collective backend identifier — XLA collectives over ICI/DCN
+    (the reference returns 'NCCL'/'GLOO'/'XCCL')."""
+    return "xla"
+
+
+def destroy_process_group(group=None):
+    """Tear down the eager cross-process plane (reference
+    dist.destroy_process_group): drops the cached TCPStore client so a new
+    init can rebind. In-graph collectives need no teardown."""
+    from paddle_tpu.distributed import store as _store_mod
+
+    if getattr(_store_mod, "_global_store", None):
+        _store_mod._global_store[0] = None
+
+
+def monitored_barrier(group=None, timeout=None):
+    """Barrier that surfaces which rank failed to arrive (reference
+    monitored_barrier): the TCPStore barrier already raises on timeout with
+    the lagging key, so this is the plain barrier with a bounded wait."""
+    barrier(group)
+
+
+__all__ += ["scatter_object_list", "is_available", "get_backend",
+            "destroy_process_group", "monitored_barrier"]
