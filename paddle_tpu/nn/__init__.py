@@ -35,7 +35,10 @@ from paddle_tpu.nn.layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,
 )
-from paddle_tpu.nn.layer.rnn import GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell  # noqa: F401
+from paddle_tpu.nn.layer.rnn import (  # noqa: F401
+    GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell,
+    BiRNN, RNNCellBase,
+)
 
 from paddle_tpu.nn import functional  # noqa: F401
 from paddle_tpu.nn import initializer  # noqa: F401

@@ -181,3 +181,30 @@ class GRU(_RNNBase):
                  time_major=False, dropout=0.0, **kwargs):
         super().__init__("GRU", input_size, hidden_size, num_layers, direction,
                          time_major, dropout)
+
+
+RNNCellBase = _RNNCellBase  # reference public name (nn/layer/rnn.py RNNCellBase)
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (reference nn/layer/rnn.py
+    BiRNN): forward and reverse scans concatenated on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        y_bw, s_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        from paddle_tpu.ops.manipulation import concat
+
+        return concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)
+
+
+__all__ += ["RNNCellBase", "BiRNN"]

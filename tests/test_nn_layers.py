@@ -279,3 +279,22 @@ def test_layer_class_tail():
                                np.ones((2, 5, 5)), rtol=1e-5)
     assert nn.ZeroPad2D([1, 2, 3, 4])(img).shape == [2, 3, 12, 8]
     assert nn.LpPool2D(2.0, 2)(t(np.abs(rs.randn(1, 1, 4, 4)))).shape == [1, 1, 2, 2]
+
+
+def test_birnn_concatenates_directions():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    fw, bw = nn.GRUCell(4, 6), nn.GRUCell(4, 6)
+    rnn = nn.BiRNN(fw, bw)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 5, 4).astype("float32"))
+    y, (s_fw, s_bw) = rnn(x)
+    assert y.shape == [2, 5, 12]
+    # forward half of the output equals a plain forward scan
+    y_fw, _ = nn.RNN(fw)(x)
+    np.testing.assert_allclose(np.asarray(y._value)[..., :6],
+                               np.asarray(y_fw._value), rtol=1e-5)
+    assert isinstance(nn.GRUCell(4, 6), nn.RNNCellBase)
