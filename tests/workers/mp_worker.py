@@ -125,6 +125,14 @@ def main():
     all_losses = multiproc.exchange_objects(losses)
     np.testing.assert_allclose(all_losses[0], all_losses[1], rtol=0, atol=0)
 
+    # collective API tail across real processes: scatter_object_list hands
+    # each rank its own object; backend/availability probes agree
+    out = []
+    dist.scatter_object_list(out, [{"for": 0}, {"for": 1}], src=0)
+    check(out == [{"for": rank}], f"scatter_object_list got {out}")
+    check(dist.is_available() and dist.get_backend() == "xla", "backend probe")
+    dist.monitored_barrier()
+
     dist.barrier()
     print(f"rank {rank} MP_WORKER_OK losses={losses}", flush=True)
 
