@@ -212,3 +212,93 @@ class TripletMarginWithDistanceLoss(Layer):
 __all__ += ["CTCLoss", "HuberLoss", "GaussianNLLLoss", "PoissonNLLLoss",
             "MultiLabelSoftMarginLoss", "SoftMarginLoss",
             "TripletMarginWithDistanceLoss"]
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax (reference nn/layer/loss.py AdaptiveLogSoftmaxWithLoss;
+    Grave et al.): frequent words get full-size logits from the head, rare
+    words route through down-projected tail clusters.
+
+    TPU note: every token computes all clusters (dense compute, masked
+    select) — data-dependent gather/scatter of the reference's CUDA path
+    would break XLA's static shapes, and head+tail are skinny matmuls the
+    MXU does at negligible cost vs the vocabulary savings in memory."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        from paddle_tpu.nn.layer.common import Linear
+
+        cutoffs = list(cutoffs)
+        if (not cutoffs or cutoffs != sorted(cutoffs)
+                or any(c <= 0 or c >= n_classes - 1 for c in cutoffs)
+                or len(set(cutoffs)) != len(cutoffs)):
+            raise ValueError("cutoffs must be unique, ascending, in (0, n_classes-1)")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.cutoffs[0] + self.n_clusters
+        self.head = Linear(in_features, self.head_size, bias_attr=head_bias)
+        self.tail = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = Sequential_(
+                Linear(in_features, hsz, bias_attr=False),
+                Linear(hsz, osz, bias_attr=False),
+            )
+            self.tail.append(proj)
+            self.add_sublayer(f"tail_{i}", proj)
+
+    def _full_log_prob(self, input):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import apply_op
+
+        head_out = self.head(input)
+        tails = [t(input) for t in self.tail]
+
+        def f(h, *ts):
+            import jax
+
+            head_lp = jax.nn.log_softmax(h, axis=-1)
+            shortlist = head_lp[..., : self.cutoffs[0]]
+            parts = [shortlist]
+            for i, tv in enumerate(ts):
+                cluster_lp = jax.nn.log_softmax(tv, axis=-1)
+                gate = head_lp[..., self.cutoffs[0] + i: self.cutoffs[0] + i + 1]
+                parts.append(gate + cluster_lp)
+            return jnp.concatenate(parts, axis=-1)
+
+        return apply_op(f, head_out, *tails, name="adaptive_log_softmax")
+
+    def log_prob(self, input):
+        return self._full_log_prob(input)
+
+    def predict(self, input):
+        lp = self._full_log_prob(input)
+        from paddle_tpu.ops.reduction import argmax
+
+        return argmax(lp, axis=-1)
+
+    def forward(self, input, label):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import apply_op
+
+        lp = self._full_log_prob(input)
+
+        def f(l, y):
+            picked = jnp.take_along_axis(l, y[..., None].astype(jnp.int32),
+                                         axis=-1)[..., 0]
+            return -picked, -picked.mean()
+
+        out, loss = apply_op(f, lp, label, name="adaptive_nll")
+        return out, loss
+
+
+from paddle_tpu.nn.layer.layers import Sequential as Sequential_  # noqa: E402
+
+__all__ += ["AdaptiveLogSoftmaxWithLoss"]

@@ -219,3 +219,45 @@ def test_loss_layer_tail_constructs_and_runs():
               paddle.to_tensor(np.array([6, 6], np.int64)),
               paddle.to_tensor(np.array([2, 2], np.int64)))
     assert np.isfinite(float(out._value))
+
+
+def test_adaptive_log_softmax_matches_torch():
+    """AdaptiveLogSoftmaxWithLoss vs torch with copied weights
+    (reference nn AdaptiveLogSoftmaxWithLoss; Grave et al. clusters)."""
+    import torch
+
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    IN, NC = 16, 20
+    cutoffs = [5, 12]
+    m = nn.AdaptiveLogSoftmaxWithLoss(IN, NC, cutoffs, div_value=2.0)
+    tm = torch.nn.AdaptiveLogSoftmaxWithLoss(IN, NC, cutoffs, div_value=2.0)
+    with torch.no_grad():
+        tm.head.weight.copy_(torch.tensor(np.asarray(m.head.weight._value).T))
+        for i in range(2):
+            ours = getattr(m, f"tail_{i}")
+            tm.tail[i][0].weight.copy_(
+                torch.tensor(np.asarray(ours[0].weight._value).T))
+            tm.tail[i][1].weight.copy_(
+                torch.tensor(np.asarray(ours[1].weight._value).T))
+    rs = RS(0)
+    x = rs.randn(8, IN).astype(np.float32)
+    y = rs.randint(0, NC, 8).astype(np.int64)
+    out, loss = m(paddle.to_tensor(x), paddle.to_tensor(y))
+    t_out, t_loss = tm(torch.tensor(x), torch.tensor(y))
+    np.testing.assert_allclose(np.asarray(loss._value), float(t_loss.detach()),
+                               rtol=1e-4)
+    np.testing.assert_allclose(-np.asarray(out._value),
+                               t_out.detach().numpy(), rtol=1e-4, atol=1e-5)
+    lp = m.log_prob(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(lp._value),
+                               tm.log_prob(torch.tensor(x)).detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    pred = m.predict(paddle.to_tensor(x))
+    np.testing.assert_array_equal(np.asarray(pred._value),
+                                  tm.predict(torch.tensor(x)).numpy())
+    # grads flow to head and tails
+    loss.backward()
+    assert m.head.weight.grad is not None
+    assert m.tail_0[0].weight.grad is not None
