@@ -1433,3 +1433,91 @@ __all__ += [
     "gaussian_nll_loss", "poisson_nll_loss", "multi_label_soft_margin_loss",
     "soft_margin_loss", "triplet_margin_with_distance_loss",
 ]
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean"):
+    """RNN-Transducer loss (reference: warp-transducer-backed
+    nn/functional/loss.py rnnt_loss:1983).
+
+    TPU-native: the transducer forward algorithm as a lax.scan over frames
+    with an inner scan over label positions — pure jax, differentiable,
+    jit/shard-compatible (no warprnnt binary). input: [B, T, U+1, D]
+    log-probs, label: [B, U]. fastemit_lambda applies FastEmit's (1+lambda)
+    label-emission weighting inside the DP (the gradient-scaling form of
+    warp-transducer, folded into the objective)."""
+    import math as _math
+
+    NEG = -1e30
+
+    def f(lp, y, t_len, u_len):
+        b, t_max, u_max1, _ = lp.shape
+        u_max = u_max1 - 1
+        blank_lp = lp[..., blank]                          # [B, T, U+1]
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :u_max, :], y[:, None, :, None].astype(jnp.int32),
+            axis=-1)[..., 0]                              # [B, T, U]
+        if fastemit_lambda:
+            lab_lp = lab_lp + _math.log1p(fastemit_lambda)
+
+        u_idx = jnp.arange(u_max1)
+        u_valid = u_idx[None, :] <= u_len[:, None]        # [B, U+1]
+
+        def u_step(carry, inp):
+            # carry: alpha row being built (prefix over u); inp: (A_u, l_{u-1})
+            prev, = carry
+            a_u, l_prev = inp
+            cur = jnp.logaddexp(a_u, prev + l_prev)
+            return (cur,), cur
+
+        def t_step(alpha_prev, t):
+            # alpha_prev: [B, U+1] for frame t-1 -> alpha for frame t
+            A = alpha_prev + blank_lp[:, t - 1, :]        # horizontal (blank) moves
+            lab_t = lab_lp[:, t, :]                       # vertical moves in frame t
+
+            def row(a_b, lab_b):
+                first = a_b[0]
+                (_, ), rest = jax.lax.scan(
+                    u_step, (first,), (a_b[1:], lab_b))
+                return jnp.concatenate([first[None], rest])
+
+            alpha = jax.vmap(row)(A, lab_t)
+            return jnp.where(u_valid, alpha, NEG), None
+
+        # frame 0: only vertical moves from alpha[0,0]=0
+        def row0(lab_b):
+            init = jnp.zeros(())
+            (_, ), rest = jax.lax.scan(
+                u_step, (init,), (jnp.full((u_max,), NEG), lab_b))
+            return jnp.concatenate([init[None], rest])
+
+        alpha0 = jnp.where(u_valid, jax.vmap(row0)(lab_lp[:, 0, :]), NEG)
+
+        def fori_body(t, alpha_all):
+            alpha, final = alpha_all
+            new_alpha, _ = t_step(alpha, t)
+            active = (t < t_len)[:, None]
+            alpha = jnp.where(active, new_alpha, alpha)
+            # when t == t_len-1 this frame is the last: record terminal value
+            at_end = (t == t_len - 1)
+            term = jnp.take_along_axis(
+                alpha + blank_lp[:, jnp.minimum(t, t_max - 1), :],
+                u_len[:, None].astype(jnp.int32), axis=1)[:, 0]
+            final = jnp.where(at_end, term, final)
+            return (alpha, final)
+
+        final0 = jnp.take_along_axis(
+            alpha0 + blank_lp[:, 0, :], u_len[:, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        final0 = jnp.where(t_len == 1, final0, NEG)
+        alpha, final = jax.lax.fori_loop(1, t_max, fori_body, (alpha0, final0))
+        per_seq = -final
+        if reduction == "mean":
+            per_seq = per_seq / jnp.maximum(u_len.astype(per_seq.dtype), 1)
+        return _reduce(per_seq, reduction)
+
+    return apply_op(f, _t(input), _t(label), _t(input_lengths),
+                    _t(label_lengths), name="rnnt_loss")
+
+
+__all__ += ["rnnt_loss"]

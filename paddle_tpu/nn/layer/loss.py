@@ -302,3 +302,23 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
 from paddle_tpu.nn.layer.layers import Sequential as Sequential_  # noqa: E402
 
 __all__ += ["AdaptiveLogSoftmaxWithLoss"]
+
+
+class RNNTLoss(Layer):
+    """reference nn RNNTLoss over F.rnnt_loss (warp-transducer analog)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)
+
+
+__all__ += ["RNNTLoss"]
