@@ -1521,3 +1521,174 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
 
 
 __all__ += ["rnnt_loss"]
+
+
+# ---------------------------------------------------------------------------
+# functional tail 2: 3-D pools, pads, metric-learning losses, edit distance
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, "max", -np.inf,
+                 data_format, ceil_mode=ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", 0.0, data_format,
+                 count_include_pad=not exclusive or padding == 0)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    os3 = ((output_size,) * 3 if isinstance(output_size, int)
+           else tuple(output_size))
+    x = _t(x)
+    d, h, w = x._value.shape[2:5]
+    if d % os3[0] == 0 and h % os3[1] == 0 and w % os3[2] == 0:
+        k = (d // os3[0], h // os3[1], w // os3[2])
+        return _pool(x, k, k, 0, 3, "avg", 0.0, data_format)
+    mats = [_adaptive_bin_matrix(s, o) for s, o in zip((d, h, w), os3)]
+
+    def f(v):
+        return jnp.einsum("ncdhw,od,ph,qw->ncopq", v, *mats,
+                          preferred_element_type=v.dtype)
+
+    return apply_op(f, x, name="adaptive_avg_pool3d")
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    p = padding if not isinstance(padding, int) else [padding] * 4
+
+    def f(v):
+        return jnp.pad(v, ((0, 0), (0, 0), (p[2], p[3]), (p[0], p[1])))
+
+    return apply_op(f, _t(x), name="zeropad2d")
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    p = paddings if not isinstance(paddings, int) else [paddings] * 6
+
+    def f(v):
+        pads = ((0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]))
+        if mode == "constant":
+            return jnp.pad(v, pads, constant_values=value)
+        m = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+        return jnp.pad(v, pads, mode=m)
+
+    return apply_op(f, _t(x), name="pad3d")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference loss.py npair_loss: cross-entropy over anchor-positive
+    similarities + L2 on the embeddings."""
+
+    def f(a, p, y):
+        sim = a @ p.T  # [B, B]
+        same = (y[:, None] == y[None, :]).astype(sim.dtype)
+        tgt = same / same.sum(-1, keepdims=True)
+        xent = (-tgt * jax.nn.log_softmax(sim, axis=-1)).sum(-1).mean()
+        reg = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / a.shape[0] * 0.25
+        return xent + reg
+
+    return apply_op(f, _t(anchor), _t(positive), _t(labels), name="npair_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """reference loss.py dice_loss: 1 - 2|X∩Y| / (|X|+|Y|) over the
+    one-hot label (input: [..., C] probabilities, label: [..., 1] ids)."""
+
+    def f(x, y):
+        oh = jax.nn.one_hot(y[..., 0].astype(jnp.int32), x.shape[-1],
+                            dtype=x.dtype)
+        reduce_dims = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * oh, axis=reduce_dims)
+        union = jnp.sum(x, axis=reduce_dims) + jnp.sum(oh, axis=reduce_dims)
+        return jnp.mean(1.0 - 2.0 * inter / (union + epsilon))
+
+    return apply_op(f, _t(input), _t(label), name="dice_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax (reference loss.py margin_cross_entropy:
+    cos(m1*theta + m2) - m3 on the target logit, then scaled CE)."""
+
+    def f(lg, y):
+        yi = y.astype(jnp.int32).reshape(-1)
+        oh = jax.nn.one_hot(yi, lg.shape[-1], dtype=lg.dtype)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = jnp.where(oh > 0, target, cos) * scale
+        lsm = jax.nn.log_softmax(adj, axis=-1)
+        loss = -(oh * lsm).sum(-1)
+        if reduction == "none":
+            out_loss = loss
+        elif reduction == "sum":
+            out_loss = loss.sum()
+        else:
+            out_loss = loss.mean()
+        if return_softmax:
+            return out_loss, jnp.exp(lsm)
+        return out_loss
+
+    return apply_op(f, _t(logits), _t(label), name="margin_cross_entropy")
+
+
+def embedding_bag(input, weight, mode="mean", padding_idx=None, name=None):
+    """Sum/mean/max over each row's embedded ids (reference embedding_bag)."""
+
+    def f(ids, w):
+        emb = jnp.take(w, ids.astype(jnp.int32), axis=0)  # [B, L, D]
+        if padding_idx is not None:
+            mask = (ids != padding_idx)[..., None].astype(emb.dtype)
+            emb = emb * mask
+            denom = jnp.maximum(mask.sum(axis=-2), 1.0)
+        else:
+            denom = jnp.asarray(ids.shape[-1], emb.dtype)
+        if mode == "sum":
+            return emb.sum(axis=-2)
+        if mode == "max":
+            return emb.max(axis=-2)
+        return emb.sum(axis=-2) / denom
+
+    return apply_op(f, _t(input), _t(weight), name="embedding_bag")
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None):
+    """Levenshtein distance per sequence pair (reference edit_distance op;
+    host DP — dynamic-length string metric, not a device op)."""
+    a_np = np.asarray(_t(input)._value)
+    b_np = np.asarray(_t(label)._value)
+
+    def lev(a, b):
+        if ignored_tokens:
+            a = [x for x in a if x not in ignored_tokens]
+            b = [x for x in b if x not in ignored_tokens]
+        m, n = len(a), len(b)
+        dp = list(range(n + 1))
+        for i in range(1, m + 1):
+            prev = dp[0]
+            dp[0] = i
+            for j in range(1, n + 1):
+                cur = dp[j]
+                dp[j] = min(dp[j] + 1, dp[j - 1] + 1,
+                            prev + (a[i - 1] != b[j - 1]))
+                prev = cur
+        return dp[n], n
+
+    out, counts = [], []
+    for a, b in zip(np.atleast_2d(a_np), np.atleast_2d(b_np)):
+        d, n = lev(list(a), list(b))
+        out.append(d / max(n, 1) if normalized else d)
+        counts.append(1)
+    return (Tensor(jnp.asarray(np.asarray(out, np.float32)[:, None])),
+            Tensor(jnp.asarray(np.asarray(counts, np.int64))))
+
+
+__all__ += [
+    "max_pool3d", "avg_pool3d", "adaptive_avg_pool3d", "zeropad2d", "pad3d",
+    "npair_loss", "dice_loss", "margin_cross_entropy", "embedding_bag",
+    "edit_distance",
+]

@@ -320,3 +320,45 @@ def test_rnnt_loss_matches_exact_enumeration():
         x, paddle.to_tensor(y), paddle.to_tensor(np.array([T], np.int64)),
         paddle.to_tensor(np.array([U], np.int64))).backward()
     assert x.grad is not None
+
+
+def test_functional_tail2():
+    """3-D pools/pads, dice/npair/margin CE, embedding_bag, edit_distance."""
+    rs = RS(0)
+    v = rs.randn(1, 2, 4, 4, 4).astype(np.float32)
+    assert F.max_pool3d(t(v), 2).shape == [1, 2, 2, 2, 2]
+    np.testing.assert_allclose(
+        np.asarray(F.adaptive_avg_pool3d(t(v), 2)._value),
+        v.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7)),
+        rtol=1e-4, atol=1e-5)
+    assert F.adaptive_avg_pool3d(t(v), 3).shape == [1, 2, 3, 3, 3]
+    assert F.zeropad2d(t(rs.randn(1, 1, 3, 3)), [1, 2, 3, 4]).shape == [1, 1, 10, 6]
+    assert F.pad3d(t(v), [1, 1, 1, 1, 1, 1]).shape == [1, 2, 6, 6, 6]
+
+    probs = np.zeros((2, 4, 3), np.float32)
+    lab = rs.randint(0, 3, (2, 4, 1)).astype(np.int64)
+    for b in range(2):
+        for i in range(4):
+            probs[b, i, lab[b, i, 0]] = 1.0
+    assert float(F.dice_loss(t(probs), paddle.to_tensor(lab))._value) < 1e-3
+
+    lg = np.clip(rs.randn(4, 6), -1, 1).astype(np.float32)
+    y = rs.randint(0, 6, 4).astype(np.int64)
+    mce = F.margin_cross_entropy(t(lg), paddle.to_tensor(y), margin1=1.0,
+                                 margin2=0.0, margin3=0.0, scale=1.0)
+    ce = F.cross_entropy(t(lg), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(mce._value), float(ce._value), rtol=1e-4)
+
+    w = rs.randn(10, 4).astype(np.float32)
+    eb = F.embedding_bag(paddle.to_tensor(np.array([[1, 2], [3, 3]], np.int64)),
+                         t(w), mode="mean")
+    np.testing.assert_allclose(np.asarray(eb._value)[0], (w[1] + w[2]) / 2,
+                               rtol=1e-5)
+
+    d, cnt = F.edit_distance(paddle.to_tensor(np.array([[1, 2, 3]], np.int64)),
+                             paddle.to_tensor(np.array([[1, 3, 3]], np.int64)),
+                             normalized=False)
+    assert float(d._value[0, 0]) == 1.0
+    dn, _ = F.edit_distance(paddle.to_tensor(np.array([[1, 2, 3]], np.int64)),
+                            paddle.to_tensor(np.array([[4, 5, 6]], np.int64)))
+    np.testing.assert_allclose(float(dn._value[0, 0]), 1.0)
