@@ -15,8 +15,9 @@ from paddle_tpu.nn.layer.norm import (  # noqa: F401
     LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm,
 )
 from paddle_tpu.nn.layer.pooling import (  # noqa: F401
-    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D,
-    AvgPool2D, MaxPool1D, MaxPool2D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
+    MaxPool3D,
 )
 from paddle_tpu.nn.layer.activation import (  # noqa: F401
     CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
