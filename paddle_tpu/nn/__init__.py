@@ -9,10 +9,13 @@ from paddle_tpu.nn.layer.common import (  # noqa: F401
     Softmax2D, Unflatten, Unfold, Upsample, UpsamplingBilinear2D,
     UpsamplingNearest2D, ZeroPad2D,
 )
-from paddle_tpu.nn.layer.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D  # noqa: F401
+from paddle_tpu.nn.layer.conv import (  # noqa: F401
+    Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
+)
 from paddle_tpu.nn.layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm2D,
     LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm,
+    InstanceNorm1D, InstanceNorm3D, SpectralNorm,
 )
 from paddle_tpu.nn.layer.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,

@@ -1692,3 +1692,58 @@ __all__ += [
     "npair_loss", "dice_loss", "margin_cross_entropy", "embedding_bag",
     "edit_distance",
 ]
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd, name):
+    """Shared N-d transpose conv: lhs-dilated conv with flipped IO kernel
+    (the XLA-native formulation — no col2im scatter)."""
+    strides = _pair(stride, nd)
+    pads = _pair(padding, nd)
+    dils = _pair(dilation, nd)
+    opad = _pair(output_padding, nd)
+    spatial = "DHW"[3 - nd:]
+    io = ("NC" + spatial, "IO" + spatial, "NC" + spatial)
+    xv = x._value if isinstance(x, Tensor) else x
+    wv_shape = (weight._value.shape if isinstance(weight, Tensor)
+                else weight.shape)
+    dn = jax.lax.conv_dimension_numbers(xv.shape, wv_shape, io)
+    pad_cfg = [
+        (dils[i] * (wv_shape[2 + i] - 1) - pads[i],
+         dils[i] * (wv_shape[2 + i] - 1) - pads[i] + opad[i])
+        for i in range(nd)
+    ]
+    spatial_axes = tuple(range(2, 2 + nd))
+
+    def f(v, w, *maybe_b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=(1,) * nd, padding=pad_cfg,
+            lhs_dilation=strides, rhs_dilation=dils, dimension_numbers=dn,
+            feature_group_count=groups)
+        if maybe_b:
+            out = out + maybe_b[0].reshape((1, -1) + (1,) * nd)
+        return out
+
+    w = _t(weight)
+    flip_w = apply_op(lambda u: jnp.flip(u, axis=spatial_axes), w, name="flip")
+    args = (_t(x), flip_w) if bias is None else (_t(x), flip_w, _t(bias))
+    return apply_op(f, *args, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, output_size=None,
+                     data_format="NCL"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 1,
+                              "conv1d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, output_size=None,
+                     data_format="NCDHW"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 3,
+                              "conv3d_transpose")
+
+
+__all__ += ["conv1d_transpose", "conv3d_transpose"]

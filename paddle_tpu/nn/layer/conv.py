@@ -92,3 +92,46 @@ class Conv2DTranspose(Layer):
         stride, padding, output_padding, dilation, groups = self._args
         return F.conv2d_transpose(x, self.weight, self.bias, stride, padding,
                                   output_padding, dilation, groups, output_size)
+
+
+class Conv1DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        ks = _pair(kernel_size, 1)
+        self._args = (stride, padding, output_padding, dilation, groups)
+        self.weight = self.create_parameter(
+            shape=[in_channels, out_channels // groups, *ks], attr=weight_attr,
+            default_initializer=I.XavierUniform(),
+        )
+        self.bias = self.create_parameter(shape=[out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, output_size=None):
+        stride, padding, output_padding, dilation, groups = self._args
+        return F.conv1d_transpose(x, self.weight, self.bias, stride, padding,
+                                  output_padding, dilation, groups, output_size)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        ks = _pair(kernel_size, 3)
+        self._args = (stride, padding, output_padding, dilation, groups)
+        self.weight = self.create_parameter(
+            shape=[in_channels, out_channels // groups, *ks], attr=weight_attr,
+            default_initializer=I.XavierUniform(),
+        )
+        self.bias = self.create_parameter(shape=[out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, output_size=None):
+        stride, padding, output_padding, dilation, groups = self._args
+        return F.conv3d_transpose(x, self.weight, self.bias, stride, padding,
+                                  output_padding, dilation, groups, output_size)
+
+
+__all__ += ["Conv1DTranspose", "Conv3DTranspose"]

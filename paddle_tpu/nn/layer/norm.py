@@ -163,3 +163,59 @@ class LocalResponseNorm(Layer):
 
     def forward(self, x):
         return F.local_response_norm(x, *self.args)
+
+
+class InstanceNorm1D(InstanceNorm2D):
+    """NCL instance norm (F.instance_norm is rank-agnostic)."""
+
+
+class InstanceNorm3D(InstanceNorm2D):
+    """NCDHW instance norm."""
+
+
+class SpectralNorm(Layer):
+    """reference nn/layer/norm.py SpectralNorm: forward(weight) returns the
+    spectrally-normalized weight via persistent power-iteration vectors."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None):
+        super().__init__()
+        import numpy as _np
+
+        self._dim = dim
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        rs = _np.random.RandomState(0)
+        self.register_buffer("weight_u", jnp.asarray(
+            rs.randn(h).astype(_np.float32)))
+        self.register_buffer("weight_v", jnp.asarray(
+            rs.randn(w).astype(_np.float32)))
+
+    def forward(self, weight):
+        from paddle_tpu.core.tensor import apply_op
+
+        dim, iters, eps = self._dim, self._power_iters, self._epsilon
+
+        def f(wv, u, v):
+            m = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+            for _ in range(iters):
+                v = m.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = m @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ m @ v
+            return wv / sigma, u, v
+
+        out, u_new, v_new = apply_op(f, weight, self.weight_u, self.weight_v,
+                                     name="spectral_norm")
+        self.weight_u._set_value(u_new.detach()._value)
+        self.weight_v._set_value(v_new.detach()._value)
+        return out
+
+
+__all__ += ["InstanceNorm1D", "InstanceNorm3D", "SpectralNorm"]

@@ -298,3 +298,46 @@ def test_birnn_concatenates_directions():
     np.testing.assert_allclose(np.asarray(y._value)[..., :6],
                                np.asarray(y_fw._value), rtol=1e-5)
     assert isinstance(nn.GRUCell(4, 6), nn.RNNCellBase)
+
+
+def test_conv_transpose_1d_3d_and_norm_tail():
+    """Conv1D/3DTranspose vs torch (lhs-dilated flipped-kernel form),
+    InstanceNorm1D/3D, SpectralNorm layer (reference nn/layer/conv.py,
+    norm.py)."""
+    import numpy as np
+    import torch
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 10).astype(np.float32)
+    w = rs.randn(3, 4, 5).astype(np.float32)
+    ours = F.conv1d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                              stride=2, padding=1)
+    ref = torch.conv_transpose1d(torch.tensor(x), torch.tensor(w), stride=2,
+                                 padding=1)
+    np.testing.assert_allclose(np.asarray(ours._value), ref.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    x3 = rs.randn(1, 2, 4, 4, 4).astype(np.float32)
+    w3 = rs.randn(2, 3, 3, 3, 3).astype(np.float32)
+    ours3 = F.conv3d_transpose(paddle.to_tensor(x3), paddle.to_tensor(w3),
+                               stride=2)
+    ref3 = torch.conv_transpose3d(torch.tensor(x3), torch.tensor(w3), stride=2)
+    np.testing.assert_allclose(np.asarray(ours3._value), ref3.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    assert nn.Conv1DTranspose(3, 4, 5, stride=2, padding=1)(
+        paddle.to_tensor(x)).shape == list(ref.shape)
+    assert nn.Conv3DTranspose(2, 3, 3, stride=2)(
+        paddle.to_tensor(x3)).shape == list(ref3.shape)
+    assert nn.InstanceNorm1D(3)(paddle.to_tensor(x)).shape == [2, 3, 10]
+    assert nn.InstanceNorm3D(2)(paddle.to_tensor(x3)).shape == [1, 2, 4, 4, 4]
+    sn = nn.SpectralNorm([6, 6], power_iters=10)
+    wmat = paddle.to_tensor((rs.randn(6, 6) * 5).astype(np.float32))
+    wn = sn(wmat)
+    for _ in range(3):
+        wn = sn(wmat)
+    sigma = np.linalg.svd(np.asarray(wn._value), compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.05
