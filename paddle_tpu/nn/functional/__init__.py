@@ -310,58 +310,80 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data
     return _conv_nd(_t(x), _t(weight), bias, stride, padding, dilation, groups, 3, data_format)
 
 
+def _group_transpose_kernel(w, groups, nd):
+    """Paddle transpose-conv kernel (Cin, Cout/g, k...) -> XLA grouped 'IO'
+    layout (Cin/g, Cout, k...): split Cin into g groups, fold the group axis
+    into the output-feature dim (group-major, matching XLA's grouped-conv
+    output partitioning). Identity reshape for groups == 1."""
+    if groups == 1:
+        return w
+    cin, coutg = w.shape[0], w.shape[1]
+    spatial = w.shape[2:]
+    w = w.reshape((groups, cin // groups, coutg) + spatial)
+    w = jnp.moveaxis(w, 0, 1)  # (Cin/g, g, Cout/g, k...)
+    return w.reshape((cin // groups, groups * coutg) + spatial)
+
+
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      dilation=1, groups=1, output_size=None, data_format="NCHW"):
-    strides = _pair(stride)
-    pads = _pair(padding)
-    dils = _pair(dilation)
-    dn = jax.lax.conv_dimension_numbers(x._value.shape if isinstance(x, Tensor) else x.shape,
-                                        weight._value.shape if isinstance(weight, Tensor) else weight.shape,
-                                        ("NCHW", "IOHW", "NCHW"))
-    opad = _pair(output_padding)
-    pad_cfg = [
-        (dils[i] * (  # transpose conv padding transform
-            (weight._value.shape[2 + i] - 1)) - pads[i],
-         dils[i] * ((weight._value.shape[2 + i] - 1)) - pads[i] + opad[i])
-        for i in range(2)
-    ]
-
-    def f(v, w, *maybe_b):
-        out = jax.lax.conv_general_dilated(
-            v, w, window_strides=(1, 1), padding=pad_cfg, lhs_dilation=strides,
-            rhs_dilation=dils, dimension_numbers=dn, feature_group_count=groups,
-        )
-        # IOHW kernel: flip spatial dims for true transpose semantics
-        if maybe_b:
-            out = out + maybe_b[0].reshape(1, -1, 1, 1)
-        return out
-
-    w = _t(weight)
-    wv = jnp.flip(w._value, axis=(2, 3))
-    wt = Tensor(wv, stop_gradient=w.stop_gradient)
-    wt._grad_node = None
-    # keep autograd: express flip as an op on the original weight
-    flip_w = apply_op(lambda u: jnp.flip(u, axis=(2, 3)), w, name="flip")
-    args = (_t(x), flip_w) if bias is None else (_t(x), flip_w, _t(bias))
-    return apply_op(f, *args, name="conv2d_transpose")
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 2,
+                              "conv2d_transpose")
 
 
 def _pool(x, kernel, stride, padding, nd, reducer, init, data_format, count_include_pad=True, ceil_mode=False):
     ks = _pair(kernel, nd)
     st = _pair(stride if stride is not None else kernel, nd)
     pd = _pair(padding, nd)
-    window = (1, 1) + ks
-    strides = (1, 1) + st
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    channels_last = data_format in ("NHWC", "NDHWC", "NLC")
+    xv = x._value if isinstance(x, Tensor) else x
+    sp = tuple(xv.shape[1:1 + nd] if channels_last else xv.shape[2:2 + nd])
+    if ceil_mode:
+        osp = [-(-(sp[d] + 2 * pd[d] - ks[d]) // st[d]) + 1 for d in range(nd)]
+        # torch/paddle rule: the last window must start inside input+left-pad
+        osp = [o - 1 if (o - 1) * st[d] >= sp[d] + pd[d] else o
+               for d, o in enumerate(osp)]
+    else:
+        osp = [(sp[d] + 2 * pd[d] - ks[d]) // st[d] + 1 for d in range(nd)]
+    # right padding so exactly osp windows exist; the part beyond the declared
+    # pd is ceil-mode overhang (never counted in avg divisors)
+    rp = [max((osp[d] - 1) * st[d] + ks[d] - sp[d] - pd[d], 0)
+          for d in range(nd)]
+    sp_pads = tuple((pd[d], rp[d]) for d in range(nd))
+    if channels_last:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = ((0, 0),) + sp_pads + ((0, 0),)
+        slicer = ((slice(None),) + tuple(slice(0, o) for o in osp)
+                  + (slice(None),))
+        base_pads = ((0, 0),) + tuple((pd[d], pd[d]) for d in range(nd)) + ((0, 0),)
+        extra_pads = (((0, 0),) + tuple((0, max(rp[d] - pd[d], 0)) for d in range(nd))
+                      + ((0, 0),))
+    else:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = ((0, 0), (0, 0)) + sp_pads
+        slicer = ((slice(None), slice(None))
+                  + tuple(slice(0, o) for o in osp))
+        base_pads = ((0, 0), (0, 0)) + tuple((pd[d], pd[d]) for d in range(nd))
+        extra_pads = ((0, 0), (0, 0)) + tuple((0, max(rp[d] - pd[d], 0))
+                                              for d in range(nd))
 
     def f(v):
         if reducer == "max":
-            return jax.lax.reduce_window(v, -jnp.inf, jax.lax.max, window, strides, pads)
-        s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, pads)
-        if count_include_pad:
+            return jax.lax.reduce_window(
+                v, -jnp.inf, jax.lax.max, window, strides, pads)[slicer]
+        s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, pads)[slicer]
+        if count_include_pad and not ceil_mode:
             return s / float(np.prod(ks))
-        ones = jnp.ones_like(v)
-        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        if count_include_pad:
+            # divisor counts the declared zero-padding but not ceil overhang
+            ones = jnp.pad(jnp.ones_like(v), base_pads, constant_values=1.0)
+            cnt = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, window, strides, extra_pads)[slicer]
+        else:
+            cnt = jax.lax.reduce_window(
+                jnp.ones_like(v), 0.0, jax.lax.add, window, strides, pads)[slicer]
         return s / cnt
 
     return apply_op(f, _t(x), name=f"{reducer}_pool{nd}d")
@@ -369,21 +391,34 @@ def _pool(x, kernel, stride, padding, nd, reducer, init, data_format, count_incl
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW"):
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError(
+                "return_mask=True requires data_format='NCHW' (reference "
+                "paddle.nn.functional.max_pool2d contract)")
+        return _max_pool_with_index_nd(x, kernel_size, stride, padding, 2,
+                                       ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, "max", -np.inf, data_format, ceil_mode=ceil_mode)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False):
+    if return_mask:
+        return _max_pool_with_index_nd(x, kernel_size, stride, padding, 1,
+                                       ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 1, "max", -np.inf, "NCL", ceil_mode=ceil_mode)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW"):
     return _pool(x, kernel_size, stride, padding, 2, "avg", 0.0, data_format,
-                 count_include_pad=not exclusive or padding == 0)
+                 count_include_pad=not exclusive or padding == 0,
+                 ceil_mode=ceil_mode)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False):
-    return _pool(x, kernel_size, stride, padding, 1, "avg", 0.0, "NCL")
+    return _pool(x, kernel_size, stride, padding, 1, "avg", 0.0, "NCL",
+                 count_include_pad=not exclusive or padding == 0,
+                 ceil_mode=ceil_mode)
 
 
 def _adaptive_bin_matrix(in_size: int, out_size: int):
@@ -404,7 +439,9 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
         h, w = x._value.shape[2], x._value.shape[3]
     else:
         h, w = x._value.shape[1], x._value.shape[2]
-    if h % os[0] == 0 and w % os[1] == 0:
+    # _pool assumes NC-leading windows, so the divisible fast path is
+    # NCHW-only; NHWC always takes the einsum path
+    if data_format == "NCHW" and h % os[0] == 0 and w % os[1] == 0:
         return _pool(x, (h // os[0], w // os[1]), (h // os[0], w // os[1]), 0, 2, "avg", 0.0, data_format)
     # non-divisible bins: contract with per-axis averaging matrices — two
     # skinny MXU matmuls instead of 16 gather/slice reductions
@@ -440,7 +477,10 @@ def adaptive_max_pool2d(x, output_size, return_mask=False):
     x = _t(x)
     h, w = x._value.shape[2], x._value.shape[3]
     if h % os[0] == 0 and w % os[1] == 0:
-        return _pool(x, (h // os[0], w // os[1]), (h // os[0], w // os[1]), 0, 2, "max", -np.inf, "NCHW")
+        k = (h // os[0], w // os[1])
+        if return_mask:
+            return _max_pool_with_index_nd(x, k, k, 0, 2)
+        return _pool(x, k, k, 0, 2, "max", -np.inf, "NCHW")
 
     def bins(size, out):
         return [((i * size) // out, -(-((i + 1) * size) // out)) for i in range(out)]
@@ -453,7 +493,25 @@ def adaptive_max_pool2d(x, output_size, return_mask=False):
                 for (hl, hh) in hb]
         return jnp.stack(rows, axis=-2)
 
-    return apply_op(f, x, name="adaptive_max_pool2d")
+    def f_mask(v):
+        outs, idxs = [], []
+        for (hl, hh) in hb:
+            row_o, row_i = [], []
+            for (wl, wh) in wb:
+                patch = v[:, :, hl:hh, wl:wh]
+                bw = wh - wl
+                flatp = patch.reshape(patch.shape[0], patch.shape[1], -1)
+                am = jnp.argmax(flatp, axis=-1)
+                row_o.append(jnp.max(flatp, axis=-1))
+                # local bin argmax -> global flat h*w index (unpool contract)
+                row_i.append((hl + am // bw) * w + (wl + am % bw))
+            outs.append(jnp.stack(row_o, axis=-1))
+            idxs.append(jnp.stack(row_i, axis=-1))
+        return (jnp.stack(outs, axis=-2),
+                jnp.stack(idxs, axis=-2).astype(jnp.int32))
+
+    return apply_op(f_mask if return_mask else f, x,
+                    name="adaptive_max_pool2d")
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
@@ -968,7 +1026,11 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         per_seq = optax.ctc_loss(logits, logit_pad, lab.astype(jnp.int32),
                                  label_pad, blank_id=blank)
         if norm_by_times:
-            per_seq = per_seq / jnp.maximum(in_len.astype(per_seq.dtype), 1)
+            # reference warpctc semantics: scale only the GRADIENT by 1/T;
+            # the reported loss value is unchanged. value = per_seq,
+            # d(out)/d(logits) = d(per_seq)/d(logits) / T.
+            t_inv = per_seq / jnp.maximum(in_len.astype(per_seq.dtype), 1)
+            per_seq = t_inv + jax.lax.stop_gradient(per_seq - t_inv)
         if reduction == "mean":
             # paddle/torch 'mean': divide by label length, then batch-mean
             per_seq = per_seq / jnp.maximum(lab_len.astype(per_seq.dtype), 1)
@@ -1182,35 +1244,69 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     return apply_op(f, _t(x), name="lp_pool2d")
 
 
+def _max_pool_with_index_nd(x, kernel_size, stride, padding, nd,
+                            ceil_mode=False):
+    """N-d max pool returning (out, flat-spatial argmax indices) — the
+    machinery behind max_pool2d_with_index and every return_mask=True pool
+    (reference ops.yaml max_pool2d_with_index; feeds max_unpool*d).
+    Indices are exact int32 arithmetic (window start + in-window offset),
+    not a float gather — no 2^24 precision cliff on large volumes."""
+    ks = _pair(kernel_size, nd)
+    st = _pair(stride if stride is not None else kernel_size, nd)
+    pd = _pair(padding, nd)
+
+    def f(v):
+        n, c = v.shape[0], v.shape[1]
+        sp = v.shape[2:]
+        if ceil_mode:
+            osp_t = [-(-(sp[d] + 2 * pd[d] - ks[d]) // st[d]) + 1
+                     for d in range(nd)]
+            # torch/paddle: the last window must start inside input+left-pad
+            osp_t = [o - 1 if (o - 1) * st[d] >= sp[d] + pd[d] else o
+                     for d, o in enumerate(osp_t)]
+        else:
+            osp_t = [(sp[d] + 2 * pd[d] - ks[d]) // st[d] + 1
+                     for d in range(nd)]
+        # right-pad enough that every ceil-mode window exists; finite
+        # dtype-min padding (NOT -inf: the patches extraction is a one-hot
+        # conv and -inf * 0 = NaN) never wins an argmax — windows always
+        # overlap valid input
+        padw = ((0, 0), (0, 0)) + tuple(
+            (pd[d], max((osp_t[d] - 1) * st[d] + ks[d] - sp[d] - pd[d], 0))
+            for d in range(nd))
+        vpad = jnp.pad(v, padw, constant_values=jnp.finfo(v.dtype).min)
+        patches = jax.lax.conv_general_dilated_patches(
+            vpad, ks, st, "VALID")  # (N, C*prod(ks), *osp) channel-major
+        patches = patches[(slice(None), slice(None))
+                          + tuple(slice(0, o) for o in osp_t)]
+        osp = patches.shape[2:]
+        kprod = int(np.prod(ks))
+        pr = patches.reshape((n, c, kprod) + osp)
+        am = jnp.argmax(pr, axis=2)
+        out = jnp.take_along_axis(pr, am[:, :, None], axis=2)[:, :, 0]
+        # decompose the in-window argmax (row-major over ks) and add the
+        # window start to get exact global per-dim coords -> flat index
+        rem = am.astype(jnp.int32)
+        flat = jnp.zeros(am.shape, jnp.int32)
+        for d in range(nd):
+            k_rest = int(np.prod(ks[d + 1:], dtype=np.int64))
+            off_d = rem // k_rest
+            rem = rem % k_rest
+            bshape = [1, 1] + [1] * nd
+            bshape[2 + d] = osp[d]
+            start_d = (jnp.arange(osp[d], dtype=jnp.int32) * st[d]
+                       - pd[d]).reshape(bshape)
+            flat = flat * sp[d] + (off_d + start_d)
+        return out, flat
+
+    return apply_op(f, _t(x), name=f"max_pool{nd}d_with_index")
+
+
 def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
                           ceil_mode=False):
     """Max pool returning flat (h*w) argmax indices per output cell
     (reference ops.yaml max_pool2d_with_index; feeds max_unpool2d)."""
-    ks = _pair(kernel_size, 2)
-    st = _pair(stride if stride is not None else kernel_size, 2)
-    pd = _pair(padding, 2)
-
-    def f(v):
-        n, c, h, w = v.shape
-        vpad = jnp.pad(v, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
-                       constant_values=-jnp.inf)
-        idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
-        ipad = jnp.pad(idx, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
-                       constant_values=-1.0)
-        patches = jax.lax.conv_general_dilated_patches(
-            vpad, ks, st, "VALID")  # (N, C*kh*kw, OH, OW)
-        ipatches = jax.lax.conv_general_dilated_patches(ipad, ks, st, "VALID")
-        oh, ow = patches.shape[-2:]
-        pr = patches.reshape(n, c, ks[0] * ks[1], oh, ow)
-        ir = ipatches.reshape(1, 1, ks[0] * ks[1], oh, ow)
-        am = jnp.argmax(pr, axis=2)
-        out = jnp.take_along_axis(pr, am[:, :, None], axis=2)[:, :, 0]
-        mask = jnp.take_along_axis(
-            jnp.broadcast_to(ir, (n, c) + ir.shape[2:]), am[:, :, None],
-            axis=2)[:, :, 0]
-        return out, mask.astype(jnp.int32)
-
-    return apply_op(f, _t(x), name="max_pool2d_with_index")
+    return _max_pool_with_index_nd(x, kernel_size, stride, padding, 2)
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
@@ -1528,6 +1624,13 @@ __all__ += ["rnnt_loss"]
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW"):
+    if return_mask:
+        if data_format != "NCDHW":
+            raise ValueError(
+                "return_mask=True requires data_format='NCDHW' (reference "
+                "paddle.nn.functional.max_pool3d contract)")
+        return _max_pool_with_index_nd(x, kernel_size, stride, padding, 3,
+                                       ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 3, "max", -np.inf,
                  data_format, ceil_mode=ceil_mode)
 
@@ -1535,21 +1638,29 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW"):
     return _pool(x, kernel_size, stride, padding, 3, "avg", 0.0, data_format,
-                 count_include_pad=not exclusive or padding == 0)
+                 count_include_pad=not exclusive or padding == 0,
+                 ceil_mode=ceil_mode)
 
 
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
     os3 = ((output_size,) * 3 if isinstance(output_size, int)
            else tuple(output_size))
     x = _t(x)
-    d, h, w = x._value.shape[2:5]
-    if d % os3[0] == 0 and h % os3[1] == 0 and w % os3[2] == 0:
+    if data_format == "NCDHW":
+        d, h, w = x._value.shape[2:5]
+    else:  # NDHWC
+        d, h, w = x._value.shape[1:4]
+    if (data_format == "NCDHW" and d % os3[0] == 0 and h % os3[1] == 0
+            and w % os3[2] == 0):
         k = (d // os3[0], h // os3[1], w // os3[2])
         return _pool(x, k, k, 0, 3, "avg", 0.0, data_format)
     mats = [_adaptive_bin_matrix(s, o) for s, o in zip((d, h, w), os3)]
 
     def f(v):
-        return jnp.einsum("ncdhw,od,ph,qw->ncopq", v, *mats,
+        if data_format == "NCDHW":
+            return jnp.einsum("ncdhw,od,ph,qw->ncopq", v, *mats,
+                              preferred_element_type=v.dtype)
+        return jnp.einsum("ndhwc,od,ph,qw->nopqc", v, *mats,
                           preferred_element_type=v.dtype)
 
     return apply_op(f, x, name="adaptive_avg_pool3d")
@@ -1707,7 +1818,9 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
     xv = x._value if isinstance(x, Tensor) else x
     wv_shape = (weight._value.shape if isinstance(weight, Tensor)
                 else weight.shape)
-    dn = jax.lax.conv_dimension_numbers(xv.shape, wv_shape, io)
+    grouped_shape = ((wv_shape[0] // groups, wv_shape[1] * groups)
+                     + tuple(wv_shape[2:]))
+    dn = jax.lax.conv_dimension_numbers(xv.shape, grouped_shape, io)
     pad_cfg = [
         (dils[i] * (wv_shape[2 + i] - 1) - pads[i],
          dils[i] * (wv_shape[2 + i] - 1) - pads[i] + opad[i])
@@ -1725,7 +1838,10 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
         return out
 
     w = _t(weight)
-    flip_w = apply_op(lambda u: jnp.flip(u, axis=spatial_axes), w, name="flip")
+    flip_w = apply_op(
+        lambda u: _group_transpose_kernel(
+            jnp.flip(u, axis=spatial_axes), groups, nd),
+        w, name="flip")
     args = (_t(x), flip_w) if bias is None else (_t(x), flip_w, _t(bias))
     return apply_op(f, *args, name=name)
 

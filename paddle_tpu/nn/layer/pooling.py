@@ -12,22 +12,22 @@ class MaxPool2D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
                  return_mask=False, data_format="NCHW", name=None):
         super().__init__()
-        self.args = (kernel_size, stride, padding, ceil_mode)
+        self.args = (kernel_size, stride, padding, ceil_mode, return_mask, data_format)
 
     def forward(self, x):
-        k, s, p, cm = self.args
-        return F.max_pool2d(x, k, s, p, ceil_mode=cm)
+        k, s, p, cm, rm, df = self.args
+        return F.max_pool2d(x, k, s, p, ceil_mode=cm, return_mask=rm, data_format=df)
 
 
 class MaxPool1D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
                  return_mask=False, name=None):
         super().__init__()
-        self.args = (kernel_size, stride, padding, ceil_mode)
+        self.args = (kernel_size, stride, padding, ceil_mode, return_mask)
 
     def forward(self, x):
-        k, s, p, cm = self.args
-        return F.max_pool1d(x, k, s, p, ceil_mode=cm)
+        k, s, p, cm, rm = self.args
+        return F.max_pool1d(x, k, s, p, ceil_mode=cm, return_mask=rm)
 
 
 class AvgPool2D(Layer):
@@ -45,7 +45,7 @@ class AvgPool1D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
                  ceil_mode=False, name=None):
         super().__init__()
-        self.args = (kernel_size, stride, padding)
+        self.args = (kernel_size, stride, padding, exclusive, ceil_mode)
 
     def forward(self, x):
         return F.avg_pool1d(x, *self.args)
@@ -55,9 +55,11 @@ class AdaptiveAvgPool2D(Layer):
     def __init__(self, output_size, data_format="NCHW", name=None):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     data_format=self.data_format)
 
 
 class AdaptiveAvgPool1D(Layer):
@@ -73,19 +75,24 @@ class AdaptiveMaxPool2D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self.output_size = output_size
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size,
+                                     return_mask=self.return_mask)
 
 
 class MaxPool3D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
                  return_mask=False, data_format="NCDHW", name=None):
         super().__init__()
-        self.args = (kernel_size, stride, padding, ceil_mode)
+        self.args = (kernel_size, stride, padding, ceil_mode, return_mask,
+                     data_format)
 
     def forward(self, x):
-        return F.max_pool3d(x, *self.args)
+        k, s, p, cm, rm, df = self.args
+        return F.max_pool3d(x, k, s, p, ceil_mode=cm, return_mask=rm,
+                            data_format=df)
 
 
 class AvgPool3D(Layer):

@@ -50,25 +50,36 @@ def weight_norm(layer, name: str = "weight", dim: int = 0):
         return None
 
     handle = layer.register_forward_pre_hook(recompute)
-    layer._weight_norm_handle = handle
+    # per-parameter-name state: a layer may have several weight-normed params
+    if not hasattr(layer, "_weight_norm_handles"):
+        layer._weight_norm_handles = {}
+        layer._weight_norm_dims = {}
+    layer._weight_norm_handles[name] = handle
+    layer._weight_norm_dims[name] = dim
     recompute(layer, None)
     return layer
 
 
 def remove_weight_norm(layer, name: str = "weight"):
-    handle = getattr(layer, "_weight_norm_handle", None)
+    handle = getattr(layer, "_weight_norm_handles", {}).pop(name, None)
     if handle is not None:
         handle.remove()
+    dim = getattr(layer, "_weight_norm_dims", {}).pop(name, 0)
     g = getattr(layer, name + "_g")
     v = getattr(layer, name + "_v")
     w = Tensor(np.asarray(
-        g._value * v._value / (_norm_except_dim(v._value, 0) + 1e-12)),
+        g._value * v._value / (_norm_except_dim(v._value, dim) + 1e-12)),
         stop_gradient=False)
     for pname in (name + "_g", name + "_v"):
         if pname in layer._parameters:
             del layer._parameters[pname]
         if hasattr(layer, pname):
             object.__delattr__(layer, pname)
+    # weight_norm's pre-hook set the composed weight as a plain instance
+    # attribute; drop it so the re-registered parameter isn't shadowed and
+    # forward / state_dict / the optimizer all see the same tensor
+    if name in layer.__dict__:
+        object.__delattr__(layer, name)
     layer.add_parameter(name, w)
     return layer
 

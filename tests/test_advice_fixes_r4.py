@@ -1,0 +1,235 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md r3):
+
+1. adaptive_avg_pool2d / adaptive_avg_pool3d with channels-last layouts
+2. remove_weight_norm honoring the original dim + no attribute shadowing
+3. grouped conv{1,2,3}d_transpose (paddle (Cin, Cout/g, k) kernel layout)
+4. return_mask on max pools (regular, adaptive, 3-D) feeding max_unpool
+5. ctc_loss norm_by_times: gradient-only 1/T scaling, loss value unchanged
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_adaptive_avg_pool2d_nhwc_divisible():
+    x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
+    out = F.adaptive_avg_pool2d(Tensor(x), 4, data_format="NHWC")
+    assert tuple(out.shape) == (2, 4, 4, 3)
+    ref = torch.nn.functional.adaptive_avg_pool2d(
+        torch.tensor(x).permute(0, 3, 1, 2), 4).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(np.asarray(out._value), ref, atol=1e-5)
+
+
+def test_adaptive_avg_pool3d_ndhwc():
+    x = np.random.RandomState(1).randn(2, 8, 8, 8, 3).astype(np.float32)
+    out = F.adaptive_avg_pool3d(Tensor(x), 4, data_format="NDHWC")
+    assert tuple(out.shape) == (2, 4, 4, 4, 3)
+    ref = torch.nn.functional.adaptive_avg_pool3d(
+        torch.tensor(x).permute(0, 4, 1, 2, 3), 4).permute(0, 2, 3, 4, 1).numpy()
+    np.testing.assert_allclose(np.asarray(out._value), ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("nd", [1, 2, 3])
+@pytest.mark.parametrize("groups", [1, 2])
+def test_grouped_conv_transpose(nd, groups):
+    tfn = {1: torch.nn.functional.conv_transpose1d,
+           2: torch.nn.functional.conv_transpose2d,
+           3: torch.nn.functional.conv_transpose3d}[nd]
+    fn = {1: F.conv1d_transpose, 2: F.conv2d_transpose,
+          3: F.conv3d_transpose}[nd]
+    rs = np.random.RandomState(nd * 10 + groups)
+    cin, cout = 4, 6
+    x = rs.randn(2, cin, *(5,) * nd).astype(np.float32)
+    w = rs.randn(cin, cout // groups, *(3,) * nd).astype(np.float32)
+    out = fn(Tensor(x), Tensor(w), stride=2, padding=1, groups=groups)
+    ref = tfn(torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+              groups=groups).numpy()
+    np.testing.assert_allclose(np.asarray(out._value), ref, atol=1e-4)
+
+
+def test_grouped_conv_transpose_grad_flows():
+    rs = np.random.RandomState(7)
+    x = Tensor(rs.randn(2, 4, 5, 5).astype(np.float32), stop_gradient=False)
+    w = Tensor(rs.randn(4, 3, 3, 3).astype(np.float32), stop_gradient=False)
+    out = F.conv2d_transpose(x, w, stride=2, groups=2)
+    out.sum().backward()
+    assert x.grad is not None and w.grad is not None
+    assert tuple(w.grad.shape) == (4, 3, 3, 3)
+
+
+def test_remove_weight_norm_dim1():
+    lin = nn.Linear(6, 4)
+    w_before = np.asarray(lin.weight._value).copy()
+    nn.utils.weight_norm(lin, dim=1)
+    nn.utils.remove_weight_norm(lin)
+    np.testing.assert_allclose(np.asarray(lin.weight._value), w_before,
+                               atol=1e-5)
+    # forward, state_dict and the optimizer must all see the same tensor
+    assert lin.weight is lin._parameters["weight"]
+
+
+@pytest.mark.parametrize("case", ["max2d", "adaptive_div", "adaptive_nondiv",
+                                  "max3d", "max1d"])
+def test_return_mask(case):
+    rs = np.random.RandomState(3)
+    if case == "max1d":
+        x = rs.randn(2, 3, 8).astype(np.float32)
+        out, mask = F.max_pool1d(Tensor(x), 2, return_mask=True)
+        to, tm = torch.nn.functional.max_pool1d(
+            torch.tensor(x), 2, return_indices=True)
+    elif case == "max2d":
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        out, mask = F.max_pool2d(Tensor(x), 2, return_mask=True)
+        to, tm = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, return_indices=True)
+    elif case == "adaptive_div":
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        out, mask = F.adaptive_max_pool2d(Tensor(x), 4, return_mask=True)
+        to, tm = torch.nn.functional.adaptive_max_pool2d(
+            torch.tensor(x), 4, return_indices=True)
+    elif case == "adaptive_nondiv":
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        out, mask = F.adaptive_max_pool2d(Tensor(x), 3, return_mask=True)
+        to, tm = torch.nn.functional.adaptive_max_pool2d(
+            torch.tensor(x), 3, return_indices=True)
+    else:
+        x = rs.randn(2, 3, 4, 8, 8).astype(np.float32)
+        out, mask = F.max_pool3d(Tensor(x), 2, return_mask=True)
+        to, tm = torch.nn.functional.max_pool3d(
+            torch.tensor(x), 2, return_indices=True)
+    np.testing.assert_allclose(np.asarray(out._value), to.numpy(), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(mask._value), tm.numpy())
+
+
+@pytest.mark.parametrize("kw", [dict(ceil_mode=True),
+                                dict(padding=1, ceil_mode=True)])
+def test_return_mask_ceil_mode(kw):
+    x = np.random.RandomState(8).randn(2, 3, 7, 7).astype(np.float32)
+    out, mask = F.max_pool2d(Tensor(x), 3, stride=2, return_mask=True, **kw)
+    to, tm = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 3, stride=2, return_indices=True, **kw)
+    np.testing.assert_allclose(np.asarray(out._value), to.numpy(), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(mask._value), tm.numpy())
+
+
+def test_ceil_mode_last_window_dropped():
+    # k2 s2 p1 ceil on 3x3: naive ceil gives 3 windows, torch/paddle drop the
+    # one starting in right padding -> 2x2
+    x = np.random.RandomState(10).randn(1, 1, 3, 3).astype(np.float32)
+    out, mask = F.max_pool2d(Tensor(x), 2, stride=2, padding=1,
+                             ceil_mode=True, return_mask=True)
+    to, tm = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, stride=2, padding=1, ceil_mode=True,
+        return_indices=True)
+    assert tuple(out.shape) == tuple(to.shape)
+    np.testing.assert_array_equal(np.asarray(mask._value), tm.numpy())
+    out2 = F.max_pool2d(Tensor(x), 2, stride=2, ceil_mode=True)
+    ref2 = torch.nn.functional.max_pool2d(torch.tensor(x), 2, stride=2,
+                                          ceil_mode=True)
+    assert tuple(out2.shape) == tuple(ref2.shape)
+    np.testing.assert_allclose(np.asarray(out2._value), ref2.numpy(),
+                               atol=1e-6)
+
+
+def test_pool_nhwc_layouts():
+    rs = np.random.RandomState(11)
+    x = rs.randn(1, 6, 6, 3).astype(np.float32)
+    out = F.max_pool2d(Tensor(x), 2, data_format="NHWC")
+    ref = torch.nn.functional.max_pool2d(
+        torch.tensor(x).permute(0, 3, 1, 2), 2).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(np.asarray(out._value), ref, atol=1e-6)
+    x3 = rs.randn(1, 6, 6, 6, 3).astype(np.float32)
+    out3 = nn.MaxPool3D(2, data_format="NDHWC")(Tensor(x3))
+    assert tuple(out3.shape) == (1, 3, 3, 3, 3)
+
+
+@pytest.mark.parametrize("exclusive,ceil,pad", [(True, True, 1),
+                                                (False, True, 1),
+                                                (True, True, 0)])
+def test_avg_pool_ceil_divisor(exclusive, ceil, pad):
+    x = np.random.RandomState(12).randn(2, 3, 7, 7).astype(np.float32)
+    out = F.avg_pool2d(Tensor(x), 3, stride=2, padding=pad, ceil_mode=ceil,
+                       exclusive=exclusive)
+    ref = torch.nn.functional.avg_pool2d(
+        torch.tensor(x), 3, stride=2, padding=pad, ceil_mode=ceil,
+        count_include_pad=not exclusive).numpy()
+    assert tuple(out.shape) == tuple(ref.shape)
+    np.testing.assert_allclose(np.asarray(out._value), ref, atol=1e-6)
+
+
+def test_weight_norm_two_params_independent():
+    class Two(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight_ih = self.create_parameter([4, 5])
+            self.weight_hh = self.create_parameter([4, 4])
+
+        def forward(self, x):
+            return x
+
+    layer = Two()
+    w_ih = np.asarray(layer.weight_ih._value).copy()
+    w_hh = np.asarray(layer.weight_hh._value).copy()
+    nn.utils.weight_norm(layer, "weight_ih", dim=0)
+    nn.utils.weight_norm(layer, "weight_hh", dim=1)
+    nn.utils.remove_weight_norm(layer, "weight_ih")
+    np.testing.assert_allclose(np.asarray(layer.weight_ih._value), w_ih,
+                               atol=1e-5)
+    assert "weight_hh" in layer._weight_norm_handles
+    nn.utils.remove_weight_norm(layer, "weight_hh")
+    np.testing.assert_allclose(np.asarray(layer.weight_hh._value), w_hh,
+                               atol=1e-5)
+
+
+def test_return_mask_nhwc_raises():
+    x = np.random.RandomState(9).randn(2, 8, 8, 3).astype(np.float32)
+    with pytest.raises(ValueError):
+        F.max_pool2d(Tensor(x), 2, return_mask=True, data_format="NHWC")
+
+
+def test_return_mask_unpool_roundtrip():
+    x = np.random.RandomState(4).randn(2, 3, 8, 8).astype(np.float32)
+    out, mask = F.max_pool2d(Tensor(x), 2, return_mask=True)
+    un = F.max_unpool2d(out, mask, 2)
+    ref = torch.nn.functional.max_unpool2d(
+        *torch.nn.functional.max_pool2d(torch.tensor(x), 2,
+                                        return_indices=True), 2).numpy()
+    np.testing.assert_allclose(np.asarray(un._value), ref, atol=1e-6)
+
+
+def test_maxpool_layer_return_mask():
+    x = np.random.RandomState(5).randn(2, 3, 8, 8).astype(np.float32)
+    out, mask = nn.MaxPool2D(2, return_mask=True)(Tensor(x))
+    assert tuple(out.shape) == (2, 3, 4, 4)
+    assert tuple(mask.shape) == (2, 3, 4, 4)
+
+
+def test_ctc_loss_norm_by_times_value_and_grad():
+    rs = np.random.RandomState(6)
+    T, N, C, S = 10, 2, 5, 3
+    lp = np.log(rs.dirichlet(np.ones(C), (T, N)).astype(np.float32))
+    labels = rs.randint(1, C, (N, S))
+    il = np.array([10, 8])
+    ll = np.array([3, 2])
+    l0 = F.ctc_loss(Tensor(lp), Tensor(labels), Tensor(il), Tensor(ll),
+                    reduction="none")
+    l1 = F.ctc_loss(Tensor(lp), Tensor(labels), Tensor(il), Tensor(ll),
+                    reduction="none", norm_by_times=True)
+    # loss VALUE must be unchanged (warpctc only scales the gradient)
+    np.testing.assert_allclose(np.asarray(l0._value), np.asarray(l1._value),
+                               atol=1e-6)
+    xt = Tensor(lp, stop_gradient=False)
+    F.ctc_loss(xt, Tensor(labels), Tensor(il), Tensor(ll),
+               reduction="sum", norm_by_times=True).backward()
+    g1 = np.asarray(xt.grad._value)
+    xt2 = Tensor(lp, stop_gradient=False)
+    F.ctc_loss(xt2, Tensor(labels), Tensor(il), Tensor(ll),
+               reduction="sum").backward()
+    g0 = np.asarray(xt2.grad._value)
+    # gradient scaled by 1/T per sequence
+    np.testing.assert_allclose(g1[:, 0], g0[:, 0] / 10.0, atol=1e-6)
+    np.testing.assert_allclose(g1[:, 1], g0[:, 1] / 8.0, atol=1e-6)
