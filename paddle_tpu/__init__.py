@@ -74,6 +74,7 @@ from paddle_tpu import vision  # noqa: F401
 from paddle_tpu.hapi import hub  # noqa: F401
 
 from paddle_tpu.framework.io_ import load, save  # noqa: F401
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
 from paddle_tpu.framework import (  # noqa: F401
     LazyGuard, finfo, get_cuda_rng_state, get_rng_state, iinfo,
     is_compiled_with_cinn, is_compiled_with_cuda, is_compiled_with_custom_device,

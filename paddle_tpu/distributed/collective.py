@@ -72,23 +72,42 @@ class Group:
 
     def _axis_position(self, r: int):
         """Position of global rank r along this group's mesh axes (row-major
-        over self.axes), or None when the mapping is not well-defined. Only
-        valid when ranks map 1:1 onto mesh slots (one device per process) —
-        with multi-device processes a process spans several mesh coords."""
+        over self.axes), or None when the mapping is not well-defined.
+
+        1:1 process↔device meshes unravel the rank directly. When processes
+        own multiple devices (the standard TPU deployment, 4 chips/host), the
+        position is derived from the mesh's device array: the coords of
+        process r's devices along the group axes — well-defined iff all of
+        r's devices share one coordinate on each group axis (e.g. a host's
+        chips span 'mp' but sit at one 'dp' index → its dp position)."""
         mesh = get_mesh()
         if (mesh is None or not self.axes
                 or not all(a in mesh.shape for a in self.axes)):
             return None
-        if int(np.prod(list(mesh.shape.values()))) != get_world_size():
-            return None  # processes own multiple devices: no 1:1 mapping
-        try:
-            coords = dict(zip(mesh.axis_names,
-                              np.unravel_index(r, tuple(mesh.shape.values()))))
-        except ValueError:
+        if int(np.prod(list(mesh.shape.values()))) == get_world_size():
+            try:
+                coords = dict(zip(mesh.axis_names,
+                                  np.unravel_index(r, tuple(mesh.shape.values()))))
+            except ValueError:
+                return None
+            pos = 0
+            for a in self.axes:
+                pos = pos * int(mesh.shape[a]) + int(coords[a])
+            return pos
+        # multi-device processes: map via device coords
+        devs = np.asarray(mesh.devices)
+        names = list(mesh.axis_names)
+        owned = np.argwhere(np.vectorize(
+            lambda d: getattr(d, "process_index", 0))(devs) == r)
+        if owned.size == 0:
             return None
         pos = 0
         for a in self.axes:
-            pos = pos * int(mesh.shape[a]) + int(coords[a])
+            ai = names.index(a)
+            vals = {int(c[ai]) for c in owned}
+            if len(vals) > 1:
+                return None  # process spans several positions on this axis
+            pos = pos * int(mesh.shape[a]) + vals.pop()
         return pos
 
     @property
@@ -405,12 +424,51 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None, out_split_size
     return out_tensor
 
 
-# ---- p2p: inside pipeline programs these lower to ppermute ---------------
+# ---- p2p: inside traced programs these lower to ppermute ------------------
+#
+# SPMD peer addressing (reference p2p_communication.py:52 send/recv between
+# arbitrary ranks): a send(t, dst)/recv(buf, src) pair in the SAME trace forms
+# one point-to-point edge. send records (dst_pos, value); the matching recv
+# (FIFO order, like batch_isend_irecv's op list) emits a single-pair
+# ppermute [(src_pos, dst_pos)] — the device at dst_pos receives the value,
+# every other device receives zeros (XLA ppermute semantics). Positions are
+# the endpoints' positions along the group's mesh axis, so dst/src are global
+# ranks exactly as in the reference API.
+
+_P2P_PENDING: list = []  # (axis, dst_pos, tensor) sends awaiting their recv
+
 
 def _ppermute(tensor, axis, shift):
     n = mesh_axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return apply_op(lambda v: jax.lax.ppermute(v, axis, perm), tensor, name="ppermute")
+
+
+def _peer_pos(group: Group | None, global_rank: int, axis: str) -> int:
+    """Map a peer rank to its DEVICE position along the p2p axis (ppermute
+    moves data between devices, so rank-list indices are only valid when they
+    coincide with axis positions).
+
+    Single-process SPMD: peers ARE axis positions — validate range. Multi-
+    process: a process's position is well-defined only when all its devices
+    share one coordinate on the axis (Group._axis_position); anything else
+    raises rather than silently addressing the wrong chip."""
+    g = group if group is not None else _global_group()
+    r = int(global_rank)
+    if get_world_size() > 1:
+        pos = g._axis_position(r)
+        if pos is None:
+            raise ValueError(
+                f"rank {r} has no well-defined device position along axis "
+                f"{axis!r} (its devices span several positions, or the mesh "
+                f"is absent); in-graph p2p needs a 1:1 rank->position map")
+        return int(pos)
+    n = mesh_axis_size(axis)
+    if not 0 <= r < n:
+        raise ValueError(
+            f"in-graph p2p peer {r} out of range for axis {axis!r} "
+            f"(size {n}); in single-process SPMD peers are axis positions")
+    return r
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -419,15 +477,16 @@ def send(tensor, dst=0, group=None, sync_op=True):
         if len(axes) > 1:
             raise NotImplementedError(
                 "in-graph send() over a fused multi-axis group has no single "
-                "ppermute ring; use a per-axis group")
-        return _ppermute(tensor, axes[0], +1)
+                "ppermute axis; use a per-axis group")
+        _P2P_PENDING.append((axes[0], _peer_pos(group, dst, axes[0]), tensor))
+        return tensor
     if multiproc.cross_process_active():
         multiproc.store_send(np.asarray(tensor._value), dst)
         return tensor
     if get_world_size() > 1:
         raise NotImplementedError(
             "eager send() between ranks requires init_parallel_env() in a "
-            "multi-process job (or use it inside a compiled pipeline, where it "
+            "multi-process job (or use it inside a compiled program, where it "
             "lowers to ppermute)")
     return tensor
 
@@ -438,8 +497,27 @@ def recv(tensor, src=0, group=None, sync_op=True):
         if len(axes) > 1:
             raise NotImplementedError(
                 "in-graph recv() over a fused multi-axis group has no single "
-                "ppermute ring; use a per-axis group")
-        return tensor  # in-graph: the matching ppermute already delivered
+                "ppermute axis; use a per-axis group")
+        # FIFO among sends on THIS axis — sends queued for another axis
+        # (another group) must not be consumed by this recv
+        match = next((i for i, e in enumerate(_P2P_PENDING)
+                      if e[0] == axes[0]), None)
+        if match is None:
+            raise RuntimeError(
+                f"in-graph recv() on axis {axes[0]!r} with no matching "
+                "send() earlier in this trace: SPMD p2p is a send/recv pair "
+                "forming one ppermute edge (send must appear first in "
+                "program order)")
+        axis, dst_pos, val = _P2P_PENDING.pop(match)
+        src_pos = _peer_pos(group, src, axis)
+        out = apply_op(
+            lambda v: jax.lax.ppermute(v, axis, [(src_pos, dst_pos)]),
+            val, name="p2p_ppermute")
+        tensor._set_value(out._value)
+        tensor._grad_node = out._grad_node
+        tensor._output_index = out._output_index
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
     if multiproc.cross_process_active():
         return _set_np(tensor, multiproc.store_recv(src))
     if get_world_size() > 1:
@@ -480,7 +558,23 @@ def partial_recv(tensor, src=0, nranks=1, rank_id=0, group=None):
     Bound-axes first, like recv(): in-graph tracing must never reach the
     host-side store path."""
     if _bound_axes(_axis_names(group)):
-        return recv(tensor, src=src, group=group)
+        shape = list(tensor.shape)
+        numel = int(np.prod(shape)) if shape else 1
+        start, per = _partial_slice(numel, nranks, rank_id)
+        piece = Tensor(jnp.zeros((per,), tensor._value.dtype))
+        recv(piece, src=src, group=group)  # pops the pending partial_send
+
+        def f(full, pc):
+            flat = full.reshape(-1)
+            return flat.at[start:start + per].set(pc.reshape(-1)).reshape(
+                full.shape)
+
+        out = apply_op(f, tensor, piece, name="partial_recv")
+        tensor._set_value(out._value)
+        tensor._grad_node = out._grad_node
+        tensor._output_index = out._output_index
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
     shape = list(tensor.shape)
     numel = int(np.prod(shape)) if shape else 1
     start, per = _partial_slice(numel, nranks, rank_id)
@@ -589,11 +683,10 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None)
     """reference communication/scatter.py scatter_object_list: rank `src`
     distributes one python object per rank."""
     if multiproc.cross_process_active():
-        objs = multiproc.broadcast_object(
-            list(in_object_list or []), src, _group_ranks(group))
-        ranks = _group_ranks(group) or list(range(multiproc.num_processes()))
-        me = ranks.index(multiproc._rank()) if multiproc._rank() in ranks else 0
-        out_object_list[:] = [objs[me]]
+        mine = multiproc.scatter_objects(
+            list(in_object_list) if in_object_list is not None else None,
+            src, _group_ranks(group))
+        out_object_list[:] = [mine]
         return out_object_list
     out_object_list[:] = [(in_object_list or [None])[0]]
     return out_object_list

@@ -1,10 +1,15 @@
 """Segment (sequence) parallel wrapper over the dedicated "sep" mesh axis.
 
 Reference parity: `SegmentParallel` (fleet/meta_parallel/segment_parallel.py:26)
-— params broadcast over the sep group; sequence dim split across sep ranks.
+— at wrap it broadcasts params over the sep group (then sharding/dp groups),
+so every sep rank starts from identical weights; grads sync over dp+sep via
+`fused_allreduce_gradients` (sep contribution unscaled, like the reference).
+
 TPU-native: the compiled step shards the sequence dim over "sep"
 (batch PartitionSpec(..., 'sep', ...)); attention over the full sequence uses
 ring attention (paddle_tpu.parallel.ring_attention) instead of gathering.
+`shard_sequence` is the eager-mode helper that hands each sep rank its
+sequence segment.
 """
 from __future__ import annotations
 
@@ -15,6 +20,46 @@ class SegmentParallel:
     def __init__(self, layers, hcg, strategy=None):
         self._layers = layers
         self._hcg = hcg
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        """reference segment_parallel.py:31 _prepare_for_model: broadcast
+        sep -> sharding -> dp parameters."""
+        from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (
+            broadcast_dp_parameters, broadcast_sep_parameters,
+            broadcast_sharding_parameters)
+
+        hcg = self._hcg
+        if hcg is None:
+            return
+        broadcast_sep_parameters(self._layers, hcg)
+        try:
+            if hcg.get_sharding_parallel_world_size() > 1:
+                broadcast_sharding_parameters(self._layers, hcg)
+            if hcg.get_data_parallel_world_size() > 1:
+                broadcast_dp_parameters(self._layers, hcg)
+        except AttributeError:
+            pass
+
+    def shard_sequence(self, x, seq_axis: int = 1):
+        """Hand this sep rank its contiguous sequence segment (eager mode).
+        In the compiled path the same split is a PartitionSpec over 'sep'."""
+        hcg = self._hcg
+        try:
+            n = hcg.get_sep_parallel_world_size()
+            r = hcg.get_sep_parallel_rank()
+        except AttributeError:
+            return x
+        if n <= 1:
+            return x
+        seqlen = x.shape[seq_axis]
+        if seqlen % n != 0:
+            raise ValueError(
+                f"sequence length {seqlen} not divisible by sep degree {n}")
+        per = seqlen // n
+        index = [slice(None)] * len(x.shape)
+        index[seq_axis] = slice(r * per, (r + 1) * per)
+        return x[tuple(index)]
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_layers"], name)

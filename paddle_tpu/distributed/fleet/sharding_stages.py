@@ -32,6 +32,17 @@ class ShardOptimizerWrapper:
             return {k: shard_array_over(v, axis) for k, v in st.items()}
 
         optimizer._init_state = sharded_init_state
+        # stage-3 additionally shards the PARAMETERS over the axis
+        # (reference api.py:1269 ShardingStage3 placements) — state-only
+        # sharding would silently downgrade the user's request to stage-1
+        if isinstance(shard_fn, ShardingStage3):
+            params = (getattr(optimizer, "_parameter_list", None)
+                      or getattr(optimizer, "_parameters", None) or [])
+            for p in params:
+                try:
+                    p._set_value(shard_array_over(p._value, axis))
+                except Exception:
+                    pass  # axis absent from the mesh: placement unchanged
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_inner_opt"], name)

@@ -1,37 +1,119 @@
 """Hybrid-parallel grad sync helpers.
 
 Reference parity: fleet/utils/hybrid_parallel_util.py —
-`fused_allreduce_gradients` (:241), broadcast_*_params helpers.
+`fused_allreduce_gradients` (:241), broadcast_*_params helpers,
+`sync_params_buffers` (:190).
 
 TPU-native: on the logical-global view, dp grads are already the global sum
 (SPMD); inside a shard_map'd step the psum is explicit. These helpers apply
-the explicit psum when an axis is bound, matching the eager-collective path.
+the explicit psum when an axis is bound and otherwise fall back to the
+cross-process eager data plane (the ProcessGroup analog), matching the
+reference behavior in every execution mode instead of silently no-opping.
 """
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.tensor import Tensor, apply_op
 from paddle_tpu.distributed.collective import _bound_axes
 
 __all__ = ["fused_allreduce_gradients", "broadcast_dp_parameters",
-           "broadcast_mp_parameters", "broadcast_sharding_parameters",
-           "sync_params_buffers"]
+           "broadcast_mp_parameters", "broadcast_sep_parameters",
+           "broadcast_sharding_parameters", "sync_params_buffers"]
+
+
+def _dp_group_info(hcg):
+    """(ranks, dp_nranks) for the dp(+sep) group from an HCG, or (None, None)."""
+    if hcg is None:
+        return None, None
+    try:
+        dp_group = hcg.get_data_parallel_group()
+        ranks = list(getattr(dp_group, "ranks", []) or [])
+        return (ranks or None), (len(ranks) if ranks else None)
+    except Exception:
+        return None, None
 
 
 def fused_allreduce_gradients(parameter_list, hcg):
-    """reference :241 — allreduce every grad over the dp(+sep) group."""
+    """reference :241 — allreduce every grad over the dp(+sep) group, scaled
+    by 1/dp_nranks (sep contribution unscaled, like the reference)."""
     axes = _bound_axes(("dp", "sep"))
-    if not axes:
+    if axes:
+        dp_axes = _bound_axes(("dp",))
+        for p in parameter_list:
+            if p.grad is not None:
+                def sync(v):
+                    v = jax.lax.psum(v, axes)
+                    if dp_axes:
+                        v = v / jax.lax.psum(jnp.ones((), v.dtype), dp_axes)
+                    return v
+
+                g = apply_op(sync, p.grad, name="fused_allreduce")
+                p.grad._set_value(g._value)
         return
+    from paddle_tpu.distributed import multiproc
+
+    if not multiproc.cross_process_active():
+        return  # single process, global view: grads already global
+    ranks, nranks = _dp_group_info(hcg)
     for p in parameter_list:
         if p.grad is not None:
-            g = apply_op(lambda v: jax.lax.psum(v, axes), p.grad, name="fused_allreduce")
-            p.grad._set_value(g._value)
+            g = multiproc.allreduce_np(np.asarray(p.grad._value), op="sum",
+                                       ranks=ranks)
+            scale = nranks or (len(ranks) if ranks else multiproc.num_processes())
+            p.grad._set_value(jnp.asarray(g / scale, p.grad._value.dtype))
+
+
+def sync_params_buffers(model, comm_group=None, src_rank=0,
+                        is_model_parallel=False, ranks=None):
+    """Broadcast every parameter and buffer from src_rank so all replicas
+    start identical (reference :190 sync_params_buffers / parallel.py:202).
+    The member set comes from `ranks` or `comm_group.ranks` (full world when
+    neither is given)."""
+    from paddle_tpu.distributed import multiproc
+
+    if not multiproc.cross_process_active():
+        return
+    if ranks is None:
+        ranks = list(getattr(comm_group, "ranks", None) or []) or None
+    for p in model.parameters():
+        p._set_value(jnp.asarray(
+            multiproc.broadcast_np(np.asarray(p._value), src=src_rank,
+                                   ranks=ranks), p._value.dtype))
+    # buffers may be raw arrays (not Tensors): write back into the owning
+    # layer's _buffers store
+    for layer in model.sublayers(include_self=True):
+        for name, b in list(layer._buffers.items()):
+            if b is None:
+                continue
+            bv = b._value if isinstance(b, Tensor) else b
+            new = multiproc.broadcast_np(np.asarray(bv), src=src_rank,
+                                         ranks=ranks)
+            if isinstance(b, Tensor):
+                b._set_value(jnp.asarray(new, np.asarray(bv).dtype))
+            else:
+                layer._buffers[name] = jnp.asarray(new, np.asarray(bv).dtype)
 
 
 def broadcast_dp_parameters(model, hcg):
-    """global-SPMD: one logical copy, nothing to broadcast."""
+    ranks, _ = _dp_group_info(hcg)
+    sync_params_buffers(model, ranks=ranks,
+                        src_rank=ranks[0] if ranks else 0)
+
+
+def broadcast_sep_parameters(model, hcg):
+    """reference hybrid_parallel_util broadcast_sep_parameters: params start
+    identical across the sep group (the wrapper replicates weights)."""
+    ranks = None
+    try:
+        sep_group = hcg.get_sep_parallel_group()
+        ranks = list(getattr(sep_group, "ranks", []) or []) or None
+    except AttributeError:
+        pass
+    sync_params_buffers(model, ranks=ranks,
+                        src_rank=ranks[0] if ranks else 0)
 
 
 def broadcast_mp_parameters(model, hcg):
@@ -39,8 +121,4 @@ def broadcast_mp_parameters(model, hcg):
 
 
 def broadcast_sharding_parameters(model, hcg):
-    pass
-
-
-def sync_params_buffers(model, comm_group=None, src_rank=0, is_model_parallel=False):
     pass

@@ -32,8 +32,8 @@ import jax
 __all__ = [
     "num_processes", "cross_process_active", "allgather_np", "allreduce_np",
     "broadcast_np", "subgroup_allgather_np", "subgroup_broadcast_np",
-    "exchange_objects", "broadcast_object", "barrier", "subgroup_barrier",
-    "store_send", "store_recv",
+    "exchange_objects", "broadcast_object", "scatter_objects", "barrier",
+    "subgroup_barrier", "store_send", "store_recv",
 ]
 
 _counters: dict[str, int] = {}
@@ -206,6 +206,31 @@ def broadcast_object(obj, src: int = 0, ranks=None):
     else:
         out = pickle.loads(store.wait(f"{pre}/v"))
     _gc_keys(store, [f"{pre}/v"], f"{pre}/acks", len(members))
+    return out
+
+
+def scatter_objects(objs, src: int = 0, ranks=None):
+    """src hands each member ONLY its own object (reference scatter_object_list
+    semantics): one store key per non-src member, each receiver reads just its
+    slice — not an O(n·size) broadcast of the whole list. Objects are assigned
+    in GROUP order (the order `ranks` was given, reference group-rank
+    semantics), not sorted-rank order."""
+    order = list(ranks) if ranks else list(range(num_processes()))
+    pre, members = _group_prefix("so", order)
+    store = _store()
+    if _rank() == src:
+        if objs is None or len(objs) != len(order):
+            raise ValueError(
+                f"scatter_objects: need {len(order)} objects, got "
+                f"{0 if objs is None else len(objs)}")
+        for r, o in zip(order, objs):
+            if r != src:
+                store.set(f"{pre}/{r}", pickle.dumps(o))
+        out = objs[order.index(src)]
+    else:
+        out = pickle.loads(store.wait(f"{pre}/{_rank()}"))
+    _gc_keys(store, [f"{pre}/{r}" for r in order if r != src],
+             f"{pre}/acks", len(members))
     return out
 
 

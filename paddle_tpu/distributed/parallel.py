@@ -2,20 +2,28 @@
 
 Reference parity: `init_parallel_env` (distributed/parallel.py:945) and
 `paddle.DataParallel` (distributed/parallel.py:202) with the C++ EagerReducer
-(collective/reducer.cc) doing bucketed overlap allreduce.
+(collective/reducer.cc:512) broadcasting params at wrap and allreduce-averaging
+grads during backward.
 
 TPU-native: `init_parallel_env` builds the global device mesh (one axis "dp"
-by default) instead of spawning NCCL comms; there is no explicit reducer —
-the DataParallel wrapper installs grad-sync semantics by (a) compiling the
-train step over the dp axis when used with fleet/to_static (grad psum fused by
-XLA, the EagerReducer analog with perfect overlap), and (b) eager mode on a
-global view where per-chip grads are already implicitly summed by SPMD.
+by default) instead of spawning NCCL comms. DataParallel delivers the
+reference contract in both execution modes:
+  - compiled step over the dp axis: grad psum fused by XLA into backward
+    (the bucketed-overlap analog of reducer.cc:1093) — hooks never fire there;
+  - eager multi-process: params+buffers broadcast from the group's first rank
+    at wrap, per-param grad hooks allreduce-average over the dp group through
+    the cross-process data plane, `no_sync` accumulates locally and the next
+    synced backward reduces the whole accumulated grad (reference
+    EagerReducer/no_sync semantics).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.distributed.env import ParallelEnv, get_rank, get_world_size
 from paddle_tpu.distributed.mesh import build_mesh, get_mesh
@@ -77,9 +85,17 @@ def get_backend() -> str:
 class DataParallel:
     """Wraps a layer for data parallelism (reference: distributed/parallel.py:202).
 
+    At wrap: broadcasts params + buffers from the group's first rank
+    (reference parallel.py:202 sync_params_buffers). During backward: per-param
+    grad hooks allreduce-average over the dp group — in-graph `lax.pmean` when
+    a dp axis is bound (eager-inside-shard_map), the cross-process data plane
+    when running multi-process. In the compiled-step path grads sync via the
+    psum fused into the step; the eager tape (and these hooks) never runs
+    there, so there is no double sync.
+
     find_unused_parameters / comm_buffer_size knobs are accepted for parity;
-    gradient sync happens inside the compiled step (XLA fuses the psum with
-    backward compute, the bucketed-overlap analog of reducer.cc:1093).
+    collectives are issued per-param in deterministic (parameters()) backward
+    order on every rank, the functional analog of bucketing.
     """
 
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
@@ -87,6 +103,70 @@ class DataParallel:
                  group=None):
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
+        self._group = group
+        self._group_ranks = list(getattr(group, "ranks", None) or []) or None
+        self._grad_sync_enabled = True
+        self._hook_handles = []
+
+        from paddle_tpu.distributed import multiproc
+
+        if multiproc.cross_process_active():
+            from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (
+                sync_params_buffers)
+
+            src = self._group_ranks[0] if self._group_ranks else 0
+            sync_params_buffers(layers, comm_group=group, src_rank=src)
+        self._install_grad_hooks()
+
+    # ---- grad sync --------------------------------------------------------
+
+    def _install_grad_hooks(self):
+        for p in self._layers.parameters():
+            if getattr(p, "stop_gradient", True):
+                continue
+            self._hook_handles.append(p.register_hook(self._make_hook(p)))
+
+    def _make_hook(self, p):
+        from paddle_tpu.distributed import multiproc
+        from paddle_tpu.distributed.collective import _bound_axes
+
+        def hook(ct):
+            if not self._grad_sync_enabled:
+                # no_sync: accumulate locally; the next synced backward
+                # reduces the whole accumulated grad (reference no_sync)
+                p._dp_unsynced = True
+                return None
+            axes = _bound_axes(("dp",))
+            if axes:
+                return jax.lax.pmean(ct, axes)
+            if not multiproc.cross_process_active():
+                return None
+            prior = None
+            if getattr(p, "_dp_unsynced", False) and p.grad is not None:
+                prior = np.asarray(p.grad._value)
+                p._dp_unsynced = False
+            total = np.asarray(ct) if prior is None else prior + np.asarray(ct)
+            avg = multiproc.allreduce_np(total, op="avg",
+                                         ranks=self._group_ranks)
+            # tape adds the returned cotangent to p.grad; subtract the local
+            # prior so the final accumulated grad equals the group average
+            out = avg if prior is None else avg - prior
+            return jnp.asarray(out, ct.dtype)
+
+        return hook
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Accumulate grads locally; sync resumes (covering the accumulated
+        grad) on the first backward after exit (reference parallel.py:312)."""
+        old = self._grad_sync_enabled
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = old
+
+    # ---- layer delegation -------------------------------------------------
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_layers"], name)
@@ -103,10 +183,7 @@ class DataParallel:
     def set_state_dict(self, *args, **kwargs):
         return self._layers.set_state_dict(*args, **kwargs)
 
-    def no_sync(self):
-        import contextlib
-
-        return contextlib.nullcontext()
-
     def scale_loss(self, loss):
+        # grads are averaged in the hook (reference EagerReducer divides by
+        # nranks), so the loss itself is not scaled
         return loss

@@ -36,7 +36,12 @@ EP_AXIS = "ep"
 
 
 class NaiveGate(Layer):
-    """Top-k softmax gate (reference gate/naive_gate.py)."""
+    """Top-k softmax gate (reference gate/naive_gate.py).
+
+    `forward` produces logits; routing itself (top-k selection, jitter,
+    random second-expert drop, gate-level capacity) is a PURE jnp transform
+    described by `routing_config()` and executed inside the sharded dispatch
+    program (`_route` in `_sparse_moe`) so it traces/shards cleanly."""
 
     def __init__(self, d_model, num_expert, world_size=1, topk=2):
         super().__init__()
@@ -49,23 +54,55 @@ class NaiveGate(Layer):
         logits = F.linear(x, self.gate_weight)
         return logits
 
+    def routing_config(self, training: bool) -> tuple:
+        """Hashable static routing spec consumed by _route."""
+        return (("kind", "naive"),)
+
+    def cap_rate(self, training: bool):
+        """Gate-level per-expert capacity as a fraction of local tokens
+        (reference limit_by_capacity), or None for no gate-level cap."""
+        return None
+
 
 class GShardGate(NaiveGate):
-    """GShard gate: top-2 + load-balance aux loss (reference gate/gshard_gate.py)."""
+    """GShard gate: top-2 + random second-expert routing + gate-level capacity
+    (reference gate/gshard_gate.py:30-84: limit_by_capacity with
+    cap_rate=capacity[train?0:1], then _random_routing keeping the second
+    expert with probability min(1, 2*topk_val[:,1]))."""
 
     def __init__(self, d_model, num_expert, world_size=1, topk=2, capacity=(1.2, 2.4),
                  random_routing=True, group=None):
+        assert topk == 2, "topk should be 2 in gshard"
         super().__init__(d_model, num_expert, world_size, topk)
-        self.capacity = capacity
+        self.capacity = tuple(capacity)
+        self.random_routing = random_routing
+
+    def routing_config(self, training: bool) -> tuple:
+        return (("kind", "gshard"),
+                ("random_routing", bool(self.random_routing and training)))
+
+    def cap_rate(self, training: bool):
+        return float(self.capacity[0 if training else 1])
 
 
 class SwitchGate(NaiveGate):
-    """Switch transformer top-1 gate (reference gate/switch_gate.py)."""
+    """Switch transformer top-1 gate (reference gate/switch_gate.py:41-75:
+    train-time uniform jitter in [1-eps, 1+eps] added to the logits, then
+    top-1 with gate-level capacity)."""
 
     def __init__(self, d_model, num_expert, world_size=1, topk=1, switch_eps=0.1,
                  capacity=(1.2, 2.4), group=None):
+        assert topk == 1, "topk should be 1 in switch"
         super().__init__(d_model, num_expert, world_size, topk=1)
-        self.switch_eps = switch_eps
+        self.switch_eps = float(switch_eps)
+        self.capacity = tuple(capacity)
+
+    def routing_config(self, training: bool) -> tuple:
+        return (("kind", "switch"),
+                ("switch_eps", self.switch_eps if training else 0.0))
+
+    def cap_rate(self, training: bool):
+        return float(self.capacity[0 if training else 1])
 
 
 class ExpertFFN(Layer):
@@ -97,27 +134,69 @@ class ExpertFFN(Layer):
         return apply_op(f, x, self.w1, self.b1, self.w2, self.b2, name="expert_ffn")
 
 
-def _sparse_moe(xv, gv, w1, b1, w2, b2, *, E, k, cf, act,
-                ep, ep_axis, token_axes, other_axes):
+def _route(logits, rng, *, k, routing):
+    """Pure gate routing: logits [N, float32] -> (topv, topi) [N, k], with
+    dropped selections marked topi == -1. Implements the reference gates'
+    semantics (gshard_gate.py:77-84 random routing, switch_gate.py:48-52
+    jitter) as jnp ops."""
+    cfg = dict(routing or ())
+    kind = cfg.get("kind", "naive")
+    if kind == "switch" and cfg.get("switch_eps", 0.0) > 0.0:
+        eps = cfg["switch_eps"]
+        rng, sub = jax.random.split(rng)
+        # reference switch_gate.py:49: noise = U(0,1)*2*eps + 1 - eps added
+        # to the logits (the constant 1 cancels in softmax)
+        logits = logits + (jax.random.uniform(sub, logits.shape)
+                           * 2.0 * eps + 1.0 - eps)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    if kind == "gshard" and cfg.get("random_routing", False):
+        # keep the second expert with probability min(1, 2*p2)
+        rng, sub = jax.random.split(rng)
+        pr = jax.random.uniform(sub, (logits.shape[0],))
+        drop2 = 2.0 * topv[:, 1] < pr
+        topi = topi.at[:, 1].set(jnp.where(drop2, -1, topi[:, 1]))
+    return topv, topi, probs
+
+
+def _sparse_moe(xv, gv, rng, w1, b1, w2, b2, *, E, k, cf, act,
+                ep, ep_axis, token_axes, other_axes,
+                routing=(), cap_rate=None, rng_axes=None):
     """Sparse capacity-bucketed dispatch/combine on LOCAL arrays.
 
     xv [N, d] (this rank's tokens), gv [N, E] gate logits, weights are this
     rank's expert shard [E//ep, ...]. When ep > 1 the capacity buffers ride
     lax.all_to_all over `ep_axis` to/from the expert owners (reference
-    global_scatter/global_gather). Returns (out [N, d], l_aux, dropped)."""
+    global_scatter/global_gather). `routing`/`cap_rate` carry the gate's
+    semantics (see _route / NaiveGate.cap_rate).
+    Returns (out [N, d], l_aux, dropped)."""
     N, d = xv.shape
     C = max(1, int(math.ceil(cf * k * N / E)))
 
-    probs = jax.nn.softmax(gv.astype(jnp.float32), axis=-1)         # [N, E]
-    topv, topi = jax.lax.top_k(probs, k)                            # [N, k]
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    # rng arrives as raw uint32 key bits (differentiable-arg plumbing); wrap
+    # back to a typed key, then fold a distinct deterministic routing stream
+    # per token shard (rng_axes covers the enclosing-shard_map 'bound' mode,
+    # where token_axes is () but dp/ep axes are bound)
+    rng = jax.random.wrap_key_data(rng)
+    for ax in (token_axes if rng_axes is None else rng_axes):
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+    topv, topi, probs = _route(gv.astype(jnp.float32), rng, k=k,
+                               routing=routing)
 
     flat_e = topi.reshape(-1)                                       # [N*k]
-    oh = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)                 # [N*k, E]
+    chosen = flat_e >= 0                                            # routing drop
+    oh = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)                 # [N*k, E] (-1 -> 0s)
     pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh, axis=-1) - 1         # [N*k]
-    valid = pos < C
-    dropped = jnp.sum((~valid).astype(jnp.float32))
-    dest = flat_e * C + jnp.minimum(pos, C - 1)                     # [N*k]
+    limit = C
+    if cap_rate is not None:
+        # gate-level per-expert capacity (reference limit_by_capacity):
+        # ceil(cap_rate * N) tokens per expert, applied before bucketing
+        limit = min(C, max(1, int(math.ceil(cap_rate * N))))
+    valid = chosen & (pos >= 0) & (pos < limit)
+    dropped = jnp.sum((chosen & ~valid).astype(jnp.float32))
+    dest = (jnp.clip(flat_e, 0, E - 1) * C
+            + jnp.clip(pos, 0, C - 1))                              # [N*k]
 
     # scatter tokens into their (expert, slot) buckets: O(E*C*d) memory
     xp = jnp.repeat(xv, k, axis=0)                                  # [N*k, d]
@@ -232,10 +311,22 @@ class MoELayer(Layer):
                 return "spmd", int(mesh.shape[EP_AXIS]), mesh, tok_axes
         return "local", 1, mesh, ()
 
-    def _spmd_fn(self, mesh, ep, tok_axes, n_tokens, E, k):
+    def _gate_semantics(self):
+        """(routing, cap_rate) from the gate, honoring train/eval mode."""
+        training = bool(getattr(self, "training", True))
+        routing = ()
+        cap_rate = None
+        if hasattr(self.gate, "routing_config"):
+            routing = tuple(self.gate.routing_config(training))
+        if hasattr(self.gate, "cap_rate"):
+            cap_rate = self.gate.cap_rate(training)
+        return routing, cap_rate
+
+    def _spmd_fn(self, mesh, ep, tok_axes, n_tokens, E, k, routing, cap_rate):
         """Build (and cache) the jitted shard_map dispatch program — rebuilt
         per forward it would retrace every step."""
-        key = (mesh, ep, tok_axes, n_tokens, E, k, self.capacity_factor)
+        key = (mesh, ep, tok_axes, n_tokens, E, k, self.capacity_factor,
+               routing, cap_rate)
         cached = self._spmd_cache.get(key)
         if cached is not None:
             return cached
@@ -245,10 +336,12 @@ class MoELayer(Layer):
         other = tuple(a for a in mesh.axis_names if a not in tok_axes)
         body = partial(_sparse_moe, E=E, k=k, cf=self.capacity_factor,
                        act=self.experts.act, ep=ep, ep_axis=EP_AXIS,
-                       token_axes=tok_axes, other_axes=other)
+                       token_axes=tok_axes, other_axes=other,
+                       routing=routing, cap_rate=cap_rate)
         tok_spec = P(tok_axes, None)
         w_spec = P(EP_AXIS, None, None)
-        in_specs = (tok_spec, P(tok_axes, None), w_spec, w_spec, w_spec, w_spec)
+        in_specs = (tok_spec, P(tok_axes, None), P(), w_spec, w_spec, w_spec,
+                    w_spec)
         out_specs = (tok_spec, P(), P())
         smapped = jax.jit(_shard_map(body, mesh, in_specs, out_specs))
 
@@ -262,27 +355,42 @@ class MoELayer(Layer):
 
     def forward(self, x):
         """x: [B, S, d] (or [N, d])."""
+        from paddle_tpu.distributed.fleet.rng import current_dropout_key
+
         orig_shape = x.shape
         d = orig_shape[-1]
         x2 = x.reshape([-1, d])
         n_tokens = x2.shape[0]
         E, k = self.num_expert, self.top_k
         logits = self.gate(x2)  # [N, E]
+        routing, cap_rate = self._gate_semantics()
         mode, ep, mesh, tok_axes = self._dispatch_plan(n_tokens)
+        # routing RNG only drawn when the gate actually randomizes, so
+        # deterministic gates stay bitwise-reproducible run to run
+        needs_rng = any(kk in dict(routing) and dict(routing)[kk]
+                        for kk in ("random_routing", "switch_eps"))
+        rng = current_dropout_key() if needs_rng else jax.random.key(0)
+        rng_bits = jax.random.key_data(rng)
 
         if mode == "spmd":
-            fn = self._spmd_fn(mesh, ep, tok_axes, n_tokens, E, k)
+            fn = self._spmd_fn(mesh, ep, tok_axes, n_tokens, E, k,
+                               routing, cap_rate)
         else:
             ep_eff = ep if mode == "bound" else 1
+            from paddle_tpu.distributed.collective import _bound_axes
+            rng_axes = (_bound_axes(("dp", "sharding", "sep", EP_AXIS))
+                        if mode == "bound" else ())
             fn = partial(_sparse_moe, E=E, k=k,
                          cf=self.capacity_factor, act=self.experts.act,
                          ep=ep_eff, ep_axis=EP_AXIS if ep_eff > 1 else None,
-                         token_axes=(), other_axes=())
+                         token_axes=(), other_axes=(),
+                         routing=routing, cap_rate=cap_rate,
+                         rng_axes=rng_axes)
 
         out, l_aux, dropped = apply_op(
-            fn, x2, logits,
+            fn, x2, logits, rng_bits,
             self.experts.w1, self.experts.b1, self.experts.w2, self.experts.b2,
-            name="moe_dispatch",
+            name="moe_dispatch", rng_args=(2,),
         )
         self.l_aux = l_aux
         self.tokens_dropped = dropped

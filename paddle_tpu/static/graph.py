@@ -152,7 +152,15 @@ class Program:
                 if d[0] == "var":
                     args.append(env[d[1]])
                 elif d[0] == "rng":
-                    args.append(jax.random.fold_in(jax.random.fold_in(d[1], k), counter))
+                    key = d[1]
+                    # keys may be recorded as raw uint32 bits (key_data) —
+                    # wrap before folding, hand back in the recorded form
+                    raw = (hasattr(key, "dtype")
+                           and key.dtype == jnp.uint32)
+                    if raw:
+                        key = jax.random.wrap_key_data(key)
+                    key = jax.random.fold_in(jax.random.fold_in(key, k), counter)
+                    args.append(jax.random.key_data(key) if raw else key)
                 else:
                     args.append(d[1])
             out = ins.fn(*args)

@@ -1,0 +1,144 @@
+"""Peer-addressed in-graph p2p + multi-device Group.rank (round-3 verdict
+items 6 / weak 3-4).
+
+Reference: distributed/fleet/meta_parallel/pp_utils/p2p_communication.py:52
+(send/recv between arbitrary ranks) and
+fluid/distributed/collective/process_group.h:205-234.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.collective import Group, _P2P_PENDING
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    yield
+    set_mesh(None)
+    _P2P_PENDING.clear()
+
+
+def _run_edge(n, src, dst, group=None):
+    """Run a send(src->dst) edge on an n-device 1-axis mesh; return the
+    per-device received values."""
+    mesh = build_mesh({"pg": n})
+
+    def body(x):
+        t = Tensor(x)
+        dist.send(t, dst=dst, group=group)
+        buf = Tensor(jnp.zeros_like(x))
+        dist.recv(buf, src=src, group=group)
+        return buf._value
+
+    f = shard_map(body, mesh=mesh, in_specs=P("pg"), out_specs=P("pg"))
+    x = np.arange(n, dtype=np.float32).reshape(n, 1) + 1.0  # device i holds i+1
+    return np.asarray(jax.jit(f)(x)).reshape(n)
+
+
+def test_send_rank0_to_rank2_in_4group():
+    g = dist.new_group(axes=("pg",))
+    out = _run_edge(4, src=0, dst=2, group=g)
+    # device 2 received device 0's value; everyone else zeros
+    np.testing.assert_allclose(out, [0.0, 0.0, 1.0, 0.0])
+
+
+def test_send_arbitrary_peer_pairs():
+    g = dist.new_group(axes=("pg",))
+    out = _run_edge(8, src=5, dst=1, group=g)
+    expect = np.zeros(8)
+    expect[1] = 6.0
+    np.testing.assert_allclose(out, expect)
+
+
+def test_two_edges_fifo_matching():
+    mesh = build_mesh({"pg": 4})
+    g = dist.new_group(axes=("pg",))
+
+    def body(x):
+        t = Tensor(x)
+        dist.send(t, dst=3, group=g)   # edge A: 0 -> 3
+        dist.send(t, dst=2, group=g)   # edge B: 1 -> 2
+        a = Tensor(jnp.zeros_like(x))
+        b = Tensor(jnp.zeros_like(x))
+        dist.recv(a, src=0, group=g)   # matches edge A
+        dist.recv(b, src=1, group=g)   # matches edge B
+        return a._value + b._value
+
+    f = shard_map(body, mesh=mesh, in_specs=P("pg"), out_specs=P("pg"))
+    x = np.arange(4, dtype=np.float32).reshape(4, 1) + 1.0
+    out = np.asarray(jax.jit(f)(x)).reshape(4)
+    np.testing.assert_allclose(out, [0.0, 0.0, 2.0, 1.0])
+
+
+def test_unmatched_recv_raises():
+    mesh = build_mesh({"pg": 4})
+    g = dist.new_group(axes=("pg",))
+
+    def body(x):
+        buf = Tensor(jnp.zeros_like(x))
+        dist.recv(buf, src=0, group=g)
+        return buf._value
+
+    f = shard_map(body, mesh=mesh, in_specs=P("pg"), out_specs=P("pg"))
+    with pytest.raises(RuntimeError, match="no matching send"):
+        jax.jit(f)(np.zeros((4, 1), np.float32))
+
+
+def test_partial_send_recv_in_graph():
+    mesh = build_mesh({"pg": 4})
+    g = dist.new_group(axes=("pg",))
+
+    def body(x):
+        t = Tensor(x.reshape(-1))
+        dist.partial_send(t, dst=2, nranks=2, rank_id=1, group=g)
+        buf = Tensor(jnp.zeros(4, x.dtype))
+        dist.partial_recv(buf, src=0, nranks=2, rank_id=1, group=g)
+        return buf._value.reshape(x.shape)
+
+    f = shard_map(body, mesh=mesh, in_specs=P("pg"), out_specs=P("pg"))
+    x = np.tile(np.arange(4, dtype=np.float32), (4, 1))
+    x = x * (np.arange(4)[:, None] + 1)  # device i holds (i+1)*[0,1,2,3]
+    out = np.asarray(jax.jit(f)(x))
+    # device 2 got device 0's second half into its second half
+    np.testing.assert_allclose(out[2], [0.0, 0.0, 2.0, 3.0])
+    np.testing.assert_allclose(out[1], np.zeros(4))
+
+
+class TestGroupRankMultiDevice:
+    def test_one_to_one_mapping(self):
+        build_mesh({"dp": 4, "mp": 2})
+        g = Group(id=99, axes=("dp",))
+        # single-process world=1: rank 0 at dp position 0
+        assert g.get_group_rank(0) == 0
+
+    def test_multi_device_process_coords(self, monkeypatch):
+        # simulate 2 processes × 4 devices: process r owns one dp row
+        # spanning all of mp (the standard chips-per-host layout)
+        class FakeDev:
+            def __init__(self, pi):
+                self.process_index = pi
+
+        class FakeMesh:
+            shape = {"dp": 2, "mp": 4}
+            axis_names = ("dp", "mp")
+            devices = np.array([[FakeDev(r) for _ in range(4)]
+                                for r in range(2)], dtype=object)
+
+        import paddle_tpu.distributed.collective as C
+        monkeypatch.setattr(C, "get_mesh", lambda: FakeMesh())
+        monkeypatch.setattr(C, "get_world_size", lambda: 2)
+        g = Group(id=98, axes=("dp",))
+        # process 1's devices all sit at dp=1 -> dp position 1
+        assert g._axis_position(1) == 1
+        assert g._axis_position(0) == 0
+        # along mp the process spans all 4 positions -> undefined
+        gmp = Group(id=97, axes=("mp",))
+        assert gmp._axis_position(0) is None

@@ -100,9 +100,15 @@ def main():
     # rank0 shard sums to 8, rank1 to 16
     np.testing.assert_allclose(np.asarray(total), 24.0)
 
-    # ---- eager DDP: allreduce-averaged grads => identical losses ----------
-    paddle.seed(7)
-    model = paddle.nn.Linear(8, 1)
+    # ---- eager DDP through the PUBLIC wrapper: param broadcast at wrap +
+    # hook-driven allreduce-averaged grads => identical losses ---------------
+    from paddle_tpu.distributed import multiproc
+
+    paddle.seed(7 + rank * 31)  # deliberately DIFFERENT init per rank
+    model = paddle.DataParallel(paddle.nn.Linear(8, 1))
+    # wrap must have broadcast rank0's params to everyone
+    w0 = multiproc.broadcast_np(model.weight.numpy(), src=0)
+    np.testing.assert_allclose(model.weight.numpy(), w0, rtol=0, atol=0)
     opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
     rng = np.random.RandomState(100 + rank)  # different per-rank data
     eval_x = paddle.to_tensor(np.linspace(0, 1, 32, dtype=np.float32).reshape(4, 8))
@@ -112,18 +118,31 @@ def main():
         x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
         y = paddle.to_tensor(rng.randn(4, 1).astype(np.float32))
         loss = ((model(x) - y) ** 2).mean()
-        loss.backward()
-        for p in model.parameters():
-            if p.grad is not None:
-                dist.all_reduce(p.grad, op=dist.ReduceOp.AVG)
+        loss.backward()  # hooks allreduce-average grads; no manual sync
         opt.step()
         opt.clear_grad()
         eval_loss = float(((model(eval_x) - eval_y) ** 2).mean())
         losses.append(eval_loss)
-    from paddle_tpu.distributed import multiproc
 
     all_losses = multiproc.exchange_objects(losses)
     np.testing.assert_allclose(all_losses[0], all_losses[1], rtol=0, atol=0)
+
+    # no_sync: local accumulation diverges, the next synced backward reduces
+    # the WHOLE accumulated grad (reference EagerReducer/no_sync semantics)
+    with model.no_sync():
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(4, 1).astype(np.float32))
+        (((model(x) - y) ** 2).mean()).backward()
+    g_local = model.weight.grad.numpy().copy()
+    g_other = multiproc.allgather_np(g_local)
+    check(not np.allclose(g_other[0], g_other[1]),
+          "no_sync grads should differ across ranks")
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(4, 1).astype(np.float32))
+    (((model(x) - y) ** 2).mean()).backward()
+    g_synced = multiproc.allgather_np(model.weight.grad.numpy())
+    np.testing.assert_allclose(g_synced[0], g_synced[1], rtol=0, atol=1e-6)
+    opt.clear_grad()
 
     # collective API tail across real processes: scatter_object_list hands
     # each rank its own object; backend/availability probes agree
