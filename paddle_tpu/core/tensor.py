@@ -122,7 +122,13 @@ class Tensor:
     def __bool__(self):
         if self.size != 1:
             raise ValueError("truth value of a multi-element Tensor is ambiguous")
-        return bool(self._value)
+        try:
+            return bool(self._value)
+        except jax.errors.TracerBoolConversionError as e:
+            from paddle_tpu.jit.dy2static import (Dy2StaticControlFlowError,
+                                                  GUIDANCE)
+
+            raise Dy2StaticControlFlowError(GUIDANCE) from e
 
     def __len__(self):
         if not self._value.shape:

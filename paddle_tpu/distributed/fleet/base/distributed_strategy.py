@@ -51,6 +51,9 @@ class DistributedStrategy:
         self.fuse_grad_size_in_MB = 32
         self.without_graph_optimization = False
         self.a_sync = False
+        # everything set above is the honored surface; later unknown sets warn
+        # (reference validates via protobuf, distributed_strategy.py:1765)
+        object.__setattr__(self, "_known", set(self.__dict__))
 
     @property
     def hybrid_configs_dict(self):
@@ -60,11 +63,15 @@ class DistributedStrategy:
     def to_dict(self) -> dict:
         out = {}
         for k, v in self.__dict__.items():
+            if k.startswith("_"):  # internal state is not strategy surface
+                continue
             out[k] = dict(v) if isinstance(v, dict) else v
         return out
 
     def from_dict(self, d: dict):
         for k, v in d.items():
+            if k.startswith("_"):
+                continue
             setattr(self, k, v)
         return self
 
@@ -87,6 +94,30 @@ class DistributedStrategy:
             cfg.update(v)
             object.__setattr__(self, k, cfg)
             return
+        known = self.__dict__.get("_known")
+        if known is not None and not k.startswith("_") and k not in known:
+            import warnings
+
+            warnings.warn(
+                f"DistributedStrategy: unknown option {k!r} is stored but has "
+                "no effect in this build (the honored subset is "
+                f"{sorted(x for x in known if not x.startswith('_'))})",
+                stacklevel=2)
+        elif (known is not None and k in known and k.endswith("_configs")
+                and isinstance(v, dict)):
+            cur = self.__dict__.get(k)
+            if isinstance(cur, dict):
+                bad = set(v) - set(cur)
+                if bad:
+                    import warnings
+
+                    warnings.warn(
+                        f"DistributedStrategy.{k}: unknown keys {sorted(bad)} "
+                        f"are stored but ignored (known: {sorted(cur)})",
+                        stacklevel=2)
+                merged = dict(cur)
+                merged.update(v)
+                v = merged
         object.__setattr__(self, k, v)
 
     def __repr__(self):

@@ -133,18 +133,54 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._dist_model = None
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
             [metrics] if metrics else [])
+        # distributed-aware fit (reference model.py:1750 _run_one_epoch under
+        # fleet): with an active mesh, training steps run through the
+        # DistModel compiled sharded train step instead of eager backward
+        self._dist_model = None
+        from paddle_tpu.distributed.mesh import get_mesh
+
+        if get_mesh() is not None and optimizer is not None and loss is not None:
+            from paddle_tpu.distributed.auto_parallel.api import DistModel
+
+            self._dist_model = DistModel(self.network, loss=loss,
+                                         optimizer=optimizer)
+
+    def _sync_dist(self):
+        """Pull trained params back to the eager layer — only when the
+        compiled step actually advanced them since the last sync (a full
+        param-tree copy otherwise repeats per eval/predict batch)."""
+        if self._dist_model is not None and getattr(self, "_dist_dirty", False):
+            self._dist_model._sync()
+            self._dist_dirty = False
 
     # -- steps ---------------------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        if self._dist_model is not None and update and labels:
+            self._dist_model.train()
+            loss = self._dist_model(*inputs, labels[0])
+            self._dist_dirty = True
+            metrics = {"loss": float(loss)}
+            if self._metrics:
+                # user-configured metrics need logits: sync + eager forward
+                # (the compiled step returns only the loss)
+                self._sync_dist()
+                with paddle.no_grad():
+                    outs = self.network(*inputs)
+                for m in self._metrics:
+                    m.update(m.compute(outs, labels[0]))
+                    metrics[m.name()] = m.accumulate()
+            return metrics
+        self._sync_dist()  # eager fallback must not train stale params
         outs = self.network(*inputs)
         loss = self._loss(outs, *labels) if self._loss else outs
         loss.backward()
@@ -158,6 +194,7 @@ class Model:
         return metrics
 
     def eval_batch(self, inputs, labels=None):
+        self._sync_dist()
         self.network.eval()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
@@ -171,6 +208,7 @@ class Model:
         return metrics
 
     def predict_batch(self, inputs):
+        self._sync_dist()
         self.network.eval()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         with paddle.no_grad():
@@ -255,6 +293,7 @@ class Model:
 
     # -- persistence ----------------------------------------------------------
     def save(self, path, training=True):
+        self._sync_dist()
         paddle.save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             paddle.save(self._optimizer.state_dict(), path + ".pdopt")

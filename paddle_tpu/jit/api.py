@@ -162,21 +162,50 @@ class StaticFunction:
             else:
                 args_vals, bucket_info = self._pad_to_buckets(args_vals)
 
-        if needs_grad or in_grad:
-            # whole-program forward + whole-program vjp through the tape
-            flat_p = [p._value for p in params]
+        from paddle_tpu.jit.dy2static import (Dy2StaticControlFlowError,
+                                              convert_control_flow)
 
-            def f(*pv):
-                return self._pure(pv, args_vals, kwargs_vals)
+        for attempt in range(2):
+            try:
+                if needs_grad or in_grad:
+                    # whole-program forward + whole-program vjp through the tape
 
-            out = apply_op(f, *params, name=f"to_static:{self._fn.__name__}")
-            return _rewrap(out)
+                    def f(*pv):
+                        return self._pure(pv, args_vals, kwargs_vals)
 
-        if self._jitted is None:
-            self._jitted = jax.jit(
-                lambda pv, av, kv: self._pure(pv, av, kv),
-            )
-        out_vals = self._jitted([p._value for p in params], args_vals, kwargs_vals)
+                    out = apply_op(f, *params,
+                                   name=f"to_static:{self._fn.__name__}")
+                    return _rewrap(out)
+
+                if self._jitted is None:
+                    self._jitted = jax.jit(
+                        lambda pv, av, kv: self._pure(pv, av, kv),
+                    )
+                out_vals = self._jitted([p._value for p in params], args_vals,
+                                        kwargs_vals)
+                break
+            except Dy2StaticControlFlowError:
+                # data-dependent Python control flow hit the trace: try the
+                # dy2static AST pass once (reference jit/dy2static/), else
+                # surface the guided error
+                if attempt == 1 or getattr(self._fn, "__dy2static_converted__",
+                                           False):
+                    raise
+                target = self._fn
+                bound_self = getattr(target, "__self__", None)
+                conv = convert_control_flow(
+                    target.__func__ if bound_self is not None else target)
+                if conv is None:
+                    raise
+                if bound_self is not None:
+                    # re-bind a converted forward to its layer
+                    def _bound(*a, _c=conv, _s=bound_self, **k):
+                        return _c(_s, *a, **k)
+
+                    _bound.__dy2static_converted__ = True
+                    conv = _bound
+                self._fn = conv
+                self._jitted = None
         if bucket_info is not None:
             out_vals = self._slice_outputs(out_vals, *bucket_info)
         return jax.tree_util.tree_map(lambda v: Tensor(v) if _is_arr(v) else v, out_vals)

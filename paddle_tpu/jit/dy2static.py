@@ -1,0 +1,300 @@
+"""dy2static control-flow conversion (restricted AST pass) + guided errors.
+
+Reference parity: paddle.jit's SOT bytecode capture (jit/sot/translate.py:32)
+and the AST dy2static package (jit/dy2static/) convert data-dependent Python
+control flow (`if tensor:`, `while tensor:`, `for i in range(tensor):`) into
+graph ops. TPU-native design: capture-by-trace makes ordinary Python the
+translator, so only DATA-DEPENDENT control flow needs help. Two pieces:
+
+1. Detection: `Tensor.__bool__` under a jax trace raises
+   `Dy2StaticControlFlowError` naming `paddle.jit.cond/while_loop` (instead
+   of jax's tracer-leak message).
+2. Conversion: `convert_control_flow(fn)` rewrites SIMPLE tensor-conditioned
+   `if`/`while`/`for ... in range(...)` statements (straight-line bodies that
+   only assign local names — no return/break/continue/yield) into
+   `lax.cond` / `lax.while_loop` / `lax.fori_loop` calls.
+   `StaticFunction.__call__` retries with the converted function when the
+   first trace hits the detection error; unconvertible functions re-raise
+   the guided message.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Dy2StaticControlFlowError", "convert_control_flow"]
+
+GUIDANCE = (
+    "data-dependent Python control flow reached a traced Tensor "
+    "(`if`/`while` on a tensor value, or bool() during jit/to_static "
+    "capture). Rewrite with paddle_tpu.jit.cond / paddle_tpu.jit.while_loop "
+    "/ paddle_tpu.jit.scan (compiled lax control flow), or keep the branch "
+    "simple (straight-line assignments only) so to_static's dy2static AST "
+    "pass can convert it automatically."
+)
+
+
+class Dy2StaticControlFlowError(TypeError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# runtime helpers injected into converted functions
+
+
+def _v(x):
+    from paddle_tpu.core.tensor import Tensor
+
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_v(x), jax.core.Tracer)
+
+
+def _wrap_out(vals):
+    from paddle_tpu.core.tensor import Tensor
+
+    return tuple(Tensor(v) if isinstance(v, (jax.Array, jnp.ndarray))
+                 or isinstance(v, jax.core.Tracer) else v for v in vals)
+
+
+def _unwrap_tuple(t):
+    return tuple(jnp.asarray(_v(x)) for x in t)
+
+
+def _pt_cvt_if(cond, true_fn, false_fn, env):
+    if not _is_traced(cond):
+        return true_fn(env) if bool(_v(cond)) else false_fn(env)
+
+    def br(fn):
+        def g(_):
+            return _unwrap_tuple(fn(env))
+
+        return g
+
+    outs = jax.lax.cond(jnp.asarray(_v(cond)).astype(bool),
+                        br(true_fn), br(false_fn), None)
+    return _wrap_out(outs)
+
+
+def _pt_cvt_while(cond_fn, body_fn, carry):
+    from paddle_tpu.core.tensor import Tensor
+
+    probe = cond_fn(tuple(carry))
+    if not _is_traced(probe) and not any(_is_traced(c) for c in carry):
+        carry = tuple(carry)
+        while bool(_v(cond_fn(carry))):
+            carry = tuple(body_fn(carry))
+        return carry
+
+    def c(cu):
+        return jnp.asarray(_v(cond_fn(_wrap_out(cu)))).astype(bool)
+
+    def b(cu):
+        return _unwrap_tuple(body_fn(_wrap_out(cu)))
+
+    outs = jax.lax.while_loop(c, b, _unwrap_tuple(carry))
+    return _wrap_out(outs)
+
+
+def _pt_cvt_for(n, body_fn, carry):
+    if not _is_traced(n):
+        carry = tuple(carry)
+        for i in range(int(_v(n))):
+            carry = tuple(body_fn(i, carry))
+        return carry
+
+    def b(i, cu):
+        from paddle_tpu.core.tensor import Tensor
+
+        return _unwrap_tuple(body_fn(Tensor(i), _wrap_out(cu)))
+
+    outs = jax.lax.fori_loop(0, jnp.asarray(_v(n)).astype(jnp.int32),
+                             b, _unwrap_tuple(carry))
+    return _wrap_out(outs)
+
+
+_HELPERS = {"__pt_cvt_if": _pt_cvt_if, "__pt_cvt_while": _pt_cvt_while,
+            "__pt_cvt_for": _pt_cvt_for}
+
+
+# --------------------------------------------------------------------------
+# the AST pass
+
+
+def _collect_assigned(stmts) -> set:
+    names = set()
+
+    def tgt(t):
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                tgt(e)
+
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    tgt(t)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                tgt(node.target)
+    return names
+
+
+def _straight_line(stmts) -> bool:
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, (ast.Return, ast.Break, ast.Continue,
+                                 ast.Yield, ast.YieldFrom, ast.Raise,
+                                 ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.Global, ast.Nonlocal)):
+                return False
+    return True
+
+
+def _names_tuple(names, ctx):
+    return ast.Tuple([ast.Name(n, ctx()) for n in names], ctx())
+
+
+def _fndef(name, argnames, body):
+    args = ast.arguments(posonlyargs=[], args=[ast.arg(a) for a in argnames],
+                         vararg=None, kwonlyargs=[], kw_defaults=[],
+                         kwarg=None, defaults=[])
+    return ast.FunctionDef(name=name, args=args, body=body,
+                           decorator_list=[], returns=None, type_params=[])
+
+
+class _Transformer(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+        self.converted = 0
+
+    def _unpack(self, names, src_name):
+        return ast.Assign(
+            targets=[_names_tuple(names, ast.Store)],
+            value=ast.Name(src_name, ast.Load()))
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if not (_straight_line(node.body) and _straight_line(node.orelse)):
+            return node
+        names = sorted(_collect_assigned(node.body)
+                       | _collect_assigned(node.orelse))
+        if not names:
+            return node
+        i = self.n
+        self.n += 1
+        self.converted += 1
+        # branch defs take the enclosing locals() so names read-then-assigned
+        # inside a branch see their current outer values
+        prelude = [ast.Assign(
+            targets=[ast.Name(n, ast.Store())],
+            value=ast.Call(
+                ast.Attribute(ast.Name("__pt_env", ast.Load()), "get",
+                              ast.Load()),
+                [ast.Constant(n)], [])) for n in names]
+        ret = ast.Return(_names_tuple(names, ast.Load))
+        tdef = _fndef(f"__pt_true_{i}", ["__pt_env"],
+                      prelude + list(node.body) + [ret])
+        fdef = _fndef(f"__pt_false_{i}", ["__pt_env"],
+                      prelude + (list(node.orelse) or [ast.Pass()]) + [ret])
+        assign = ast.Assign(
+            targets=[_names_tuple(names, ast.Store)],
+            value=ast.Call(ast.Name("__pt_cvt_if", ast.Load()),
+                           [node.test,
+                            ast.Name(f"__pt_true_{i}", ast.Load()),
+                            ast.Name(f"__pt_false_{i}", ast.Load()),
+                            ast.Call(ast.Name("locals", ast.Load()), [], [])],
+                           []))
+        return [tdef, fdef, assign]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or not _straight_line(node.body):
+            return node
+        names = sorted(_collect_assigned(node.body))
+        if not names:
+            return node
+        i = self.n
+        self.n += 1
+        self.converted += 1
+        unpack = self._unpack(names, "__pt_c")
+        cdef = _fndef(f"__pt_cond_{i}", ["__pt_c"],
+                      [unpack, ast.Return(node.test)])
+        bdef = _fndef(f"__pt_body_{i}", ["__pt_c"],
+                      [unpack] + list(node.body)
+                      + [ast.Return(_names_tuple(names, ast.Load))])
+        assign = ast.Assign(
+            targets=[_names_tuple(names, ast.Store)],
+            value=ast.Call(ast.Name("__pt_cvt_while", ast.Load()),
+                           [ast.Name(f"__pt_cond_{i}", ast.Load()),
+                            ast.Name(f"__pt_body_{i}", ast.Load()),
+                            _names_tuple(names, ast.Load)], []))
+        return [cdef, bdef, assign]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (node.orelse or not _straight_line(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range"
+                        and len(node.iter.args) == 1)):
+            return node
+        names = sorted(_collect_assigned(node.body) - {node.target.id})
+        if not names:
+            return node
+        i = self.n
+        self.n += 1
+        self.converted += 1
+        unpack = self._unpack(names, "__pt_c")
+        bind_i = ast.Assign(targets=[ast.Name(node.target.id, ast.Store())],
+                            value=ast.Name("__pt_i", ast.Load()))
+        bdef = _fndef(f"__pt_body_{i}", ["__pt_i", "__pt_c"],
+                      [unpack, bind_i] + list(node.body)
+                      + [ast.Return(_names_tuple(names, ast.Load))])
+        assign = ast.Assign(
+            targets=[_names_tuple(names, ast.Store)],
+            value=ast.Call(ast.Name("__pt_cvt_for", ast.Load()),
+                           [node.iter.args[0],
+                            ast.Name(f"__pt_body_{i}", ast.Load()),
+                            _names_tuple(names, ast.Load)], []))
+        return [bdef, assign]
+
+
+def convert_control_flow(fn):
+    """AST-convert simple tensor-conditioned if/while/for in `fn`.
+    Returns the converted function, or None when nothing was (or could be)
+    converted — closures, unavailable source, or no convertible statements."""
+    if getattr(fn, "__code__", None) is None or fn.__code__.co_freevars:
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        return None
+    fdef.decorator_list = []  # don't re-apply @to_static etc.
+    tr = _Transformer()
+    tree = tr.visit(tree)
+    if tr.converted == 0:
+        return None
+    ast.fix_missing_locations(tree)
+    code = compile(tree, f"<dy2static:{fn.__name__}>", "exec")
+    ns = dict(fn.__globals__)
+    ns.update(_HELPERS)
+    exec(code, ns)
+    out = ns[fdef.name]
+    out.__dy2static_converted__ = True
+    return out

@@ -6,3 +6,4 @@ shardings on ONE compiled XLA program instead of per-rank programs + NCCL.
 """
 from paddle_tpu.parallel.train_step import CompiledTrainStep, functional_call  # noqa: F401
 from paddle_tpu.parallel import pipeline_schedules  # noqa: F401
+from paddle_tpu.parallel.zero_bubble import ZBH1PipelinedStep  # noqa: F401

@@ -1,0 +1,349 @@
+"""Executable ZB-H1 zero-bubble pipeline schedule.
+
+Reference parity: pipeline_zero_bubble.py (distributed/passes/
+pipeline_scheduler_pass/) executes {F, B, W} job lists per rank, where the
+backward is split into B (activation grad — on the inter-stage critical path)
+and W (weight grad — no downstream consumer, fills the drain bubble).
+
+TPU-native design: the zb_h1_schedule tick TABLE (pipeline_schedules.py) is
+compiled into ONE XLA program — a lax.scan over ticks inside shard_map over
+the 'pp' axis. Each tick every rank dispatches its scheduled op through
+lax.switch (idle/F/B/W branches are collective-free; the two ppermutes — one
+forward activation hop, one backward cotangent hop — run unconditionally
+every tick, so SPMD ranks never diverge on collectives). Microbatch-keyed
+stashes carry (stage input, arriving cotangent) between F, B and W ticks;
+their capacities are computed statically from the table (max live window).
+
+Cost accounting (honest): B and W each re-run the stage forward (vjp-based
+split — the same recompute a remat'd 1F1B backward performs once), so one
+microbatch costs F + (F+Bx) + (F+Bw) FLOPs vs remat-1F1B's F + (F+Bx+Bw):
+one extra forward per microbatch buys the bubble reduction. The parity test
+checks grads match the dense model exactly; the probe measures the idle
+(bubble) fraction against the compiled 1F1B runtime's.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.mesh import get_mesh
+from paddle_tpu.distributed.mesh import shard_map_compat as _shard_map
+from paddle_tpu.parallel.pipeline_schedules import zb_h1_schedule
+from paddle_tpu.parallel.train_step import functional_call
+
+__all__ = ["ZBH1PipelinedStep"]
+
+_OP = {"F": 1, "B": 2, "W": 3}
+
+
+def _tables(sched, S):
+    """numpy per-tick tables from a schedule dict: op/mb codes plus the
+    arrival tables (what lands on each rank at the START of tick t = what its
+    neighbor sent at t-1)."""
+    ticks = sched["ticks"]
+    T = len(ticks)
+    op = np.zeros((T, S), np.int32)
+    mb = np.zeros((T, S), np.int32)
+    for t, row in enumerate(ticks):
+        for r, cell in enumerate(row):
+            if cell is not None:
+                op[t, r] = _OP[cell[0]]
+                mb[t, r] = cell[1]
+    arr_f_valid = np.zeros((T, S), bool)
+    arr_f_mb = np.zeros((T, S), np.int32)
+    arr_b_valid = np.zeros((T, S), bool)
+    arr_b_mb = np.zeros((T, S), np.int32)
+    for t in range(1, T):
+        for r in range(S):
+            if r > 0 and op[t - 1, r - 1] == _OP["F"]:
+                arr_f_valid[t, r] = True
+                arr_f_mb[t, r] = mb[t - 1, r - 1]
+            if r < S - 1 and op[t - 1, r + 1] == _OP["B"]:
+                arr_b_valid[t, r] = True
+                arr_b_mb[t, r] = mb[t - 1, r + 1]
+    return op, mb, arr_f_valid, arr_f_mb, arr_b_valid, arr_b_mb
+
+
+def _stash_capacity(sched, S, M):
+    """Max (next_f - next_w) span over the run: microbatch slots live from
+    first touch until their W completes, and per-rank F/B/W are monotone in
+    mb, so mb %% cap is collision-free when cap covers the widest window."""
+    done = {k: [[-1] * M for _ in range(S)] for k in "FBW"}
+    span = 1
+    prog = {k: [0] * S for k in "FBW"}
+    for row in sched["ticks"]:
+        for r, cell in enumerate(row):
+            if cell is not None:
+                kind, m, _ = cell
+                done[kind][r][m] = 1
+                prog[kind][r] = m + 1
+        for r in range(S):
+            span = max(span, prog["F"][r] - prog["W"][r])
+    return span + 1
+
+
+class ZBH1PipelinedStep:
+    """ZB-H1 for (embed, blocks, head) models on a pp-only mesh.
+
+    run(ids, labels) -> (loss, (embed_grads, stacked_block_grads, head_grads))
+    with grads numerically equal to the dense model's (parity-tested).
+    ids/labels: [M * mb_size, seq]-style arrays split into M microbatches on
+    the leading dim."""
+
+    def __init__(self, embed_layer, blocks: Sequence, head_layer,
+                 loss_fn: Callable, mesh: Mesh | None = None,
+                 num_micro: int = 2, seed: int = 0):
+        self.mesh = mesh if mesh is not None else get_mesh()
+        if self.mesh is None or "pp" not in self.mesh.shape:
+            raise ValueError("ZBH1PipelinedStep requires a mesh with a 'pp' axis")
+        self.S = int(self.mesh.shape["pp"])
+        if len(blocks) % self.S != 0:
+            raise ValueError(f"{len(blocks)} blocks not divisible by pp={self.S}")
+        self.bps = len(blocks) // self.S
+        self.M = int(num_micro)
+        self.embed = embed_layer
+        self.blocks = list(blocks)
+        self.head = head_layer
+        self.loss_fn = loss_fn
+        self._key = jax.random.key(seed)
+
+        self.sched = zb_h1_schedule(self.S, self.M)
+        (self._op, self._mb, self._afv, self._afm, self._abv,
+         self._abm) = _tables(self.sched, self.S)
+        self.T = len(self.sched["ticks"])
+        self.cap = _stash_capacity(self.sched, self.S, self.M)
+
+        mesh = self.mesh
+        self._embed_params = embed_layer.parameters()
+        self._head_params = head_layer.parameters()
+        self._block_params = [b.parameters() for b in blocks]
+        nb = len(self._block_params[0])
+        stacked = []
+        for i in range(nb):
+            vals = [bp[i]._value for bp in self._block_params]
+            stacked.append(jnp.stack(vals).reshape(
+                (self.S, self.bps) + vals[0].shape))
+        self._block_specs = [
+            PartitionSpec("pp", *([None] * (a.ndim - 1))) for a in stacked]
+        self._stacked_blocks = [
+            jax.device_put(a, NamedSharding(mesh, s))
+            for a, s in zip(stacked, self._block_specs)]
+        self._embed_vals = [jax.device_put(p._value, NamedSharding(mesh, PartitionSpec()))
+                            for p in self._embed_params]
+        self._head_vals = [jax.device_put(p._value, NamedSharding(mesh, PartitionSpec()))
+                           for p in self._head_params]
+        self._jitted = None
+
+    # -- pure per-rank compute pieces ---------------------------------------
+
+    def _stage_fwd(self, stage_params, x):
+        def one_block(h, layer_params):
+            out = functional_call(self.blocks[0], layer_params, (Tensor(h),))
+            return out._value if isinstance(out, Tensor) else out, None
+
+        h, _ = jax.lax.scan(one_block, x, stage_params)
+        return h
+
+    def _embed_fwd(self, embed_vals, ids_mb):
+        out = functional_call(self.embed, embed_vals, (Tensor(ids_mb),))
+        return out._value if isinstance(out, Tensor) else out
+
+    def _last_chain(self, stage_params, head_vals, x, labels_mb):
+        """loss(head(stage(x))) for the last rank."""
+        y = self._stage_fwd(stage_params, x)
+        h = functional_call(self.head, head_vals, (Tensor(y),))
+        hv = h._value if isinstance(h, Tensor) else h
+        loss = self.loss_fn(Tensor(hv), Tensor(labels_mb))
+        return (loss._value if isinstance(loss, Tensor) else loss).astype(jnp.float32)
+
+    # -- the compiled schedule ----------------------------------------------
+
+    def _build(self, mb_shape, ids_dtype):
+        mesh, S, M, T, cap = self.mesh, self.S, self.M, self.T, self.cap
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        op_t = jnp.asarray(self._op)
+        mb_t = jnp.asarray(self._mb)
+        afv_t = jnp.asarray(self._afv)
+        afm_t = jnp.asarray(self._afm)
+        abv_t = jnp.asarray(self._abv)
+        abm_t = jnp.asarray(self._abm)
+
+        def body(stacked_local, embed_vals, head_vals, ids_mb, labels_mb):
+            rank = jax.lax.axis_index("pp")
+            stage_params = [a[0] for a in stacked_local]
+            act_shape = mb_shape  # stage in/out share the shape (residual nets)
+
+            zero_act = jnp.zeros(act_shape, jnp.float32)
+            state = dict(
+                instash=jnp.zeros((cap,) + act_shape, jnp.float32),
+                dystash=jnp.zeros((cap,) + act_shape, jnp.float32),
+                out_f=zero_act,
+                out_b=zero_act,
+                fwd_in=zero_act,
+                bwd_in=zero_act,
+                g_stage=[jnp.zeros_like(p) for p in stage_params],
+                g_embed=[jnp.zeros_like(v) for v in embed_vals],
+                g_head=[jnp.zeros_like(v) for v in head_vals],
+                loss=jnp.zeros((), jnp.float32),
+            )
+
+            def set_slot(buf, m, val):
+                return jax.lax.dynamic_update_index_in_dim(
+                    buf, val, m % cap, 0)
+
+            def get_slot(buf, m):
+                return jax.lax.dynamic_index_in_dim(buf, m % cap, 0,
+                                                    keepdims=False)
+
+            def idle_br(state, m):
+                return state
+
+            def f_br(state, m):
+                x = jnp.where(rank == 0,
+                              self._embed_fwd(embed_vals, ids_mb[m]),
+                              get_slot(state["instash"], m))
+                y = self._stage_fwd(stage_params, x)
+                st = dict(state)
+                st["instash"] = set_slot(state["instash"], m, x)
+                st["out_f"] = y
+                return st
+
+            def b_br(state, m):
+                x = get_slot(state["instash"], m)
+                dy = get_slot(state["dystash"], m)
+
+                def last_case(_):
+                    # cotangent 1/M: run() reports the MEAN microbatch loss
+                    lval, vjp = jax.vjp(
+                        lambda xx: self._last_chain(stage_params, head_vals,
+                                                    xx, labels_mb[m]), x)
+                    (dx,) = vjp(jnp.asarray(1.0 / M, jnp.float32))
+                    return dx, lval
+
+                def mid_case(_):
+                    _, vjp = jax.vjp(
+                        lambda xx: self._stage_fwd(stage_params, xx), x)
+                    (dx,) = vjp(dy)
+                    return dx, jnp.zeros((), jnp.float32)
+
+                dx, lval = jax.lax.cond(rank == S - 1, last_case, mid_case,
+                                        None)
+
+                def embed_case(_):
+                    _, evjp = jax.vjp(
+                        lambda ev: self._embed_fwd(ev, ids_mb[m]), embed_vals)
+                    (ge,) = evjp(dx)
+                    return list(ge)
+
+                def no_embed(_):
+                    return [jnp.zeros_like(v) for v in embed_vals]
+
+                ge = jax.lax.cond(rank == 0, embed_case, no_embed, None)
+                st = dict(state)
+                st["out_b"] = dx
+                st["g_embed"] = [a + b for a, b in zip(state["g_embed"], ge)]
+                st["loss"] = state["loss"] + lval / M
+                return st
+
+            def w_br(state, m):
+                x = get_slot(state["instash"], m)
+                dy = get_slot(state["dystash"], m)
+
+                def last_case(_):
+                    _, vjp = jax.vjp(
+                        lambda sp, hv: self._last_chain(sp, hv, x,
+                                                        labels_mb[m]),
+                        stage_params, head_vals)
+                    gs, gh = vjp(jnp.asarray(1.0 / M, jnp.float32))
+                    return list(gs), list(gh)
+
+                def mid_case(_):
+                    _, vjp = jax.vjp(
+                        lambda sp: self._stage_fwd(sp, x), stage_params)
+                    (gs,) = vjp(dy)
+                    return list(gs), [jnp.zeros_like(v) for v in head_vals]
+
+                gs, gh = jax.lax.cond(rank == S - 1, last_case, mid_case,
+                                      None)
+                gs, gh = list(gs), list(gh)
+                st = dict(state)
+                st["g_stage"] = [a + b for a, b in zip(state["g_stage"], gs)]
+                st["g_head"] = [a + b for a, b in zip(state["g_head"], gh)]
+                return st
+
+            def tick(state, t):
+                # 1. deliver arrivals (sent by neighbors at t-1)
+                my_op = op_t[t, rank]
+                my_mb = mb_t[t, rank]
+                afv = afv_t[t, rank]
+                abv = abv_t[t, rank]
+                afm = afm_t[t, rank]
+                abm = abm_t[t, rank]
+                inst = state["instash"]
+                inst = jnp.where(afv, set_slot(inst, afm, state["fwd_in"]),
+                                 inst)
+                dyst = state["dystash"]
+                dyst = jnp.where(abv, set_slot(dyst, abm, state["bwd_in"]),
+                                 dyst)
+                state = dict(state, instash=inst, dystash=dyst)
+                # 2. dispatch the scheduled op (collective-free branches)
+                state = jax.lax.switch(
+                    my_op,
+                    [idle_br, f_br, b_br, w_br],
+                    state, my_mb)
+                # 3. unconditional hops (every rank, every tick)
+                state = dict(
+                    state,
+                    fwd_in=jax.lax.ppermute(state["out_f"], "pp", fwd_perm),
+                    bwd_in=jax.lax.ppermute(state["out_b"], "pp", bwd_perm))
+                return state, None
+
+            state, _ = jax.lax.scan(tick, state, jnp.arange(T))
+            loss = jax.lax.psum(state["loss"], "pp")  # only last rank adds
+            # stack grads back over pp; embed/head grads live on one rank
+            g_stage = tuple(g[None] for g in state["g_stage"])
+            g_embed = tuple(jax.lax.psum(g, "pp") for g in state["g_embed"])
+            g_head = tuple(jax.lax.psum(g, "pp") for g in state["g_head"])
+            return loss, g_stage, g_embed, g_head
+
+        in_specs = (
+            tuple(self._block_specs),
+            tuple(PartitionSpec() for _ in self._embed_vals),
+            tuple(PartitionSpec() for _ in self._head_vals),
+            PartitionSpec(),
+            PartitionSpec(),
+        )
+        out_specs = (
+            PartitionSpec(),
+            tuple(self._block_specs),
+            tuple(PartitionSpec() for _ in self._embed_vals),
+            tuple(PartitionSpec() for _ in self._head_vals),
+        )
+        smapped = _shard_map(
+            lambda bl, ev, hv, i, l: body(bl, ev, hv, i, l),
+            self.mesh, in_specs, out_specs)
+        self._jitted = jax.jit(smapped)
+
+    def run(self, ids, labels):
+        """ids/labels: [M*mb, seq] numpy/jnp arrays."""
+        ids = np.asarray(ids)
+        labels = np.asarray(labels)
+        mbs = ids.shape[0] // self.M
+        ids_mb = jnp.asarray(ids.reshape((self.M, mbs) + ids.shape[1:]))
+        labels_mb = jnp.asarray(
+            labels.reshape((self.M, mbs) + labels.shape[1:]))
+        if self._jitted is None:
+            emb_probe = self._embed_fwd(self._embed_vals, ids_mb[0])
+            self._build(tuple(emb_probe.shape), ids_mb.dtype)
+        loss, g_stage, g_embed, g_head = self._jitted(
+            tuple(self._stacked_blocks), tuple(self._embed_vals),
+            tuple(self._head_vals), ids_mb, labels_mb)
+        return loss, (list(g_embed), list(g_stage), list(g_head))

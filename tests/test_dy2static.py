@@ -1,0 +1,139 @@
+"""dy2static control-flow detection + AST conversion (round-3 verdict item 8).
+
+Reference: jit/sot/translate.py:32 (bytecode capture) and jit/dy2static/
+(AST transform) convert tensor-conditioned Python control flow.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as jit
+from paddle_tpu.jit.dy2static import (Dy2StaticControlFlowError,
+                                      convert_control_flow)
+
+
+class TestDetection:
+    def test_bool_on_traced_tensor_raises_guided_error(self):
+        def f(x):
+            if x.sum() > 0:  # data-dependent branch, not convertible result
+                return x * 2
+            return x - 1
+
+        sf = jit.to_static(f)
+        with pytest.raises(Dy2StaticControlFlowError,
+                           match="cond.*while_loop|while_loop"):
+            sf(paddle.to_tensor(np.ones(4, np.float32)))
+
+    def test_eager_bool_still_works(self):
+        t = paddle.to_tensor(np.array([1.0], np.float32))
+        assert bool(t)
+
+
+def simple_if(x):
+    y = x * 2
+    if (x.sum() > 0):
+        y = y + 10.0
+        z = y * 2
+    else:
+        y = y - 10.0
+        z = y * 3
+    return z + y
+
+
+def simple_while(x):
+    s = x.sum()
+    n = paddle.to_tensor(np.float32(0.0)) * s  # traced zero
+    while (n < 3.0):
+        n = n + 1.0
+        s = s * 2.0
+    return s
+
+
+def simple_for(x):
+    acc = x
+    for i in range(x.shape[0]):
+        acc = acc + 1.0
+    return acc
+
+
+class TestConversion:
+    def test_if_converts_and_matches_eager(self):
+        x_pos = np.ones(4, np.float32)
+        x_neg = -np.ones(4, np.float32)
+        eager_pos = simple_if(paddle.to_tensor(x_pos))
+        eager_neg = simple_if(paddle.to_tensor(x_neg))
+        sf = jit.to_static(simple_if)
+        out_pos = sf(paddle.to_tensor(x_pos))
+        out_neg = sf(paddle.to_tensor(x_neg))
+        np.testing.assert_allclose(np.asarray(out_pos._value),
+                                   np.asarray(eager_pos._value), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out_neg._value),
+                                   np.asarray(eager_neg._value), atol=1e-6)
+
+    def test_while_converts_and_matches_eager(self):
+        x = np.full(3, 0.5, np.float32)
+        eager = simple_while(paddle.to_tensor(x))
+        sf = jit.to_static(simple_while)
+        out = sf(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.asarray(eager._value), atol=1e-6)
+
+    def test_convert_control_flow_direct(self):
+        conv = convert_control_flow(simple_if)
+        assert conv is not None and conv.__dy2static_converted__
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        np.testing.assert_allclose(
+            np.asarray(conv(x)._value),
+            np.asarray(simple_if(x)._value), atol=1e-6)
+
+    def test_unconvertible_returns_none(self):
+        def with_return_in_branch(x):
+            if x.sum() > 0:
+                return x
+            return -x
+
+        assert convert_control_flow(with_return_in_branch) is None
+
+    def test_unconvertible_raises_guided_error_via_to_static(self):
+        def f(x):
+            if x.sum() > 0:  # early return: AST pass must refuse
+                return x * 2
+            return x
+
+        sf = jit.to_static(f)
+        with pytest.raises(Dy2StaticControlFlowError):
+            sf(paddle.to_tensor(np.ones(3, np.float32)))
+
+    def test_layer_forward_bound_method_converts(self):
+        import paddle_tpu.nn as nn
+
+        class Gated(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                y = self.fc(x)
+                if (x.sum() > 0):
+                    y = y + 1.0
+                else:
+                    y = y - 1.0
+                return y
+
+        paddle.seed(0)
+        layer = Gated()
+        eager_pos = layer(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        sf = jit.to_static(layer)
+        with paddle.no_grad():
+            out = sf(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.asarray(eager_pos._value), atol=1e-6)
+
+    def test_converted_if_gradients_flow(self):
+        conv = convert_control_flow(simple_if)
+        x = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+        out = conv(x)
+        out.sum().backward()
+        # d/dx of branch (x>0): z + y where y = 2x+10, z = 2y -> 3y -> d=6
+        np.testing.assert_allclose(np.asarray(x.grad._value),
+                                   np.full(4, 6.0), atol=1e-5)

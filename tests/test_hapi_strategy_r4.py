@@ -1,0 +1,136 @@
+"""hapi metrics/distributed fit + DistributedStrategy validation (round-3
+verdict item 9).
+
+Reference: hapi/model.py:1750 (metric aggregation in fit/evaluate),
+fleet/base/distributed_strategy.py:1765 (strategy validation).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.base.distributed_strategy import (
+    DistributedStrategy)
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+from paddle_tpu.hapi.model import Model
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class _ToyData(Dataset):
+    """Linearly separable 2-class toy set."""
+
+    def __init__(self, n=64):
+        rs = np.random.RandomState(0)
+        self.x = rs.randn(n, 8).astype(np.float32)
+        self.y = (self.x.sum(-1) > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mk_model():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.05,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    return model
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    set_mesh(None)
+    yield
+    set_mesh(None)
+
+
+class TestHapiMetrics:
+    def test_fit_reports_accuracy_per_epoch(self):
+        model = _mk_model()
+        hist = model.fit(_ToyData(), batch_size=16, epochs=3, verbose=0)
+        assert len(hist) == 3
+        for logs in hist:
+            assert "acc" in logs, logs
+        # the toy task is separable: accuracy should improve
+        assert hist[-1]["acc"] > hist[0]["acc"] - 1e-6
+        assert hist[-1]["acc"] > 0.7
+
+    def test_evaluate_reports_accuracy(self):
+        model = _mk_model()
+        model.fit(_ToyData(), batch_size=16, epochs=3, verbose=0)
+        out = model.evaluate(_ToyData(), batch_size=16, verbose=0)
+        assert "acc" in out and out["acc"] > 0.7
+
+
+class TestHapiDistFit:
+    def test_fit_routes_through_dist_model_when_mesh_active(self):
+        build_mesh({"dp": 8})
+        model = _mk_model()
+        assert model._dist_model is not None
+        hist = model.fit(_ToyData(), batch_size=16, epochs=2, verbose=0)
+        assert np.isfinite(hist[-1]["loss"])
+        # loss drops over epochs through the compiled path
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        # eval syncs trained params back to the eager layer
+        out = model.evaluate(_ToyData(), batch_size=16, verbose=0)
+        assert out["acc"] > 0.7
+
+    def test_no_mesh_no_dist_model(self):
+        model = _mk_model()
+        assert model._dist_model is None
+
+
+class TestStrategyValidation:
+    def test_unknown_key_warns(self):
+        s = DistributedStrategy()
+        with pytest.warns(UserWarning, match="unknown option 'shardingg'"):
+            s.shardingg = True  # typo'd key
+
+    def test_unknown_config_key_warns_and_known_keys_merge(self):
+        s = DistributedStrategy()
+        with pytest.warns(UserWarning, match="unknown keys"):
+            s.sharding_configs = {"stagee": 2}
+        # partial dicts merge over defaults instead of erasing them
+        s2 = DistributedStrategy()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            s2.sharding_configs = {"stage": 2}
+        assert s2.sharding_configs["stage"] == 2
+        assert s2.sharding_configs["degree"] == 1  # default preserved
+
+    def test_save_load_round_trip_keeps_validation(self, tmp_path):
+        s = DistributedStrategy()
+        s.amp = True
+        path = str(tmp_path / "strategy.json")
+        s.save_to_prototxt(path)
+        s2 = DistributedStrategy().load_from_prototxt(path)
+        assert s2.amp is True
+        assert "_known" not in s.to_dict()
+        # validation still works after the round trip
+        with pytest.warns(UserWarning, match="unknown option"):
+            s2.sync = True
+
+    def test_dist_fit_reports_metrics(self):
+        build_mesh({"dp": 8})
+        model = _mk_model()
+        assert model._dist_model is not None
+        hist = model.fit(_ToyData(), batch_size=16, epochs=2, verbose=0)
+        # metrics flow through the distributed path too
+        assert "acc" in hist[-1] and hist[-1]["acc"] > 0.6
+
+    def test_known_assignments_do_not_warn(self):
+        s = DistributedStrategy()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            s.amp = True
+            s.recompute = True
+            s.hybrid_configs = {"dp_degree": 2}

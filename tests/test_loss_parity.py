@@ -1,0 +1,52 @@
+"""Loss-curve parity vs an independent torch LLaMA twin (round-3 verdict
+item 2; BASELINE.md "loss-curve parity" metric).
+
+Identical init/data/hyperparams; max per-step |loss dev| asserted. Tolerances
+are calibrated from the committed 200-step run (docs/loss_parity_curves.json:
+fp32 0.0016, bf16 0.078, canary-with-wrong-beta2 0.61): fp32 0.02 / bf16 0.25
+leave a 10x margin above the measured clean deviation while sitting 30x/2.4x
+below the canary's.
+
+The default (quick-tier-excluded) run uses PARITY_STEPS=60; tools/ci.sh's
+nightly stage runs the full 200.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from tools.loss_parity import run_parity  # noqa: E402
+
+STEPS = int(os.environ.get("PARITY_STEPS", 40))
+
+FP32_TOL = 0.02
+BF16_TOL = 0.25
+# measured (docs/loss_parity_curves.json + 40-step calibration): clean fp32
+# 3.4e-5 @ 40 steps / 1.6e-3 @ 200; canary 0.036 @ 40 / 0.61 @ 200 — the
+# canary clears FP32_TOL at both horizons
+
+
+class TestLossCurveParity:
+    def test_fp32_curves_match(self):
+        pl, tl, dev = run_parity(STEPS, dtype="float32")
+        assert dev < FP32_TOL, f"fp32 max dev {dev} over {STEPS} steps"
+        # the curve actually learns (not a frozen model agreeing trivially)
+        assert pl[-1] < pl[0] - 0.1
+
+    @pytest.mark.skipif(os.environ.get("PARITY_BF16", "0") != "1",
+                        reason="bf16 eager CPU run is slow; nightly sets "
+                               "PARITY_BF16=1 (200-step curve committed in "
+                               "docs/loss_parity_curves.json: dev 0.078)")
+    def test_bf16_curve_tracks_fp32_reference(self):
+        pl, tl, dev = run_parity(STEPS, dtype="bfloat16")
+        assert dev < BF16_TOL, f"bf16 max dev {dev} over {STEPS} steps"
+        assert pl[-1] < pl[0] - 0.1
+
+    def test_canary_perturbed_optimizer_is_caught(self):
+        """A deliberately wrong torch beta2 must blow past the tolerance —
+        proves the assertion has teeth (numeric-harness wrong-vjp analog)."""
+        _, _, dev = run_parity(STEPS, dtype="float32", perturb="beta2")
+        assert dev > FP32_TOL, (
+            f"canary dev {dev} did not exceed tolerance {FP32_TOL}")

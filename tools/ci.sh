@@ -1,6 +1,14 @@
 #!/usr/bin/env bash
 # CI entry point (reference analog: the reference repo's CI pipelines under
-# tools/ + paddle_build.sh test stages). Stages:
+# tools/ + paddle_build.sh test stages, with testslist.csv-style run tiers).
+#
+# Usage:
+#   tools/ci.sh quick     per-commit tier: import hygiene + fast unit subset
+#                         (-m "not slow"), <3 min on the CI host
+#   tools/ci.sh           full gate: everything below
+#   tools/ci.sh nightly   full gate + 200-step loss-curve parity vs torch
+#
+# Stages (full):
 #   1. import hygiene: importing paddle_tpu must NOT initialize the XLA
 #      backend (jax.distributed would break)
 #   2. unit suite on the virtual 8-device CPU mesh
@@ -10,7 +18,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/4] import hygiene =="
+TIER="${1:-full}"
+
+echo "== [1] import hygiene =="
 python - <<'EOF'
 import jax, paddle_tpu
 from jax._src import xla_bridge
@@ -18,13 +28,25 @@ assert not xla_bridge._backends, "import paddle_tpu initialized the XLA backend"
 print("ok: lazy backend")
 EOF
 
-echo "== [2/4] unit suite =="
+if [ "$TIER" = "quick" ]; then
+  echo "== [2] unit suite (quick tier) =="
+  python -m pytest tests/ -q -m "not slow"
+  echo "CI QUICK TIER PASSED"
+  exit 0
+fi
+
+echo "== [2] unit suite (full) =="
 python -m pytest tests/ -q
 
-echo "== [3/4] multichip gate =="
+echo "== [3] multichip gate =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== [4/4] bench regression =="
+echo "== [4] bench regression =="
 python tools/bench_regression.py
+
+if [ "$TIER" = "nightly" ]; then
+  echo "== [5] loss-curve parity (200 steps, fp32 + bf16, vs torch) =="
+  PARITY_STEPS=200 PARITY_BF16=1 python -m pytest tests/test_loss_parity.py -q
+fi
 
 echo "CI PASSED"

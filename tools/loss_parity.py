@@ -1,0 +1,218 @@
+"""Loss-curve parity: paddle_tpu vs an INDEPENDENT torch implementation.
+
+The BASELINE.md metric is "loss-curve parity vs the GPU reference run". This
+harness trains the same ~8M-param LLaMA config for N steps in paddle_tpu and
+in a from-scratch torch twin (written against the LLaMA paper, not against
+paddle_tpu's code): identical init (params exported once and loaded into
+torch), identical data stream, identical AdamW hyperparameters. It returns
+both loss curves; the test asserts the max per-step deviation.
+
+Canary: `perturb="beta2"` deliberately mis-sets the torch AdamW beta2 — the
+assertion must catch it (same philosophy as the numeric harness's planted
+wrong-vjp).
+
+Run standalone:  python tools/loss_parity.py [steps] > curves.json
+"""
+from __future__ import annotations
+
+import math
+import sys
+
+import numpy as np
+
+CFG = dict(vocab=4096, hidden=256, inter=688, layers=8, heads=4, seq=128,
+           batch=8, lr=3e-4, wd=0.01, betas=(0.9, 0.999), eps=1e-8, pool=8)
+
+
+def _data_pool(cfg=CFG, seed=1234):
+    """Fixed pool of batches, cycled — memorization drives the curve down."""
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, cfg["vocab"], (cfg["batch"], cfg["seq"]))
+            .astype(np.int64) for _ in range(cfg["pool"])]
+
+
+# --------------------------------------------------------------------------
+# paddle_tpu side
+
+
+def run_paddle(steps: int, cfg=CFG, dtype="float32"):
+    """Returns (losses, init_state_dict as numpy)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    lcfg = LlamaConfig(
+        vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
+        intermediate_size=cfg["inter"], num_hidden_layers=cfg["layers"],
+        num_attention_heads=cfg["heads"], num_key_value_heads=cfg["heads"],
+        max_position_embeddings=cfg["seq"], use_parallel_cross_entropy=False)
+    paddle.seed(0)
+    model = LlamaForCausalLM(lcfg)
+    init = {k: np.asarray(v._value, np.float32).copy()
+            for k, v in model.state_dict().items()}
+    if dtype == "bfloat16":
+        model.to(dtype="bfloat16")
+    model.train()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=cfg["lr"], beta1=cfg["betas"][0], beta2=cfg["betas"][1],
+        epsilon=cfg["eps"], weight_decay=cfg["wd"],
+        parameters=model.parameters(),
+        multi_precision=(dtype == "bfloat16"))
+    pool = _data_pool(cfg)
+    losses = []
+    for i in range(steps):
+        ids = paddle.to_tensor(pool[i % len(pool)])
+        loss = model(ids, ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses, init
+
+
+# --------------------------------------------------------------------------
+# independent torch twin (from the LLaMA paper: RMSNorm, RoPE, SwiGLU,
+# causal attention, untied head, CE over all positions)
+
+
+def _torch_model(cfg, init):
+    import torch
+    import torch.nn as tn
+
+    h, heads = cfg["hidden"], cfg["heads"]
+    hd = h // heads
+
+    class RMSNorm(tn.Module):
+        def __init__(self, n, eps=1e-5):
+            super().__init__()
+            self.w = tn.Parameter(torch.ones(n))
+            self.eps = eps
+
+        def forward(self, x):
+            var = x.float().pow(2).mean(-1, keepdim=True)
+            return (x.float() * torch.rsqrt(var + self.eps)).to(x.dtype) * self.w
+
+    class Block(tn.Module):
+        def __init__(self):
+            super().__init__()
+            self.ln1 = RMSNorm(h)
+            self.ln2 = RMSNorm(h)
+            self.q = tn.Linear(h, h, bias=False)
+            self.k = tn.Linear(h, h, bias=False)
+            self.v = tn.Linear(h, h, bias=False)
+            self.o = tn.Linear(h, h, bias=False)
+            self.gate = tn.Linear(h, cfg["inter"], bias=False)
+            self.up = tn.Linear(h, cfg["inter"], bias=False)
+            self.down = tn.Linear(cfg["inter"], h, bias=False)
+
+        def attn(self, x, cos, sin):
+            b, s, _ = x.shape
+            q = self.q(x).view(b, s, heads, hd)
+            k = self.k(x).view(b, s, heads, hd)
+            v = self.v(x).view(b, s, heads, hd)
+
+            def rope(t):
+                t1, t2 = t.chunk(2, dim=-1)
+                c = cos[None, :s, None, :]
+                sn = sin[None, :s, None, :]
+                return torch.cat([t1 * c - t2 * sn, t2 * c + t1 * sn], -1)
+
+            q, k = rope(q), rope(k)
+            q, k, v = (t.transpose(1, 2) for t in (q, k, v))  # [B,H,S,D]
+            att = (q @ k.transpose(-2, -1)) / math.sqrt(hd)
+            mask = torch.full((s, s), float("-inf")).triu(1)
+            att = torch.softmax(att + mask, dim=-1)
+            out = (att @ v).transpose(1, 2).reshape(b, s, h)
+            return self.o(out)
+
+        def forward(self, x, cos, sin):
+            x = x + self.attn(self.ln1(x), cos, sin)
+            x = x + self.down(torch.nn.functional.silu(self.gate(self.ln2(x)))
+                              * self.up(self.ln2(x)))
+            return x
+
+    class Model(tn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = tn.Embedding(cfg["vocab"], h)
+            self.blocks = tn.ModuleList([Block() for _ in range(cfg["layers"])])
+            self.norm = RMSNorm(h)
+            self.head = tn.Linear(h, cfg["vocab"], bias=False)
+            inv = 1.0 / (10000.0 ** (torch.arange(0, hd, 2).float() / hd))
+            t = torch.arange(cfg["seq"]).float()
+            freqs = torch.outer(t, inv)
+            self.register_buffer("cos", freqs.cos())
+            self.register_buffer("sin", freqs.sin())
+
+        def forward(self, ids):
+            x = self.emb(ids)
+            for blk in self.blocks:
+                x = blk(x, self.cos, self.sin)
+            return self.head(self.norm(x))
+
+    m = Model()
+
+    def cp(dst, src_key, transpose=False):
+        w = torch.tensor(init[src_key])
+        dst.data.copy_(w.t() if transpose else w)
+
+    cp(m.emb.weight, "llama.embed_tokens.weight")
+    cp(m.head.weight, "lm_head.weight", transpose=True)
+    cp(m.norm.w, "llama.norm.weight")
+    for i, blk in enumerate(m.blocks):
+        pre = f"llama.layers.{i}."
+        cp(blk.ln1.w, pre + "input_layernorm.weight")
+        cp(blk.ln2.w, pre + "post_attention_layernorm.weight")
+        cp(blk.q.weight, pre + "self_attn.q_proj.weight", transpose=True)
+        cp(blk.k.weight, pre + "self_attn.k_proj.weight", transpose=True)
+        cp(blk.v.weight, pre + "self_attn.v_proj.weight", transpose=True)
+        cp(blk.o.weight, pre + "self_attn.o_proj.weight", transpose=True)
+        cp(blk.gate.weight, pre + "mlp.gate_proj.weight", transpose=True)
+        cp(blk.up.weight, pre + "mlp.up_proj.weight", transpose=True)
+        cp(blk.down.weight, pre + "mlp.down_proj.weight", transpose=True)
+    return m
+
+
+def run_torch(steps: int, init, cfg=CFG, perturb=None):
+    import torch
+
+    torch.manual_seed(0)
+    m = _torch_model(cfg, init)
+    betas = cfg["betas"]
+    if perturb == "beta2":  # canary: deliberately wrong optimizer
+        betas = (betas[0], 0.95)
+    opt = torch.optim.AdamW(m.parameters(), lr=cfg["lr"], betas=betas,
+                            eps=cfg["eps"], weight_decay=cfg["wd"])
+    pool = _data_pool(cfg)
+    losses = []
+    for i in range(steps):
+        ids = torch.tensor(pool[i % len(pool)])
+        logits = m(ids)
+        loss = torch.nn.functional.cross_entropy(
+            logits.reshape(-1, cfg["vocab"]), ids.reshape(-1))
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    return losses
+
+
+def run_parity(steps: int = 200, dtype: str = "float32", perturb=None):
+    """Returns (paddle_losses, torch_losses, max_abs_dev)."""
+    pl, init = run_paddle(steps, dtype=dtype)
+    tl = run_torch(steps, init, perturb=perturb)
+    dev = float(np.max(np.abs(np.asarray(pl) - np.asarray(tl))))
+    return pl, tl, dev
+
+
+if __name__ == "__main__":
+    import json
+
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    out = {}
+    for dtype in ("float32", "bfloat16"):
+        pl, tl, dev = run_parity(steps, dtype=dtype)
+        out[dtype] = {"paddle_tpu": pl, "torch": tl,
+                      "max_abs_dev": round(dev, 6)}
+        print(f"{dtype}: max |dev| over {steps} steps = {dev:.5f}",
+              file=sys.stderr)
+    print(json.dumps(out))
