@@ -31,7 +31,8 @@ import jax
 
 __all__ = [
     "num_processes", "cross_process_active", "allgather_np", "allreduce_np",
-    "broadcast_np", "subgroup_allgather_np", "subgroup_broadcast_np",
+    "broadcast_np", "subgroup_allgather_np", "subgroup_allreduce_np",
+    "subgroup_broadcast_np",
     "exchange_objects", "broadcast_object", "scatter_objects", "barrier",
     "subgroup_barrier", "store_send", "store_recv",
 ]
@@ -98,6 +99,8 @@ def _reduce_rows(gathered: np.ndarray, op: str) -> np.ndarray:
 
 
 def allreduce_np(arr, op: str = "sum", ranks=None) -> np.ndarray:
+    if _is_subgroup(ranks):
+        return subgroup_allreduce_np(arr, ranks, op)
     return _reduce_rows(allgather_np(arr, ranks), op)
 
 
@@ -127,6 +130,19 @@ def _store():
     return create_or_get_global_tcp_store()
 
 
+# payloads above this ride the direct rank-to-rank socket plane (gloo-style;
+# socket_plane.py) — the store stays a rendezvous/control channel and never
+# carries multi-MB tensors through its single server socket
+_SOCKET_THRESHOLD = int(os.getenv("PADDLE_SOCKET_THRESHOLD", str(1 << 20)))
+_SOCKET_MARKER = b"\x01PT_SOCKET_PLANE"
+
+
+def _plane():
+    from paddle_tpu.distributed.socket_plane import plane
+
+    return plane()
+
+
 def _gc_keys(store, keys: list[str], ack_key: str, nmembers: int) -> None:
     """Last member to finish deletes the exchange's keys (+ the ack counter),
     so per-step traffic cannot grow the store server without bound."""
@@ -148,24 +164,51 @@ def _group_prefix(kind: str, ranks) -> tuple[str, list[int]]:
 
 
 def subgroup_allgather_np(arr, ranks) -> np.ndarray:
-    """Gather member arrays [len(ranks), *shape]; only members enter."""
+    """Gather member arrays [len(ranks), *shape]; only members enter.
+    Large payloads move rank-to-rank over the socket plane (all members see
+    the same shape, so the routing decision is consistent)."""
     pre, members = _group_prefix("sg", ranks)
+    arr = np.asarray(arr)
+    if arr.nbytes > _SOCKET_THRESHOLD:
+        return _plane().allgather(arr, members, tag=pre)
     store = _store()
-    store.set(f"{pre}/{_rank()}", pickle.dumps(np.asarray(arr)))
+    store.set(f"{pre}/{_rank()}", pickle.dumps(arr))
     rows = [pickle.loads(store.wait(f"{pre}/{r}")) for r in members]
     _gc_keys(store, [f"{pre}/{r}" for r in members], f"{pre}/acks", len(members))
     return np.stack(rows)
 
 
+def subgroup_allreduce_np(arr, ranks, op: str = "sum") -> np.ndarray:
+    """Bandwidth-optimal ring allreduce over the socket plane for large
+    payloads; small ones take the store allgather + local reduce."""
+    arr = np.asarray(arr)
+    if arr.nbytes > _SOCKET_THRESHOLD:
+        pre, members = _group_prefix("sar", ranks)
+        return _plane().allreduce(arr, members, tag=pre, op=op)
+    return _reduce_rows(subgroup_allgather_np(arr, ranks), op)
+
+
 def subgroup_broadcast_np(arr, src: int, ranks) -> np.ndarray:
-    """Only the src rank's payload crosses the wire."""
+    """Only the src rank's payload crosses the wire. Receivers learn the
+    route (store inline vs socket plane) from the store record, so only the
+    src's payload size drives the decision."""
     pre, members = _group_prefix("sb", ranks)
     store = _store()
     if _rank() == src:
-        store.set(f"{pre}/v", pickle.dumps(np.asarray(arr)))
-        out = np.asarray(arr)
+        a = np.asarray(arr)
+        if a.nbytes > _SOCKET_THRESHOLD:
+            store.set(f"{pre}/v", _SOCKET_MARKER)
+            _plane().broadcast(a, src, members, tag=pre)
+            out = a
+        else:
+            store.set(f"{pre}/v", pickle.dumps(a))
+            out = a
     else:
-        out = pickle.loads(store.wait(f"{pre}/v"))
+        raw = store.wait(f"{pre}/v")
+        if raw == _SOCKET_MARKER:
+            out = _plane().recv(src, tag=pre)
+        else:
+            out = pickle.loads(raw)
     _gc_keys(store, [f"{pre}/v"], f"{pre}/acks", len(members))
     return out
 
@@ -236,16 +279,26 @@ def scatter_objects(objs, src: int = 0, ranks=None):
 
 def store_send(arr, dst: int) -> None:
     """Peer-addressed eager send (reference isend, process_group.h:205); the
-    per-(src,dst) sequence pairs each send with exactly one recv."""
+    per-(src,dst) sequence pairs each send with exactly one recv. Large
+    payloads ride the socket plane; the store key carries only the route."""
     seq = _next(f"p2p/{_rank()}->{dst}")
     key = f"{_session()}/p2p/{_rank()}->{dst}/{seq}"
-    _store().set(key, pickle.dumps(np.asarray(arr)))
+    a = np.asarray(arr)
+    if a.nbytes > _SOCKET_THRESHOLD:
+        _plane().send(a, dst, tag=key)
+        _store().set(key, _SOCKET_MARKER)
+        return
+    _store().set(key, pickle.dumps(a))
 
 
 def store_recv(src: int):
     seq = _next(f"p2p/{src}->{_rank()}")
     store = _store()
     key = f"{_session()}/p2p/{src}->{_rank()}/{seq}"
-    out = pickle.loads(store.wait(key))
+    raw = store.wait(key)
+    if raw == _SOCKET_MARKER:
+        out = _plane().recv(src, tag=key)
+    else:
+        out = pickle.loads(raw)
     store.delete_key(key)  # consumed exactly once — GC immediately
     return out

@@ -1,0 +1,321 @@
+"""Direct rank-to-rank TCP data plane for eager collectives.
+
+Reference analog: the gloo data plane behind ProcessGroupGloo
+(fluid/distributed/collective/process_group_gloo.h) — the TCPStore is used
+for RENDEZVOUS ONLY (tcp_store.h:121) and bulk payloads move over dedicated
+rank-to-rank connections, not through the store server.
+
+Design: each rank runs one accept loop on an ephemeral port published in the
+TCPStore (`<session>/sockaddr/<rank>`). SENDING to a peer uses this rank's
+lazily-dialed outbound connection, fed by a per-peer sender thread (async —
+posting a send never blocks, so symmetric exchanges cannot deadlock on full
+OS socket buffers). RECEIVING demultiplexes inbound frames into per-(src,
+tag) queues. Frames carry (tag, dtype, shape, raw bytes) with chunked
+socket writes.
+
+multiproc.py routes store-plane operations here above _SOCKET_THRESHOLD
+bytes: subgroup allgather/broadcast exchange payloads peer-to-peer, p2p
+store_send/store_recv ship the tensor body over the socket (the store keeps
+only a tiny routing record), and subgroup allreduce runs a bandwidth-optimal
+ring reduce-scatter + allgather.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["plane", "SocketPlane"]
+
+_CHUNK = 1 << 20  # 1 MiB socket read/write granularity
+
+
+def _send_all(sock, data: bytes):
+    view = memoryview(data)
+    while view:
+        n = sock.send(view[:_CHUNK])
+        view = view[n:]
+
+
+def _recv_into(sock, view) -> None:
+    n = view.nbytes
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:got + _CHUNK])
+        if r == 0:
+            raise ConnectionError("socket plane: peer closed connection")
+        got += r
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray(n)
+    _recv_into(sock, memoryview(buf))
+    return bytes(buf)
+
+
+class SocketPlane:
+    """One per process; lazily started on first use."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listener = None
+        self._port = None
+        self._out: dict[int, tuple] = {}     # dst -> (queue, thread)
+        self._out_err: dict[int, BaseException] = {}
+        self._in: dict[tuple, queue.Queue] = {}  # (src, tag) -> frames
+        self._in_lock = threading.Lock()
+        self._started = False
+
+    # -- bring-up ------------------------------------------------------------
+
+    def _session(self) -> str:
+        return os.getenv("PADDLE_JOB_SESSION", "s0")
+
+    def _rank(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    def _store(self):
+        from paddle_tpu.distributed.store import create_or_get_global_tcp_store
+
+        return create_or_get_global_tcp_store()
+
+    def ensure_started(self):
+        with self._lock:
+            if self._started:
+                return
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("0.0.0.0", 0))
+            srv.listen(64)
+            self._port = srv.getsockname()[1]
+            self._listener = srv
+            t = threading.Thread(target=self._accept_loop, daemon=True)
+            t.start()
+            self._store().set(f"{self._session()}/sockaddr/{self._rank()}",
+                              f"{self._local_host()}:{self._port}".encode())
+            self._started = True
+            import atexit
+
+            atexit.register(self.flush)
+
+    def _local_host(self) -> str:
+        """This rank's address as PEERS can reach it. PADDLE_LOCAL_HOST wins;
+        otherwise the interface that routes to the job master (UDP-connect
+        trick, no packet sent) — loopback only for single-host jobs."""
+        h = os.getenv("PADDLE_LOCAL_HOST")
+        if h:
+            return h
+        master = os.getenv("PADDLE_MASTER") or os.getenv("PADDLE_COORDINATOR")
+        if master and ":" in master:
+            mhost, mport = master.rsplit(":", 1)
+            try:
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                try:
+                    s.connect((mhost, int(mport)))
+                    addr = s.getsockname()[0]
+                finally:
+                    s.close()
+                if addr:
+                    return addr
+            except OSError:
+                pass
+        return "127.0.0.1"
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn):
+        try:
+            hello = struct.unpack("!i", _recv_exact(conn, 4))[0]  # src rank
+            while True:
+                hlen = struct.unpack("!i", _recv_exact(conn, 4))[0]
+                header = pickle.loads(_recv_exact(conn, hlen))
+                # receive straight into the destination array — no staging
+                # copies on the bandwidth path
+                arr = np.empty(header["shape"], dtype=header["dtype"])
+                _recv_into(conn, memoryview(arr).cast("B"))
+                self._inbox(hello, header["tag"]).put(arr)
+        except (ConnectionError, OSError):
+            return
+
+    def _inbox(self, src: int, tag: str) -> queue.Queue:
+        with self._in_lock:
+            q = self._in.get((src, tag))
+            if q is None:
+                q = queue.Queue()
+                self._in[(src, tag)] = q
+            return q
+
+    def _sender(self, dst: int):
+        with self._lock:
+            ent = self._out.get(dst)
+            if ent is not None:
+                return ent[0]
+            q: queue.Queue = queue.Queue()
+
+            def run():
+                try:
+                    addr = self._store().wait(
+                        f"{self._session()}/sockaddr/{dst}").decode()
+                    host, port = addr.rsplit(":", 1)
+                    sock = socket.create_connection((host, int(port)))
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    _send_all(sock, struct.pack("!i", self._rank()))
+                    while True:
+                        item = q.get()
+                        try:
+                            if item is None:
+                                sock.close()
+                                return
+                            tag, arr = item
+                            header = pickle.dumps(
+                                {"tag": tag, "dtype": str(arr.dtype),
+                                 "shape": arr.shape, "nbytes": arr.nbytes})
+                            _send_all(sock, struct.pack("!i", len(header)))
+                            _send_all(sock, header)
+                            _send_all(sock, memoryview(arr).cast("B"))
+                        finally:
+                            q.task_done()
+                except BaseException as e:  # record + fail fast on next send
+                    self._out_err[dst] = e
+                    while True:  # permanent sink: racing enqueues are
+                        q.get()  # drained so flush()/join() cannot hang
+                        q.task_done()
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            self._out[dst] = (q, t)
+            return q
+
+    # -- p2p -----------------------------------------------------------------
+
+    def send(self, arr: np.ndarray, dst: int, tag: str):
+        """Async: enqueue a PRIVATE COPY and return (symmetric exchanges
+        cannot deadlock; the caller may freely mutate `arr` afterwards).
+        Delivery completes by the next flush()/barrier or interpreter exit
+        (atexit flush). A dead sender thread raises on the next send."""
+        self.ensure_started()
+        err = self._out_err.get(dst)
+        if err is not None:
+            raise ConnectionError(
+                f"socket plane: sender to rank {dst} died: {err!r}") from err
+        self._sender(dst).put((tag, np.array(arr, order="C", copy=True)))
+
+    def flush(self):
+        """Block until every enqueued send has been transmitted."""
+        for dst, (q, _t) in list(self._out.items()):
+            q.join()
+            err = self._out_err.get(dst)
+            if err is not None:
+                raise ConnectionError(
+                    f"socket plane: sender to rank {dst} died: {err!r}") from err
+
+    def recv(self, src: int, tag: str, timeout: float = 300.0) -> np.ndarray:
+        self.ensure_started()
+        out = self._inbox(src, tag).get(timeout=timeout)
+        # tags are single-use (seq-numbered): drop the inbox entry so the
+        # dict cannot grow over a long run (the _gc_keys analog)
+        with self._in_lock:
+            q = self._in.get((src, tag))
+            if q is not None and q.empty():
+                del self._in[(src, tag)]
+        return out
+
+    # -- collectives ---------------------------------------------------------
+
+    def allgather(self, arr: np.ndarray, members, tag: str) -> np.ndarray:
+        """Post sends to every peer, then collect; returns [n, *shape]."""
+        self.ensure_started()
+        me = self._rank()
+        arr = np.asarray(arr)
+        for r in members:
+            if r != me:
+                self.send(arr, r, tag)
+        rows = [arr if r == me else self.recv(r, tag) for r in members]
+        return np.stack(rows)
+
+    def broadcast(self, arr, src: int, members, tag: str) -> np.ndarray:
+        self.ensure_started()
+        me = self._rank()
+        if me == src:
+            a = np.asarray(arr)
+            for r in members:
+                if r != src:
+                    self.send(a, r, tag)
+            return a
+        return self.recv(src, tag)
+
+    def allreduce(self, arr: np.ndarray, members, tag: str,
+                  op: str = "sum") -> np.ndarray:
+        """Ring reduce-scatter + ring allgather: 2*(n-1)/n payload volumes
+        per link, the bandwidth-optimal eager allreduce."""
+        self.ensure_started()
+        members = list(members)
+        n = len(members)
+        me = self._rank()
+        if n == 1:
+            return np.asarray(arr)
+        i = members.index(me)
+        nxt, prv = members[(i + 1) % n], members[(i - 1) % n]
+        flat = np.asarray(arr).reshape(-1)
+        pad = (-len(flat)) % n
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+        chunks = [c.copy() for c in np.split(flat, n)]
+
+        def combine(a, b):
+            if op == "sum" or op == "avg":
+                return a + b
+            if op == "max":
+                return np.maximum(a, b)
+            if op == "min":
+                return np.minimum(a, b)
+            if op == "prod":
+                return a * b
+            raise ValueError(f"unknown reduce op {op!r}")
+
+        # reduce-scatter: after n-1 steps chunk (i+1) mod n is complete here
+        for s in range(n - 1):
+            send_c = (i - s) % n
+            recv_c = (i - s - 1) % n
+            self.send(chunks[send_c], nxt, f"{tag}/rs{s}")
+            chunks[recv_c] = combine(chunks[recv_c],
+                                     self.recv(prv, f"{tag}/rs{s}"))
+        # allgather the completed chunks around the ring
+        for s in range(n - 1):
+            send_c = (i - s + 1) % n
+            recv_c = (i - s) % n
+            self.send(chunks[send_c], nxt, f"{tag}/ag{s}")
+            chunks[recv_c] = self.recv(prv, f"{tag}/ag{s}")
+        out = np.concatenate(chunks)
+        if pad:
+            out = out[:-pad]
+        if op == "avg":
+            out = out / n
+        return out.reshape(np.asarray(arr).shape)
+
+
+_plane: SocketPlane | None = None
+_plane_lock = threading.Lock()
+
+
+def plane() -> SocketPlane:
+    global _plane
+    with _plane_lock:
+        if _plane is None:
+            _plane = SocketPlane()
+        return _plane

@@ -55,6 +55,19 @@ def test_four_process_hybrid_subgroups(tmp_path):
     assert logs.count("HYBRID_WORKER_OK") == 4, f"not all ranks succeeded\n{logs}"
 
 
+_SOCKET_WORKER = os.path.join(os.path.dirname(__file__), "workers",
+                              "socket_plane_worker.py")
+
+
+def test_four_process_socket_plane(tmp_path):
+    """Direct rank-to-rank TCP data plane (round-3 verdict item 7): subgroup
+    allgather/allreduce/broadcast/p2p correctness above the size threshold,
+    and the 100MB 4-proc ring allreduce must beat the store path >5x."""
+    proc, logs = _launch(4, _SOCKET_WORKER, str(tmp_path / "logs"))
+    assert proc.returncode == 0, f"launch failed rc={proc.returncode}\n{proc.stdout}\n{logs}"
+    assert logs.count("SOCKET_PLANE_OK") == 4, f"not all ranks succeeded\n{logs}"
+
+
 _RPC_WORKER = os.path.join(os.path.dirname(__file__), "workers", "rpc_worker.py")
 
 
