@@ -138,12 +138,48 @@ class PipelineParallel:
             vpp = 1
         from paddle_tpu.parallel.pipeline import PipelinedTrainStep
 
+        cfg = (self._strategy.pipeline_configs
+               if self._strategy is not None else {})
+        mode = str(cfg.get("schedule_mode", "1F1B")).upper().replace("-", "")
+        if mode == "ZBH1":
+            # the ZB-H1 runtime shards over pp only: mp/sep layers expect
+            # LOCAL weight shards + axis collectives, which it does not
+            # provide — fall back to the 1F1B program that honors them.
+            # dp/sharding axes merely replicate (correct math, no dp
+            # speedup): allow with a warning.
+            breaking = [a for a in ("mp", "sep") if mesh.shape.get(a, 1) > 1]
+            replicated = [a for a in ("dp", "sharding")
+                          if mesh.shape.get(a, 1) > 1]
+            if breaking:
+                warnings.warn(
+                    f"schedule_mode=ZB-H1 supports pp(+replicated dp) meshes "
+                    f"only; axes {breaking} are active — using the compiled "
+                    "1F1B schedule")
+                mode = "1F1B"
+            elif replicated:
+                warnings.warn(
+                    f"schedule_mode=ZB-H1 replicates the batch over "
+                    f"{replicated} (correct math, no data-parallel speedup); "
+                    "use 1F1B for dp scaling")
         try:
-            self._compiled_step = PipelinedTrainStep(
-                embed, blocks, head,
-                lambda out, lab: self._layers.loss(out, lab),
-                optimizer=optimizer, mesh=mesh, num_micro=self.accumulate_steps,
-                remat=self._layers._recompute_interval > 0, virtual_pp=vpp)
+            if mode == "ZBH1":
+                # executable zero-bubble schedule (reference
+                # pipeline_zero_bubble.py): B/W split drives the tick table
+                from paddle_tpu.parallel.zero_bubble import ZBH1PipelinedStep
+
+                self._compiled_step = ZBH1PipelinedStep(
+                    embed, blocks, head,
+                    lambda out, lab: self._layers.loss(out, lab),
+                    mesh=mesh, num_micro=self.accumulate_steps,
+                    optimizer=optimizer)
+            else:
+                self._compiled_step = PipelinedTrainStep(
+                    embed, blocks, head,
+                    lambda out, lab: self._layers.loss(out, lab),
+                    optimizer=optimizer, mesh=mesh,
+                    num_micro=self.accumulate_steps,
+                    remat=self._layers._recompute_interval > 0,
+                    virtual_pp=vpp)
         except Exception as e:  # shape/mesh mismatch: degrade, don't die
             warnings.warn(
                 f"PipelineParallel: compiled pipeline unavailable ({e}); "
@@ -223,8 +259,11 @@ class PipelineParallel:
         """reference: pipeline_parallel.py:697. Routes to the compiled scanned
         1F1B/VPP program (paddle_tpu.parallel.pipeline) when
         strategy.pipeline_configs['compile'] (default) and the mesh has a pp
-        axis; the optimizer update then runs inside the same XLA program.
-        GradScaler implies a fp16 loss-scaling loop, which stays eager."""
+        axis — the optimizer update runs inside the same XLA program. With
+        schedule_mode='ZB-H1' (pp-only meshes) the zero-bubble schedule
+        program computes loss+grads and a second jitted program applies the
+        update. GradScaler implies a fp16 loss-scaling loop, which stays
+        eager."""
         self._layers.train()
         if scaler is not None and self._compiled_step is not None:
             # switching to the eager scaler route mid-run: pull the compiled

@@ -219,15 +219,12 @@ class PipelinedTrainStep:
                            for p, s in zip(self._head_params, self._head_specs)]
 
         # optimizer state over the flat param list (embed + blocks-stacked + head)
+        from paddle_tpu.parallel.train_step import init_opt_states
+
         self._opt_states = None
         if optimizer is not None:
-            self._opt_states = []
-            for v in self._embed_vals + self._stacked_blocks + self._head_vals:
-                holder = Tensor(v)
-                st = optimizer._init_state(holder)
-                # co-locate state with its (sharded) parameter
-                st = {k: jax.device_put(s, v.sharding) for k, s in st.items()}
-                self._opt_states.append(st)
+            self._opt_states = init_opt_states(
+                optimizer, self._embed_vals + self._stacked_blocks + self._head_vals)
 
         self._jitted = None
 
@@ -423,13 +420,10 @@ class PipelinedTrainStep:
         flat_g = list(g_embed) + list(g_blocks) + list(g_head)
         if self.optimizer is None:
             return loss, embed_vals, stacked_blocks, head_vals, opt_states
-        new_p, new_s = [], []
-        for pv, gv, st in zip(flat_p, flat_g, opt_states):
-            if gv.dtype != pv.dtype:
-                gv = gv.astype(pv.dtype)
-            np_, ns_ = self.optimizer._update(pv, gv, st, lr, step_i)
-            new_p.append(np_)
-            new_s.append(ns_)
+        from paddle_tpu.parallel.train_step import apply_optimizer_update
+
+        new_p, new_s = apply_optimizer_update(
+            self.optimizer, flat_p, flat_g, opt_states, lr, step_i)
         ne = len(embed_vals)
         nb = len(stacked_blocks)
         return (loss, new_p[:ne], new_p[ne:ne + nb], new_p[ne + nb:], new_s)

@@ -31,7 +31,56 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.distributed.fleet import rng as fleet_rng
 from paddle_tpu.distributed.mesh import get_mesh
 
-__all__ = ["CompiledTrainStep", "functional_call"]
+__all__ = ["CompiledTrainStep", "functional_call", "init_opt_states",
+           "apply_optimizer_update"]
+
+
+def init_opt_states(optimizer, vals):
+    """Per-array optimizer state, co-located with its (sharded) value —
+    shared by the compiled pipeline runtimes."""
+    states = []
+    for v in vals:
+        st = optimizer._init_state(Tensor(v))
+        st = {k: jax.device_put(s, v.sharding) for k, s in st.items()}
+        states.append(st)
+    return states
+
+
+def apply_optimizer_update(optimizer, params, grads, states, lr, step_i):
+    """Pure (jit-safe) update loop over flat array lists: dtype-cast grads,
+    honor the optimizer's grad_clip (global-norm / per-tensor norm / value,
+    the nn.clip semantics on raw arrays), then optimizer._update per array.
+    The single implementation behind PipelinedTrainStep and
+    ZBH1PipelinedStep — schedule runtimes must not drift apart here."""
+    grads = [g.astype(p.dtype) if g.dtype != p.dtype else g
+             for p, g in zip(params, grads)]
+    clip = getattr(optimizer, "_grad_clip", None)
+    if clip is not None:
+        from paddle_tpu.nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                                        ClipGradByValue)
+
+        if isinstance(clip, ClipGradByGlobalNorm):
+            gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                              for g in grads))
+            f = jnp.where(gn > clip.clip_norm,
+                          clip.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+            grads = [g * f.astype(g.dtype) for g in grads]
+        elif isinstance(clip, ClipGradByNorm):
+            out = []
+            for g in grads:
+                n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                f = jnp.where(n > clip.clip_norm,
+                              clip.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                out.append(g * f.astype(g.dtype))
+            grads = out
+        elif isinstance(clip, ClipGradByValue):
+            grads = [jnp.clip(g, clip.min, clip.max) for g in grads]
+    new_p, new_s = [], []
+    for pv, gv, st in zip(params, grads, states):
+        np_, ns_ = optimizer._update(pv, gv, st, lr, step_i)
+        new_p.append(np_)
+        new_s.append(ns_)
+    return new_p, new_s
 
 
 def _param_pspec(p: Tensor, mesh: Mesh | None) -> PartitionSpec:

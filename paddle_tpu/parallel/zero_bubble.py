@@ -98,7 +98,7 @@ class ZBH1PipelinedStep:
 
     def __init__(self, embed_layer, blocks: Sequence, head_layer,
                  loss_fn: Callable, mesh: Mesh | None = None,
-                 num_micro: int = 2, seed: int = 0):
+                 num_micro: int = 2, seed: int = 0, optimizer=None):
         self.mesh = mesh if mesh is not None else get_mesh()
         if self.mesh is None or "pp" not in self.mesh.shape:
             raise ValueError("ZBH1PipelinedStep requires a mesh with a 'pp' axis")
@@ -139,6 +139,18 @@ class ZBH1PipelinedStep:
         self._head_vals = [jax.device_put(p._value, NamedSharding(mesh, PartitionSpec()))
                            for p in self._head_params]
         self._jitted = None
+
+        # optional optimizer: ZB-H1 as a full Fleet train-batch mode
+        self.optimizer = optimizer
+        self._opt_states = None
+        self._update_jit = None
+        self._step_i = 0
+        if optimizer is not None:
+            from paddle_tpu.parallel.train_step import init_opt_states
+
+            self._opt_states = init_opt_states(
+                optimizer,
+                self._embed_vals + self._stacked_blocks + self._head_vals)
 
     # -- pure per-rank compute pieces ---------------------------------------
 
@@ -347,3 +359,48 @@ class ZBH1PipelinedStep:
             tuple(self._stacked_blocks), tuple(self._embed_vals),
             tuple(self._head_vals), ids_mb, labels_mb)
         return loss, (list(g_embed), list(g_stage), list(g_head))
+
+    def __call__(self, ids, labels):
+        """Train step: ZB-H1 forward/backward + optimizer update (the Fleet
+        train_batch contract, like PipelinedTrainStep)."""
+        ids = ids._value if isinstance(ids, Tensor) else ids
+        labels = labels._value if isinstance(labels, Tensor) else labels
+        loss, (g_embed, g_stage, g_head) = self.run(np.asarray(ids),
+                                                    np.asarray(labels))
+        if self.optimizer is None:
+            return Tensor(loss)
+        flat_p = list(self._embed_vals) + list(self._stacked_blocks) \
+            + list(self._head_vals)
+        flat_g = list(g_embed) + list(g_stage) + list(g_head)
+        if self._update_jit is None:
+            from paddle_tpu.parallel.train_step import apply_optimizer_update
+
+            def upd(params, grads, states, lr, step_i):
+                return apply_optimizer_update(self.optimizer, params, grads,
+                                              states, lr, step_i)
+
+            self._update_jit = jax.jit(upd, donate_argnums=(0, 2))
+        self._step_i += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        new_p, self._opt_states = self._update_jit(
+            flat_p, flat_g, self._opt_states, lr,
+            jnp.asarray(self._step_i, jnp.int32))
+        ne = len(self._embed_vals)
+        nb = len(self._stacked_blocks)
+        self._embed_vals = list(new_p[:ne])
+        self._stacked_blocks = list(new_p[ne:ne + nb])
+        self._head_vals = list(new_p[ne + nb:])
+        # checkpoint parity: state_dict must reflect the trained step count
+        # (moments live in this step's _opt_states, like PipelinedTrainStep)
+        self.optimizer._step_count = self._step_i
+        return Tensor(loss)
+
+    def sync_params_to_model(self):
+        for p, v in zip(self._embed_params, self._embed_vals):
+            p._set_value(v)
+        for p, v in zip(self._head_params, self._head_vals):
+            p._set_value(v)
+        for i, stacked in enumerate(self._stacked_blocks):
+            flat = stacked.reshape((self.S * self.bps,) + stacked.shape[2:])
+            for l, bp in enumerate(self._block_params):
+                bp[i]._set_value(flat[l])

@@ -146,6 +146,51 @@ class TestZBH1Parity:
         assert zb_bubble < fb_bubble_units + 1e-9
 
 
+class TestZBH1FleetMode:
+    def test_fleet_train_batch_schedule_mode_zbh1(self):
+        """strategy.pipeline_configs['schedule_mode']='ZB-H1' routes Fleet
+        train_batch through the executable zero-bubble step, end to end with
+        the optimizer update."""
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+        from paddle_tpu.models.llama import (
+            LlamaForCausalLM, LlamaPretrainingCriterion, llama_tiny_config)
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 2, "sharding_degree": 1,
+                                   "sep_degree": 1}
+        strategy.pipeline_configs = {"accumulate_steps": 2,
+                                     "micro_batch_size": 2,
+                                     "schedule_mode": "ZB-H1"}
+        fleet.init(is_collective=True, strategy=strategy)
+
+        paddle.seed(0)
+        cfg = llama_tiny_config(num_hidden_layers=2,
+                                use_parallel_cross_entropy=False)
+        crit = LlamaPretrainingCriterion(cfg)
+        pipe = PipelineLayer(
+            layers=LlamaForCausalLM.pipeline_layers(cfg),
+            num_stages=2,
+            loss_fn=lambda out, lab: crit(out, lab))
+        model = fleet.distributed_model(pipe)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=pipe.parameters()))
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 256, (4, 16)).astype(np.int64))
+        labels = paddle.to_tensor(
+            rng.randint(0, 256, (4, 16)).astype(np.int64))
+        l0 = float(model.train_batch([ids, labels], opt))
+        l1 = float(model.train_batch([ids, labels], opt))
+        l2 = float(model.train_batch([ids, labels], opt))
+        from paddle_tpu.parallel.zero_bubble import ZBH1PipelinedStep
+
+        assert isinstance(model._compiled_step, ZBH1PipelinedStep)
+        set_mesh(None)
+        assert l2 < l1 < l0
+
+
 class TestZBH1MeasuredBubble:
     def test_measured_bubble_below_1f1b(self):
         """Wall-clock probe on the virtual 8-device mesh: for each runtime,
