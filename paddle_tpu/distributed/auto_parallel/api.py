@@ -242,12 +242,95 @@ def shard_optimizer(optimizer, shard_fn=None):
     return ShardOptimizerWrapper(optimizer, shard_fn)
 
 
+class _ShardedLoader:
+    """Per-process input sharding along the DATA-parallel dimension only:
+    model-parallel peers (same dp position) see the SAME rows (reference
+    ShardDataloader._dataloader). Nested tuple/list/dict batches are sliced
+    recursively; non-divisible tails pad by wrapping around (the
+    DistributedBatchSampler convention) so no sample is silently dropped."""
+
+    def __init__(self, loader, shard_index: int, num_shards: int):
+        self._loader = loader
+        self._idx = shard_index
+        self._n = num_shards
+
+    def _slice(self, item):
+        import numpy as _np
+
+        from paddle_tpu.core.tensor import Tensor
+
+        if isinstance(item, dict):
+            return {k: self._slice(v) for k, v in item.items()}
+        if isinstance(item, (tuple, list)):
+            return type(item)(self._slice(v) for v in item)
+        v = item._value if isinstance(item, Tensor) else item
+        if not hasattr(v, "shape") or not getattr(v, "ndim", 0):
+            return item
+        n = v.shape[0]
+        per = -(-n // self._n)  # ceil: wrap-around pad, never drop rows
+        rows = (_np.arange(self._idx * per, (self._idx + 1) * per)) % n
+        sl = v[rows] if n % self._n else v[self._idx * per:(self._idx + 1) * per]
+        return Tensor(sl) if isinstance(item, Tensor) else sl
+
+    def __iter__(self):
+        for batch in self._loader:
+            yield self._slice(batch)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_loader"], name)
+
+
+def _dp_shard_position(shard_dims=None):
+    """(shard_index, num_shards) for THIS process along the data-parallel
+    mesh dims — mp/pp peers share a position. None when not well-defined."""
+    import jax
+
+    from paddle_tpu.distributed.collective import Group
+    from paddle_tpu.distributed.mesh import get_mesh
+
+    mesh = get_mesh()
+    if mesh is None:
+        return None
+    if shard_dims is None:
+        dims = tuple(a for a in ("dp", "sharding")
+                     if mesh.shape.get(a, 1) > 1)
+    else:
+        dims = ((shard_dims,) if isinstance(shard_dims, str)
+                else tuple(shard_dims))
+        dims = tuple(a for a in dims if mesh.shape.get(a, 1) > 1)
+    if not dims:
+        return None
+    g = Group(id=-1, axes=dims)
+    pos = g._axis_position(jax.process_index())
+    if pos is None:
+        return None
+    num = 1
+    for a in dims:
+        num *= int(mesh.shape[a])
+    return int(pos), num
+
+
 def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset_splitted=False,
                      dense_tensor_idx=None):
-    """reference api.py:2846: feed each rank its input shard. Under global-SPMD
-    the loader already yields the global batch; mark batches with the target
-    sharding so the compiled step places them."""
-    return dataloader
+    """reference api.py:2846: feed each rank its input shard.
+
+    Single-process global-SPMD: the loader already yields the global batch
+    and the compiled step's input shardings place it — returned unchanged.
+    Multi-process: each process gets the slice for its DATA-parallel mesh
+    position (`shard_dims`, default the active dp/sharding axes) — mp/pp
+    peers read identical rows. Falls back to unsharded when the process has
+    no well-defined dp position."""
+    from paddle_tpu.distributed import multiproc
+
+    if is_dataset_splitted or not multiproc.cross_process_active():
+        return dataloader
+    pos = _dp_shard_position(shard_dims)
+    if pos is None:
+        return dataloader
+    return _ShardedLoader(dataloader, *pos)
 
 
 class _ShardingStagePlacement:

@@ -33,13 +33,16 @@ class SegmentParallel:
         if hcg is None:
             return
         broadcast_sep_parameters(self._layers, hcg)
-        try:
-            if hcg.get_sharding_parallel_world_size() > 1:
-                broadcast_sharding_parameters(self._layers, hcg)
-            if hcg.get_data_parallel_world_size() > 1:
-                broadcast_dp_parameters(self._layers, hcg)
-        except AttributeError:
-            pass
+        # per-axis capability probes: a missing hcg accessor skips only that
+        # axis, never the dp sync after it
+        def _degree(name):
+            fn = getattr(hcg, name, None)
+            return fn() if callable(fn) else 1
+
+        if _degree("get_sharding_parallel_world_size") > 1:
+            broadcast_sharding_parameters(self._layers, hcg)
+        if _degree("get_data_parallel_world_size") > 1:
+            broadcast_dp_parameters(self._layers, hcg)
 
     def shard_sequence(self, x, seq_axis: int = 1):
         """Hand this sep rank its contiguous sequence segment (eager mode).

@@ -1,8 +1,10 @@
 """TensorParallel wrapper (reference: fleet/meta_parallel/tensor_parallel.py:28).
 
-On NCCL the wrapper broadcasts params across the mp group at wrap time; in
-global-SPMD the logical params are already consistent (one copy, sharded by
-GSPMD), so wrapping is bookkeeping + input broadcast semantics.
+At wrap it broadcasts the mp-REPLICATED params over the mp group (sharded
+mpu weights stay per-rank), then sep/sharding/dp params — the reference's
+_prepare_for_model order. In global-SPMD the logical params are already
+consistent (one copy, sharded by GSPMD), so the broadcasts no-op and
+wrapping is bookkeeping + input broadcast semantics.
 """
 from __future__ import annotations
 
@@ -13,6 +15,31 @@ class TensorParallel:
     def __init__(self, layers, hcg, strategy=None):
         self._layers = layers
         self._hcg = hcg
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        """reference tensor_parallel.py:33 _prepare_for_model."""
+        from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (
+            broadcast_dp_parameters, broadcast_mp_parameters,
+            broadcast_sep_parameters, broadcast_sharding_parameters)
+
+        hcg = self._hcg
+        if hcg is None:
+            return
+        broadcast_mp_parameters(self._layers, hcg)
+
+        # per-axis capability probes: a missing hcg accessor skips only that
+        # axis, never the dp sync after it
+        def _degree(name):
+            fn = getattr(hcg, name, None)
+            return fn() if callable(fn) else 1
+
+        if _degree("get_sep_parallel_world_size") > 1:
+            broadcast_sep_parameters(self._layers, hcg)
+        if _degree("get_sharding_parallel_world_size") > 1:
+            broadcast_sharding_parameters(self._layers, hcg)
+        if _degree("get_data_parallel_world_size") > 1:
+            broadcast_dp_parameters(self._layers, hcg)
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_layers"], name)

@@ -67,11 +67,13 @@ def fused_allreduce_gradients(parameter_list, hcg):
 
 
 def sync_params_buffers(model, comm_group=None, src_rank=0,
-                        is_model_parallel=False, ranks=None):
+                        is_model_parallel=False, ranks=None,
+                        skip_param=None):
     """Broadcast every parameter and buffer from src_rank so all replicas
     start identical (reference :190 sync_params_buffers / parallel.py:202).
     The member set comes from `ranks` or `comm_group.ranks` (full world when
-    neither is given)."""
+    neither is given); `skip_param(p) -> bool` exempts params whose per-rank
+    values are authoritative (mp-sharded weights)."""
     from paddle_tpu.distributed import multiproc
 
     if not multiproc.cross_process_active():
@@ -79,6 +81,8 @@ def sync_params_buffers(model, comm_group=None, src_rank=0,
     if ranks is None:
         ranks = list(getattr(comm_group, "ranks", None) or []) or None
     for p in model.parameters():
+        if skip_param is not None and skip_param(p):
+            continue
         p._set_value(jnp.asarray(
             multiproc.broadcast_np(np.asarray(p._value), src=src_rank,
                                    ranks=ranks), p._value.dtype))
@@ -116,8 +120,26 @@ def broadcast_sep_parameters(model, hcg):
                         src_rank=ranks[0] if ranks else 0)
 
 
+def _is_mp_sharded(p) -> bool:
+    spec = getattr(p, "_mp_pspec", None)
+    return spec is not None and any(s is not None for s in spec)
+
+
 def broadcast_mp_parameters(model, hcg):
-    pass
+    """reference :170 broadcast_mp_parameters: params AND buffers replicated
+    across the mp group (is_distributed=False — layernorms, BN running
+    stats, row-parallel biases) are broadcast; mp-SHARDED weights (marked
+    here with _mp_pspec) are per-rank different by construction and must
+    not be overwritten."""
+    ranks = None
+    try:
+        mp_group = hcg.get_model_parallel_group()
+        ranks = list(getattr(mp_group, "ranks", []) or []) or None
+    except AttributeError:
+        pass
+    sync_params_buffers(model, ranks=ranks,
+                        src_rank=ranks[0] if ranks else 0,
+                        skip_param=_is_mp_sharded)
 
 
 def broadcast_sharding_parameters(model, hcg):

@@ -114,6 +114,45 @@ def main():
         np.concatenate([o.numpy() for o in outs]),
         np.array([r * 10.0 + pos for r in exp_mp], np.float32))
 
+    # ---- TensorParallel wrap: mp-REPLICATED params broadcast across the mp
+    # group, mp-SHARDED params untouched (reference broadcast_mp_parameters)
+    from paddle_tpu.distributed.fleet.meta_parallel import TensorParallel
+
+    class TpToy(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.norm_w = self.create_parameter([4])   # replicated
+            self.shard_w = self.create_parameter([4, 2])
+            self.shard_w._mp_pspec = (None, "mp")      # mp-sharded
+
+    paddle.seed(100 + rank)  # different init per rank
+    toy = TpToy()
+    TensorParallel(toy, hcg)
+    from paddle_tpu.distributed import multiproc
+
+    # replicated param now identical across the mp group
+    rows = multiproc.subgroup_allgather_np(toy.norm_w.numpy(), exp_mp)
+    np.testing.assert_allclose(rows[0], rows[1], rtol=0, atol=0)
+    # mp-SHARDED param was NOT overwritten by the mp broadcast: the mp peers
+    # still hold different shards (dp broadcast equalizes only across dp)
+    srows = multiproc.subgroup_allgather_np(toy.shard_w.numpy(), exp_mp)
+    check(not np.allclose(srows[0], srows[1]),
+          "mp-sharded param was clobbered by broadcast_mp_parameters")
+
+    # ---- shard_dataloader: DP-dim sharding — mp peers read the SAME rows,
+    # dp peers read disjoint halves covering the full batch ------------------
+    import paddle_tpu.distributed as pdist
+
+    batches = [np.arange(8, dtype=np.float32).reshape(4, 2)]
+    sharded = pdist.shard_dataloader(batches, meshes=None)
+    got = np.asarray(list(sharded)[0])
+    check(got.shape == (2, 2), f"dp shard shape {got.shape}")
+    mp_rows = multiproc.subgroup_allgather_np(got, exp_mp)
+    np.testing.assert_allclose(mp_rows[0], mp_rows[1], rtol=0, atol=0)
+    dp_rows = multiproc.subgroup_allgather_np(got, exp_dp)
+    union = np.sort(dp_rows.reshape(-1, 2), axis=0)
+    np.testing.assert_allclose(union, batches[0], rtol=0, atol=0)
+
     # ---- sub-group barrier then whole-world barrier ------------------------
     dist.barrier(group=mp_group)
     dist.barrier()
