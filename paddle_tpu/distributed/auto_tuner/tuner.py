@@ -26,6 +26,7 @@ class TunerConfig:
     pp: int = 1
     sharding: int = 1
     micro_batches: int = 1
+    schedule_mode: str = "1F1B"
     time_s: float | None = None
     error: str | None = None
 
@@ -53,6 +54,15 @@ def candidate_configs(n_devices: int, max_micro: int = 8):
                         continue
                     out.append(TunerConfig(dp=dp, mp=mp, pp=pp, sharding=sharding,
                                            micro_batches=mb))
+                    # ZB-H1 is a pp-ONLY schedule (it replicates over any
+                    # dp/sharding axis with no speedup): offer it only where
+                    # it genuinely runs, so duplicate candidates never crowd
+                    # distinct parallelism configs out of max_trials
+                    if pp > 1 and mp == 1 and dp == 1 and sharding == 1 \
+                            and mb > 1:
+                        out.append(TunerConfig(
+                            dp=dp, mp=mp, pp=pp, sharding=sharding,
+                            micro_batches=mb, schedule_mode="ZB-H1"))
     return out
 
 
@@ -130,15 +140,23 @@ def compiled_trial_fn(model_fn, batch_fn, optimizer_fn, warmup=1, iters=3):
             batch = batch_fn(cfg)
             if cfg.pp > 1:
                 from paddle_tpu.parallel.pipeline import PipelinedTrainStep
+                from paddle_tpu.parallel.zero_bubble import ZBH1PipelinedStep
 
                 embed, blocks, head, loss_fn = parts
                 params = (embed.parameters() + [p for b in blocks
                                                 for p in b.parameters()]
                           + head.parameters())
-                step = PipelinedTrainStep(
-                    embed, blocks, head, loss_fn,
-                    optimizer=optimizer_fn(params),
-                    num_micro=cfg.micro_batches, remat=False)
+                if cfg.schedule_mode.upper().replace("-", "") == "ZBH1":
+                    # time the ACTUAL zero-bubble program, not its 1F1B twin
+                    step = ZBH1PipelinedStep(
+                        embed, blocks, head, loss_fn,
+                        optimizer=optimizer_fn(params),
+                        num_micro=cfg.micro_batches)
+                else:
+                    step = PipelinedTrainStep(
+                        embed, blocks, head, loss_fn,
+                        optimizer=optimizer_fn(params),
+                        num_micro=cfg.micro_batches, remat=False)
                 ids, labels = batch
                 for _ in range(warmup):
                     float(step(ids, labels))

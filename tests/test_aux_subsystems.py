@@ -450,6 +450,80 @@ class TestAutoTunerRealTrials:
         assert len(timed) >= 2  # real measurements, not a heuristic score
         assert get_mesh() is None  # previous mesh restored
 
+    def test_zbh1_candidates_pp_only_and_trial_uses_zbh1(self, monkeypatch):
+        """ZB-H1 candidates appear only for pure-pp configs, and the trial
+        runner times the ACTUAL zero-bubble program for them."""
+        from paddle_tpu.distributed.auto_tuner import candidate_configs
+        from paddle_tpu.distributed.auto_tuner.tuner import (TunerConfig,
+                                                             compiled_trial_fn)
+        from paddle_tpu.distributed.mesh import set_mesh
+        import paddle_tpu.parallel.zero_bubble as zb
+
+        zbs = [c for c in candidate_configs(8)
+               if c.schedule_mode == "ZB-H1"]
+        assert zbs, "no ZB-H1 candidates generated"
+        assert all(c.pp > 1 and c.mp == 1 and c.dp == 1 and c.sharding == 1
+                   for c in zbs)
+
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        set_mesh(None)
+        paddle.seed(0)
+        V, D = 32, 16
+
+        class Emb(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.e = nn.Embedding(V, D)
+
+            def forward(self, ids):
+                return self.e(ids)
+
+        class Blk(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(D, D)
+
+            def forward(self, x):
+                return x + paddle.tanh(self.fc(x))
+
+        class Head(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.h = nn.Linear(D, V)
+
+            def forward(self, x):
+                return self.h(x)
+
+        def model_fn():
+            return (Emb(), [Blk() for _ in range(2)], Head(),
+                    lambda o, l: F.cross_entropy(o.reshape([-1, V]),
+                                                 l.reshape([-1])))
+
+        rng = np.random.RandomState(0)
+
+        def batch_fn(cfg):
+            ids = rng.randint(0, V, (2 * cfg.micro_batches, 8)).astype(np.int64)
+            return ids, ids
+
+        def opt_fn(params):
+            return paddle.optimizer.SGD(learning_rate=0.01, parameters=params)
+
+        built = []
+        orig = zb.ZBH1PipelinedStep.__init__
+
+        def spy(self, *a, **k):
+            built.append(True)
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(zb.ZBH1PipelinedStep, "__init__", spy)
+        trial = compiled_trial_fn(model_fn, batch_fn, opt_fn, warmup=0,
+                                  iters=1)
+        t = trial(TunerConfig(pp=2, micro_batches=2, schedule_mode="ZB-H1"))
+        assert t > 0 and built, "ZB-H1 trial did not build ZBH1PipelinedStep"
+        set_mesh(None)
+
 
 class TestWatchdogDump:
     def test_hang_writes_state_dump(self, tmp_path, monkeypatch):
