@@ -315,11 +315,13 @@ def main():
 
 def main_full():
     """--full: the largest-LLaMA-that-FITS demo — ZeRO optimizer-state
-    OFFLOAD to pinned host memory + rematerialization + flash, seq 2048.
+    OFFLOAD to pinned host memory + rematerialization + flash, seq 2048,
+    at the TRUE 7B layer geometry (hidden 4096 / inter 11008 / 32 heads).
     The fp32 master/m/v (12 bytes/param) live in host RAM and stream through
     HBM per step, so params are bounded by bf16 weights + activations only:
-    ~1.6B on one 16GB v5e vs ~650M without offload. Throughput is NOT the
-    point here (the state transfer dominates); fitting is."""
+    12 such layers = 2.69B params on one 16GB v5e (L=14 OOMs) vs ~870M
+    without offload. Throughput is NOT the point here (the state transfer
+    dominates); fitting is."""
     import jax
     import jax.numpy as jnp
 
@@ -328,9 +330,9 @@ def main_full():
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.parallel import CompiledTrainStep
 
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=2560, intermediate_size=6912,
-                      num_hidden_layers=18, num_attention_heads=20,
-                      num_key_value_heads=20, max_position_embeddings=2048,
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                      num_hidden_layers=12, num_attention_heads=32,
+                      num_key_value_heads=32, max_position_embeddings=2048,
                       use_parallel_cross_entropy=False)
     batch, seq = 1, 2048
     build_mesh({"dp": 1})

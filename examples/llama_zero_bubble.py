@@ -1,0 +1,52 @@
+"""Zero-bubble pipeline (ZB-H1): the round-4 executable schedule, selected
+via `pipeline_configs['schedule_mode']='ZB-H1'` — the backward splits into
+B (activation grad) and W (weight grad) jobs and W fills the drain bubble
+(reference pipeline_scheduler_pass/pipeline_zero_bubble.py).
+"""
+import numpy as np
+
+from _common import env_int, ensure_cpu_mesh
+
+ensure_cpu_mesh()
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer  # noqa: E402
+from paddle_tpu.distributed.mesh import set_mesh  # noqa: E402
+from paddle_tpu.models.llama import (  # noqa: E402
+    LlamaForCausalLM, LlamaPretrainingCriterion, llama_tiny_config,
+)
+
+
+def main():
+    steps = env_int("STEPS", 4)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2,
+                               "sharding_degree": 1, "sep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2,
+                                 "schedule_mode": "ZB-H1"}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(num_hidden_layers=2, use_parallel_cross_entropy=False)
+    crit = LlamaPretrainingCriterion(cfg)
+    pipe = PipelineLayer(layers=LlamaForCausalLM.pipeline_layers(cfg),
+                         num_stages=2, loss_fn=lambda out, lab: crit(out, lab))
+    model = fleet.distributed_model(pipe)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(1e-3, parameters=pipe.parameters()))
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 256, (8, 16)).astype(np.int64))
+    labels = paddle.to_tensor(rng.randint(0, 256, (8, 16)).astype(np.int64))
+    losses = [float(model.train_batch([ids, labels], opt)) for _ in range(steps)]
+
+    from paddle_tpu.parallel.zero_bubble import ZBH1PipelinedStep
+
+    assert isinstance(model._compiled_step, ZBH1PipelinedStep)
+    assert losses[-1] < losses[0], losses
+    set_mesh(None)
+    print(f"llama_zero_bubble (ZB-H1) loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

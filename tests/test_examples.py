@@ -11,8 +11,8 @@ _EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
 
 @pytest.mark.parametrize("script", [
     "mnist_lenet.py", "resnet_cifar_dp.py", "bert_mlm_zero2.py",
-    "llama_tp_pp.py", "gpt_moe_ep.py", "static_mode_mnist.py",
-    "inference_deploy.py",
+    "llama_tp_pp.py", "llama_zero_bubble.py", "gpt_moe_ep.py",
+    "static_mode_mnist.py", "inference_deploy.py",
 ])
 def test_example_runs(script):
     env = dict(os.environ)
