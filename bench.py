@@ -86,6 +86,7 @@ def loss_fn(logits, labels):
 build_mesh({"pp": S})
 paddle.seed(0)
 times = {}
+zb_times = {}
 for M in (4, 16):
     blocks = [Block() for _ in range(S)]
     step = PipelinedTrainStep(Emb(), blocks, Head(), loss_fn, optimizer=None,
@@ -100,13 +101,48 @@ for M in (4, 16):
         float(loss)
         ts.append(time.perf_counter() - t0)
     times[M] = min(ts)
+
+    # executable ZB-H1 on the same modules/shapes (W fills the drain bubble).
+    # Guarded: a ZB failure must never null the 1F1B numbers above (the 1F1B
+    # loop still completes; only the zbh1_* keys are dropped).
+    if zb_times is not None:
+        try:
+            from paddle_tpu.parallel.zero_bubble import ZBH1PipelinedStep
+
+            paddle.seed(0)
+            zstep = ZBH1PipelinedStep(Emb(), [Block() for _ in range(S)],
+                                      Head(), loss_fn, num_micro=M)
+            float(zstep.run(ids, ids)[0])  # compile
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(zstep.run(ids, ids)[0])
+                ts.append(time.perf_counter() - t0)
+            zb_times[M] = min(ts)
+        except Exception:
+            zb_times = None
+
+
+def bubble(t):
+    # steady per-mb cost a = slope; fill/drain overhead = t(4) - 4a
+    a = (t[16] - t[4]) / 12
+    return max(t[4] - 4 * a, 0.0) / t[4]
+
+
 ratio = times[16] / times[4]
 theory = (16 + S - 1) / (4 + S - 1)
-print("PIPE_JSON " + json.dumps({
+out = {
     "S": S, "t_m4_ms": round(times[4] * 1e3, 2), "t_m16_ms": round(times[16] * 1e3, 2),
     "tick_ratio_measured": round(ratio, 3), "tick_ratio_theory": round(theory, 3),
     "overhead_vs_theory": round(ratio / theory - 1, 3),
-    "bubble_frac_m4": round((S - 1) / (4 + S - 1), 3)}))
+    "bubble_frac_m4": round((S - 1) / (4 + S - 1), 3),
+    "measured_bubble_1f1b": round(bubble(times), 3)}
+if zb_times and 16 in zb_times:
+    out.update({
+        "measured_bubble_zbh1": round(bubble(zb_times), 3),
+        "zbh1_t_m4_ms": round(zb_times[4] * 1e3, 2),
+        "zbh1_t_m16_ms": round(zb_times[16] * 1e3, 2)})
+print("PIPE_JSON " + json.dumps(out))
 """
 
 
@@ -118,7 +154,7 @@ def _pipeline_overhead():
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
     try:
         res = subprocess.run([sys.executable, "-c", PIPELINE_PROBE],
-                             capture_output=True, text=True, timeout=240, env=env)
+                             capture_output=True, text=True, timeout=420, env=env)
         for line in res.stdout.splitlines():
             if line.startswith("PIPE_JSON "):
                 return json.loads(line[len("PIPE_JSON "):])
