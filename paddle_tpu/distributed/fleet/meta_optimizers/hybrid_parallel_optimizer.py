@@ -29,11 +29,42 @@ class HybridParallelClipGrad:
         self._hcg = hcg
 
     def __call__(self, params_grads):
-        grads = [g for _, g in params_grads if g is not None]
-        if not grads:
+        live = [(p, g) for p, g in params_grads if g is not None]
+        # logical-global view: every grad is the full tensor -> plain global
+        # norm. Cross-process eager mode: mp-SHARDED params hold only this
+        # rank's shard, so their squared norms sum over the mp group
+        # (reference :71 sum_square_dist allreduced over mp); replicated
+        # params are counted once from the local value. NOTE: a rank with no
+        # live grads must still join the mp allreduce — an early return here
+        # would deadlock its peers.
+        from paddle_tpu.distributed import multiproc
+        from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (
+            _is_mp_sharded)
+
+        def _sq(pairs):
+            return (sum(jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+                        for _, g in pairs)
+                    if pairs else jnp.zeros((), jnp.float32))
+
+        if multiproc.cross_process_active():
+            import numpy as _np
+
+            sq_shard = _sq([pg for pg in live if _is_mp_sharded(pg[0])])
+            sq_repl = _sq([pg for pg in live if not _is_mp_sharded(pg[0])])
+            mp_ranks = None
+            try:
+                mp_group = self._hcg.get_model_parallel_group()
+                mp_ranks = list(getattr(mp_group, "ranks", []) or []) or None
+            except AttributeError:
+                pass
+            if mp_ranks and len(mp_ranks) > 1:
+                sq_shard = jnp.asarray(multiproc.allreduce_np(
+                    _np.asarray(sq_shard), "sum", ranks=mp_ranks))
+            sq = sq_repl + sq_shard
+        else:
+            sq = _sq(live)
+        if not live:
             return params_grads
-        # logical-global view: every grad is the full tensor -> plain global norm
-        sq = sum(jnp.sum(jnp.square(g._value.astype(jnp.float32))) for g in grads)
         gn = jnp.sqrt(sq)
         cn = self._clip.clip_norm
         factor = jnp.where(gn > cn, cn / jnp.maximum(gn, 1e-12), 1.0)

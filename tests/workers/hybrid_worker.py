@@ -153,6 +153,23 @@ def main():
     union = np.sort(dp_rows.reshape(-1, 2), axis=0)
     np.testing.assert_allclose(union, batches[0], rtol=0, atol=0)
 
+    # ---- HybridParallelClipGrad: mp-sharded norms sum over the mp group ----
+    from paddle_tpu.distributed.fleet.meta_optimizers.hybrid_parallel_optimizer import (
+        HybridParallelClipGrad)
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+    p_sh = paddle.to_tensor(np.zeros(2, np.float32))
+    p_sh._mp_pspec = ("mp",)
+    g_sh = paddle.to_tensor(np.full(2, float(exp_mp.index(rank) + 1), np.float32))
+    p_rep = paddle.to_tensor(np.zeros(2, np.float32))
+    g_rep = paddle.to_tensor(np.full(2, 2.0, np.float32))
+    clip = HybridParallelClipGrad(ClipGradByGlobalNorm(1.0), hcg)
+    out_pg = clip([(p_sh, g_sh), (p_rep, g_rep)])
+    # true global norm: shard norms over mp (1^2*2 + 2^2*2) + replicated 2^2*2
+    true_gn = np.sqrt((1.0 + 4.0) * 2 + 4.0 * 2)
+    np.testing.assert_allclose(
+        np.asarray(out_pg[1][1]._value), np.full(2, 2.0) / true_gn, rtol=1e-5)
+
     # ---- sub-group barrier then whole-world barrier ------------------------
     dist.barrier(group=mp_group)
     dist.barrier()
