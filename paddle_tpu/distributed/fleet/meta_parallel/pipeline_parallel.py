@@ -191,6 +191,10 @@ class PipelineParallel:
     def _sync_from_compiled(self):
         if self._compiled_step is not None:
             self._compiled_step.sync_params_to_model()
+            sync_states = getattr(self._compiled_step,
+                                  "sync_states_to_optimizer", None)
+            if sync_states is not None:
+                sync_states()  # optimizer.state_dict() checkpoint parity
 
     # -- passthrough --------------------------------------------------------
     def __getattr__(self, name):

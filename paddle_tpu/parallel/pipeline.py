@@ -168,7 +168,11 @@ class PipelinedTrainStep:
         self.optimizer = optimizer
         self.remat = remat
         self._key = jax.random.key(seed)
-        self._step_i = 0
+        # resume parity: continue from a restored optimizer's step count
+        from paddle_tpu.parallel.train_step import _innermost_opt
+
+        self._step_i = (int(getattr(_innermost_opt(optimizer), "_step_count",
+                                    0) or 0) if optimizer is not None else 0)
         self._sched = (_interleave_schedule(self.S, self.V, self.M)
                        if self.V > 1 else None)
 
@@ -457,17 +461,33 @@ class PipelinedTrainStep:
         loss, self._embed_vals, self._stacked_blocks, self._head_vals, self._opt_states = out
         return Tensor(loss)
 
+    def _unstack(self, arr):
+        """[S, bps, ...] (or [S, V, bpc, ...]) -> [n_layers, ...] in layer
+        order — the inverse of the __init__ stacking."""
+        if self.V == 1:
+            return arr.reshape((self.S * self.blocks_per_stage,) + arr.shape[2:])
+        # [S, V, bpc, ...] -> layer l = position*bpc + i, position = c*S + r
+        return jnp.moveaxis(arr, 1, 0).reshape(
+            (self.S * self.blocks_per_stage,) + arr.shape[3:])
+
     def sync_params_to_model(self):
         for p, v in zip(self._embed_params, self._embed_vals):
             p._set_value(v)
         for p, v in zip(self._head_params, self._head_vals):
             p._set_value(v)
         for i, stacked in enumerate(self._stacked_blocks):
-            if self.V == 1:
-                flat = stacked.reshape((self.S * self.blocks_per_stage,) + stacked.shape[2:])
-            else:
-                # [S, V, bpc, ...] -> layer l = position*bpc + i, position = c*S + r
-                flat = jnp.moveaxis(stacked, 1, 0).reshape(
-                    (self.S * self.blocks_per_stage,) + stacked.shape[3:])
+            flat = self._unstack(stacked)
             for l, bp in enumerate(self._block_params):
                 bp[i]._set_value(flat[l])
+
+    def sync_states_to_optimizer(self):
+        """Checkpoint parity (see train_step.sync_pipeline_states_to_optimizer)."""
+        if self.optimizer is None or self._opt_states is None:
+            return
+        from paddle_tpu.parallel.train_step import (
+            sync_pipeline_states_to_optimizer)
+
+        sync_pipeline_states_to_optimizer(
+            self.optimizer, self._opt_states, self._embed_params,
+            self._head_params, self._block_params, self._unstack,
+            self._step_i)

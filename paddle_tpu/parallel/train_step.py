@@ -35,6 +35,41 @@ __all__ = ["CompiledTrainStep", "functional_call", "init_opt_states",
            "apply_optimizer_update"]
 
 
+def _innermost_opt(opt):
+    """Walk wrapper chains (HybridParallelOptimizer etc.) to the optimizer
+    whose _state/_step_count feed state_dict()."""
+    seen = set()
+    while id(opt) not in seen:
+        seen.add(id(opt))
+        inner = opt.__dict__.get("_inner_opt")
+        if inner is None:
+            break
+        opt = inner
+    return opt
+
+
+def sync_pipeline_states_to_optimizer(optimizer, states, embed_params,
+                                      head_params, block_params, unstack,
+                                      step_i):
+    """Shared checkpoint-parity sync for the pipelined runtimes
+    (PipelinedTrainStep / ZBH1PipelinedStep): flat [embed..., stacked-blocks
+    ..., head...] states written into the INNERMOST optimizer's _state, with
+    stacked block states split per layer via `unstack`."""
+    opt = _innermost_opt(optimizer)
+    ne = len(embed_params)
+    nh = len(head_params)
+    nb = len(states) - ne - nh
+    for p, st in zip(embed_params, states[:ne]):
+        opt._state[id(p)] = dict(st)
+    for p, st in zip(head_params, states[ne + nb:]):
+        opt._state[id(p)] = dict(st)
+    for i, st in enumerate(states[ne:ne + nb]):
+        flat = {k: unstack(v) for k, v in st.items()}
+        for l, bp in enumerate(block_params):
+            opt._state[id(bp[i])] = {k: v[l] for k, v in flat.items()}
+    opt._step_count = step_i
+
+
 def init_opt_states(optimizer, vals):
     """Per-array optimizer state, co-located with its (sharded) value —
     shared by the compiled pipeline runtimes."""
@@ -345,7 +380,7 @@ class CompiledTrainStep:
             jnp.asarray(self._step_i, jnp.int32),
         )
         if self.optimizer is not None:
-            self.optimizer._step_count = self._step_i
+            _innermost_opt(self.optimizer)._step_count = self._step_i
             if hasattr(self.optimizer._lr, "step") and not isinstance(self.optimizer._lr, float):
                 pass  # schedulers stepped by caller, matching eager semantics
         return Tensor(loss)
@@ -358,12 +393,15 @@ class CompiledTrainStep:
 
     def sync_states_to_optimizer(self):
         """Write the in-program optimizer state back into optimizer._state so
-        optimizer.state_dict() reflects trained moments (checkpoint parity)."""
+        optimizer.state_dict() reflects trained moments (checkpoint parity).
+        Targets the INNERMOST optimizer: wrappers delegate state_dict() there,
+        and attribute assignment on a wrapper would only shadow it."""
         if self.optimizer is None or self._opt_states is None:
             return
+        opt = _innermost_opt(self.optimizer)
         for p, st in zip(self._params, self._opt_states):
-            self.optimizer._state[id(p)] = dict(st)
-        self.optimizer._step_count = self._step_i
+            opt._state[id(p)] = dict(st)
+        opt._step_count = self._step_i
 
     @property
     def step_count(self):

@@ -144,7 +144,11 @@ class ZBH1PipelinedStep:
         self.optimizer = optimizer
         self._opt_states = None
         self._update_jit = None
-        self._step_i = 0
+        # resume parity: continue from a restored optimizer's step count
+        from paddle_tpu.parallel.train_step import _innermost_opt
+
+        self._step_i = (int(getattr(_innermost_opt(optimizer), "_step_count",
+                                    0) or 0) if optimizer is not None else 0)
         if optimizer is not None:
             from paddle_tpu.parallel.train_step import init_opt_states
 
@@ -401,6 +405,21 @@ class ZBH1PipelinedStep:
         for p, v in zip(self._head_params, self._head_vals):
             p._set_value(v)
         for i, stacked in enumerate(self._stacked_blocks):
-            flat = stacked.reshape((self.S * self.bps,) + stacked.shape[2:])
+            flat = self._unstack(stacked)
             for l, bp in enumerate(self._block_params):
                 bp[i]._set_value(flat[l])
+
+    def _unstack(self, arr):
+        return arr.reshape((self.S * self.bps,) + arr.shape[2:])
+
+    def sync_states_to_optimizer(self):
+        """Checkpoint parity (see train_step.sync_pipeline_states_to_optimizer)."""
+        if self.optimizer is None or self._opt_states is None:
+            return
+        from paddle_tpu.parallel.train_step import (
+            sync_pipeline_states_to_optimizer)
+
+        sync_pipeline_states_to_optimizer(
+            self.optimizer, self._opt_states, self._embed_params,
+            self._head_params, self._block_params, self._unstack,
+            self._step_i)

@@ -187,6 +187,15 @@ class TestZBH1FleetMode:
         from paddle_tpu.parallel.zero_bubble import ZBH1PipelinedStep
 
         assert isinstance(model._compiled_step, ZBH1PipelinedStep)
+        # checkpoint parity: optimizer.state_dict() reflects trained moments
+        # after a sync (reference DygraphShardingOptimizer state handling)
+        model._sync_from_compiled()
+        sd = opt.state_dict()
+        assert sd["step"] == 3
+        moment_entries = [v for k, v in sd.items() if k.startswith("param_")]
+        assert moment_entries, "no optimizer state checkpointed"
+        assert any(np.abs(np.asarray(m["m"])).max() > 0
+                   for m in moment_entries if "m" in m)
         set_mesh(None)
         assert l2 < l1 < l0
 
