@@ -107,17 +107,23 @@ def broadcast_dp_parameters(model, hcg):
                         src_rank=ranks[0] if ranks else 0)
 
 
+def _group_ranks_of(hcg, accessor: str):
+    """Rank list from an hcg group accessor, or None when unavailable —
+    the shared extraction behind every broadcast_*_parameters."""
+    try:
+        group = getattr(hcg, accessor)()
+        return list(getattr(group, "ranks", []) or []) or None
+    except AttributeError:
+        return None
+
+
 def broadcast_sep_parameters(model, hcg):
     """reference hybrid_parallel_util broadcast_sep_parameters: params start
     identical across the sep group (the wrapper replicates weights)."""
-    ranks = None
-    try:
-        sep_group = hcg.get_sep_parallel_group()
-        ranks = list(getattr(sep_group, "ranks", []) or []) or None
-    except AttributeError:
-        pass
-    sync_params_buffers(model, ranks=ranks,
-                        src_rank=ranks[0] if ranks else 0)
+    ranks = _group_ranks_of(hcg, "get_sep_parallel_group")
+    if ranks is None:
+        return  # group unknown: a full-world broadcast could clobber shards
+    sync_params_buffers(model, ranks=ranks, src_rank=ranks[0])
 
 
 def _is_mp_sharded(p) -> bool:
@@ -131,16 +137,20 @@ def broadcast_mp_parameters(model, hcg):
     stats, row-parallel biases) are broadcast; mp-SHARDED weights (marked
     here with _mp_pspec) are per-rank different by construction and must
     not be overwritten."""
-    ranks = None
-    try:
-        mp_group = hcg.get_model_parallel_group()
-        ranks = list(getattr(mp_group, "ranks", []) or []) or None
-    except AttributeError:
-        pass
-    sync_params_buffers(model, ranks=ranks,
-                        src_rank=ranks[0] if ranks else 0,
+    ranks = _group_ranks_of(hcg, "get_model_parallel_group")
+    if ranks is None:
+        return
+    sync_params_buffers(model, ranks=ranks, src_rank=ranks[0],
                         skip_param=_is_mp_sharded)
 
 
 def broadcast_sharding_parameters(model, hcg):
-    pass
+    """reference :201 broadcast_sharding_parameters: replicas across the
+    sharding group start from the group leader's params+buffers (the ZeRO
+    stages shard STATE, not the wrapped layer's weights). No-op when the
+    group can't be resolved — a full-world fallback broadcast would clobber
+    mp-sharded weights."""
+    ranks = _group_ranks_of(hcg, "get_sharding_parallel_group")
+    if ranks is None:
+        return
+    sync_params_buffers(model, ranks=ranks, src_rank=ranks[0])
