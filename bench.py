@@ -23,6 +23,13 @@ detail.pipeline: compiled-1F1B schedule overhead measured on the virtual
 8-device CPU mesh — step time across microbatch counts must scale like the
 (M + S - 1) tick theory, so the recorded ratio vs theory exposes any
 schedule bubble beyond fill+drain.
+
+Round-5 probe honesty fix: both pipeline probes now run FULL TRAIN STEPS
+(live gradients + SGD update). Through round 4 the 1F1B probe passed
+optimizer=None, whose grads are dead code — XLA DCE'd the entire backward,
+so zbh1_* (which does return grads) was being compared against a
+forward-only 1F1B: the 7.4x "ZB-H1 pessimization" in BENCH_r04 was an
+artifact of that asymmetry, not a property of either schedule.
 """
 from __future__ import annotations
 
@@ -88,8 +95,14 @@ paddle.seed(0)
 times = {}
 zb_times = {}
 for M in (4, 16):
-    blocks = [Block() for _ in range(S)]
-    step = PipelinedTrainStep(Emb(), blocks, Head(), loss_fn, optimizer=None,
+    emb, blocks, head = Emb(), [Block() for _ in range(S)], Head()
+    # LIVE gradients + update: with optimizer=None the grads are dead code
+    # and XLA removes the whole backward, so the probe would time a
+    # forward-only schedule (the r4 probe's flaw)
+    params = (emb.parameters() + [p for b in blocks for p in b.parameters()]
+              + head.parameters())
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=params)
+    step = PipelinedTrainStep(emb, blocks, head, loss_fn, optimizer=opt,
                               num_micro=M, remat=False)
     mb = 8
     ids = np.random.RandomState(0).randint(0, V, (M * mb, 32)).astype(np.int64)
@@ -110,13 +123,20 @@ for M in (4, 16):
             from paddle_tpu.parallel.zero_bubble import ZBH1PipelinedStep
 
             paddle.seed(0)
-            zstep = ZBH1PipelinedStep(Emb(), [Block() for _ in range(S)],
-                                      Head(), loss_fn, num_micro=M)
-            float(zstep.run(ids, ids)[0])  # compile
+            zemb = Emb()
+            zblocks = [Block() for _ in range(S)]
+            zhead = Head()
+            zparams = (zemb.parameters()
+                       + [p for b in zblocks for p in b.parameters()]
+                       + zhead.parameters())
+            zopt = paddle.optimizer.SGD(learning_rate=0.0, parameters=zparams)
+            zstep = ZBH1PipelinedStep(zemb, zblocks, zhead, loss_fn,
+                                      num_micro=M, optimizer=zopt)
+            float(zstep(ids, ids))  # compile
             ts = []
             for _ in range(3):
                 t0 = time.perf_counter()
-                float(zstep.run(ids, ids)[0])
+                float(zstep(ids, ids))
                 ts.append(time.perf_counter() - t0)
             zb_times[M] = min(ts)
         except Exception:

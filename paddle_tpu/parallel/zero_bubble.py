@@ -3,23 +3,49 @@
 Reference parity: pipeline_zero_bubble.py (distributed/passes/
 pipeline_scheduler_pass/) executes {F, B, W} job lists per rank, where the
 backward is split into B (activation grad — on the inter-stage critical path)
-and W (weight grad — no downstream consumer, fills the drain bubble).
+and W (weight grad — no downstream consumer, fills the drain bubble). The
+reference realises the split by cutting matmul_grad into its dX and dW
+matmuls (pipeline_zero_bubble.py:111) with zero recompute; this module does
+the same generically at the jaxpr level.
 
-TPU-native design: the zb_h1_schedule tick TABLE (pipeline_schedules.py) is
-compiled into ONE XLA program — a lax.scan over ticks inside shard_map over
-the 'pp' axis. Each tick every rank dispatches its scheduled op through
-lax.switch (idle/F/B/W branches are collective-free; the two ppermutes — one
-forward activation hop, one backward cotangent hop — run unconditionally
-every tick, so SPMD ranks never diverge on collectives). Microbatch-keyed
-stashes carry (stage input, arriving cotangent) between F, B and W ticks;
-their capacities are computed statically from the table (max live window).
+TPU-native design (round-5 rewrite): the static zb_h1_schedule tick table is
+UNROLLED into one XLA program (shard_map over 'pp'):
 
-Cost accounting (honest): B and W each re-run the stage forward (vjp-based
-split — the same recompute a remat'd 1F1B backward performs once), so one
-microbatch costs F + (F+Bx) + (F+Bw) FLOPs vs remat-1F1B's F + (F+Bx+Bw):
-one extra forward per microbatch buys the bubble reduction. The parity test
-checks grads match the dense model exactly; the probe measures the idle
-(bubble) fraction against the compiled 1F1B runtime's.
+* Zero recompute. Each F tick runs the stage forward ONCE via `jax.vjp` and
+  extracts the vjp residuals with `jax.closure_convert`; B and W ticks replay
+  slices of a pre-built backward jaxpr on the stashed residuals.
+* True B/W split with a cut. `_split_bwd` partitions the backward jaxpr into
+  the dX slice (B: every equation the input cotangent needs) and the dW
+  remainder (W); interior cotangents crossing the cut are EXPORTED by B and
+  consumed by W, so W recomputes nothing — the generic analog of splitting
+  matmul_grad into its dX and dW matmuls. The per-stage block loop is
+  unrolled (no lax.scan) so the cut lands between individual matmuls.
+* SSA stashes. Because the tick loop is unrolled (T is static), residuals,
+  arrived activations/cotangents and cut values are plain traced values
+  selected by static `where(rank == r, ...)` chains — no carried ring
+  buffers, no dynamic_update_slice copies, no state dict flowing through the
+  switch (the round-4 tick machine paid ~13-21 ms/tick for exactly that).
+* Static hop elision. ppermute hops are emitted only on ticks that actually
+  transfer an activation (forward) or cotangent (backward); drain (all-W)
+  ticks carry no hops at all.
+* Per-tick switch specialisation. Each tick's `lax.switch` contains only the
+  op kinds present in that tick's table row, and its output tuple only the
+  components that tick can produce; grad accumulators are threaded through
+  the switch only on ticks that can update them.
+
+Labels caveat: `jax.closure_convert` hoists only inexact-dtype closure
+values; integer (label-derived) residuals stay baked in the converted
+function, so the last-stage backward jaxpr is built PER MICROBATCH with that
+microbatch's labels (statically known per tick). The same mechanism imposes
+a restriction on BLOCKS: a block backward may not save an
+activation-DERIVED integer/bool residual (e.g. a custom_vjp stashing
+`x > 0` as bool) — it would bake at the probe's zeros-input value. Standard
+blocks save float residuals (hoisted per-tick) and weight/shape-derived
+values (input-independent), both safe; the grad-parity test is the gate.
+
+Cost model: one microbatch costs F + B(dX slice) + W(dW remainder) = exactly
+one forward + one backward, like 1F1B, while the W ticks fill 1F1B's
+(S-1)/(M+S-1) drain bubble.
 """
 from __future__ import annotations
 
@@ -42,50 +68,110 @@ __all__ = ["ZBH1PipelinedStep"]
 _OP = {"F": 1, "B": 2, "W": 3}
 
 
-def _tables(sched, S):
-    """numpy per-tick tables from a schedule dict: op/mb codes plus the
-    arrival tables (what lands on each rank at the START of tick t = what its
-    neighbor sent at t-1)."""
+def _tables(sched, S, M):
+    """Static schedule tables: op/mb codes [T, S] plus, per (rank, mb), the
+    tick at which rank r runs F/B/W on that microbatch."""
     ticks = sched["ticks"]
     T = len(ticks)
     op = np.zeros((T, S), np.int32)
     mb = np.zeros((T, S), np.int32)
+    f_tick = [[-1] * M for _ in range(S)]
+    b_tick = [[-1] * M for _ in range(S)]
+    w_tick = [[-1] * M for _ in range(S)]
+    by_kind = {"F": f_tick, "B": b_tick, "W": w_tick}
     for t, row in enumerate(ticks):
         for r, cell in enumerate(row):
             if cell is not None:
-                op[t, r] = _OP[cell[0]]
-                mb[t, r] = cell[1]
-    arr_f_valid = np.zeros((T, S), bool)
-    arr_f_mb = np.zeros((T, S), np.int32)
-    arr_b_valid = np.zeros((T, S), bool)
-    arr_b_mb = np.zeros((T, S), np.int32)
-    for t in range(1, T):
-        for r in range(S):
-            if r > 0 and op[t - 1, r - 1] == _OP["F"]:
-                arr_f_valid[t, r] = True
-                arr_f_mb[t, r] = mb[t - 1, r - 1]
-            if r < S - 1 and op[t - 1, r + 1] == _OP["B"]:
-                arr_b_valid[t, r] = True
-                arr_b_mb[t, r] = mb[t - 1, r + 1]
-    return op, mb, arr_f_valid, arr_f_mb, arr_b_valid, arr_b_mb
-
-
-def _stash_capacity(sched, S, M):
-    """Max (next_f - next_w) span over the run: microbatch slots live from
-    first touch until their W completes, and per-rank F/B/W are monotone in
-    mb, so mb %% cap is collision-free when cap covers the widest window."""
-    done = {k: [[-1] * M for _ in range(S)] for k in "FBW"}
-    span = 1
-    prog = {k: [0] * S for k in "FBW"}
-    for row in sched["ticks"]:
-        for r, cell in enumerate(row):
-            if cell is not None:
                 kind, m, _ = cell
-                done[kind][r][m] = 1
-                prog[kind][r] = m + 1
-        for r in range(S):
-            span = max(span, prog["F"][r] - prog["W"][r])
-    return span + 1
+                op[t, r] = _OP[kind]
+                mb[t, r] = m
+                by_kind[kind][r][m] = t
+    return op, mb, f_tick, b_tick, w_tick
+
+
+def _eval_eqns(eqns, env, outvars):
+    """Evaluate a topologically-ordered equation list against env (the
+    core.eval_jaxpr inner loop, over a subset of equations)."""
+    from jax._src.core import Literal
+
+    def read(v):
+        return v.val if isinstance(v, Literal) else env[v]
+
+    for eqn in eqns:
+        subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+        ans = eqn.primitive.bind(*subfuns, *[read(v) for v in eqn.invars],
+                                 **bind_params)
+        if eqn.primitive.multiple_results:
+            for v, a in zip(eqn.outvars, ans):
+                env[v] = a
+        else:
+            env[eqn.outvars[0]] = ans
+    return [read(v) for v in outvars]
+
+
+def _split_bwd(closed, n_w):
+    """Split a backward jaxpr (dy, *consts) -> (w_grads..., dx) into the
+    B slice (everything dx needs) and the W remainder, with the interior
+    values crossing the cut exported by B and consumed by W — the generic,
+    zero-recompute form of the reference's matmul_grad split into its dX
+    and dW matmuls (pipeline_zero_bubble.py:111).
+
+    Returns (b_fn, w_fn, cut_avals):
+      b_fn(*args) -> (dx, cuts)          args = (dy, *consts)
+      w_fn(args, cuts) -> w_grads list
+    """
+    from jax._src.interpreters import partial_eval as pe
+    from jax._src.core import Literal
+
+    jaxpr = pe.convert_constvars_jaxpr(closed.jaxpr)
+    consts = list(closed.consts)
+    eqns = list(jaxpr.eqns)
+    outvars = list(jaxpr.outvars)
+    dx_var = outvars[-1]
+    w_vars = outvars[:n_w]
+
+    def needed_ids(roots):
+        need = {v for v in roots if not isinstance(v, Literal)}
+        sel = set()
+        for eqn in reversed(eqns):
+            if any(o in need for o in eqn.outvars):
+                sel.add(id(eqn))
+                need.update(v for v in eqn.invars
+                            if not isinstance(v, Literal))
+        return sel
+
+    ids_x = needed_ids([dx_var])
+    ids_w = needed_ids(w_vars)
+    b_eqns = [e for e in eqns if id(e) in ids_x]
+    w_eqns = [e for e in eqns if id(e) in ids_w and id(e) not in ids_x]
+    produced_b = {o for e in b_eqns for o in e.outvars}
+    cut, seen = [], set()
+    for e in w_eqns:
+        for iv in e.invars:
+            if (not isinstance(iv, Literal) and iv in produced_b
+                    and iv not in seen):
+                seen.add(iv)
+                cut.append(iv)
+    # a w output may be produced directly by the B slice (e.g. a bias grad
+    # equal to an interior cotangent reduction) — export it over the cut too
+    for v in w_vars:
+        if v in produced_b and v not in seen and not isinstance(v, Literal):
+            seen.add(v)
+            cut.append(v)
+    cut_avals = [v.aval for v in cut]
+    invars = list(jaxpr.invars)
+
+    def b_fn(*args):
+        env = dict(zip(invars, consts + list(args)))
+        outs = _eval_eqns(b_eqns, env, [dx_var] + cut)
+        return outs[0], outs[1:]
+
+    def w_fn(args, cuts):
+        env = dict(zip(invars, consts + list(args)))
+        env.update(zip(cut, cuts))
+        return _eval_eqns(w_eqns, env, w_vars)
+
+    return b_fn, w_fn, cut_avals
 
 
 class ZBH1PipelinedStep:
@@ -98,7 +184,12 @@ class ZBH1PipelinedStep:
 
     def __init__(self, embed_layer, blocks: Sequence, head_layer,
                  loss_fn: Callable, mesh: Mesh | None = None,
-                 num_micro: int = 2, seed: int = 0, optimizer=None):
+                 num_micro: int = 2, seed: int = 0, optimizer=None,
+                 debug: bool = False):
+        # debug=True additionally returns every tick's sent activation /
+        # cotangent (per rank) from run(), in self._dbg_out — the parity
+        # debugging view used by tests
+        self._debug = bool(debug)
         self.mesh = mesh if mesh is not None else get_mesh()
         if self.mesh is None or "pp" not in self.mesh.shape:
             raise ValueError("ZBH1PipelinedStep requires a mesh with a 'pp' axis")
@@ -114,10 +205,20 @@ class ZBH1PipelinedStep:
         self._key = jax.random.key(seed)
 
         self.sched = zb_h1_schedule(self.S, self.M)
-        (self._op, self._mb, self._afv, self._afm, self._abv,
-         self._abm) = _tables(self.sched, self.S)
+        (self._op, self._mb, self._f_tick, self._b_tick,
+         self._w_tick) = _tables(self.sched, self.S, self.M)
         self.T = len(self.sched["ticks"])
-        self.cap = _stash_capacity(self.sched, self.S, self.M)
+        # residual-liveness window (informational; the unrolled program's
+        # buffers are sized by XLA liveness, not by a carried ring buffer).
+        # Residuals live from a microbatch's F tick until its W tick (B only
+        # adds the cut tensors), so count the peak F->W overlap per rank.
+        self.cap = 1
+        for r in range(self.S):
+            for m in range(self.M):
+                live = sum(1 for m2 in range(self.M)
+                           if self._f_tick[r][m2] <= self._f_tick[r][m]
+                           <= self._w_tick[r][m2])
+                self.cap = max(self.cap, live)
 
         mesh = self.mesh
         self._embed_params = embed_layer.parameters()
@@ -159,12 +260,13 @@ class ZBH1PipelinedStep:
     # -- pure per-rank compute pieces ---------------------------------------
 
     def _stage_fwd(self, stage_params, x):
-        def one_block(h, layer_params):
-            out = functional_call(self.blocks[0], layer_params, (Tensor(h),))
-            return out._value if isinstance(out, Tensor) else out, None
-
-        h, _ = jax.lax.scan(one_block, x, stage_params)
-        return h
+        # unrolled block loop (NOT lax.scan): the B/W jaxpr cut must land
+        # between individual matmuls, and scans are atomic to the slicer
+        for i in range(self.bps):
+            lp = [a[i] for a in stage_params]
+            out = functional_call(self.blocks[0], lp, (Tensor(x),))
+            x = out._value if isinstance(out, Tensor) else out
+        return x
 
     def _embed_fwd(self, embed_vals, ids_mb):
         out = functional_call(self.embed, embed_vals, (Tensor(ids_mb),))
@@ -181,153 +283,373 @@ class ZBH1PipelinedStep:
     # -- the compiled schedule ----------------------------------------------
 
     def _build(self, mb_shape, ids_dtype):
-        mesh, S, M, T, cap = self.mesh, self.S, self.M, self.T, self.cap
+        mesh, S, M, T = self.mesh, self.S, self.M, self.T
+        op, mb = self._op, self._mb
+        f_tick, b_tick = self._f_tick, self._b_tick
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
         bwd_perm = [(i, (i - 1) % S) for i in range(S)]
-
-        op_t = jnp.asarray(self._op)
-        mb_t = jnp.asarray(self._mb)
-        afv_t = jnp.asarray(self._afv)
-        afm_t = jnp.asarray(self._afm)
-        abv_t = jnp.asarray(self._abv)
-        abm_t = jnp.asarray(self._abm)
+        f32 = jnp.float32
 
         def body(stacked_local, embed_vals, head_vals, ids_mb, labels_mb):
             rank = jax.lax.axis_index("pp")
             stage_params = [a[0] for a in stacked_local]
-            act_shape = mb_shape  # stage in/out share the shape (residual nets)
+            n_sp = len(stage_params)
+            n_hv = len(head_vals)
+            zero_act = jnp.zeros(mb_shape, f32)
+            inv_m = jnp.asarray(1.0 / M, f32)
 
-            zero_act = jnp.zeros(act_shape, jnp.float32)
-            state = dict(
-                instash=jnp.zeros((cap,) + act_shape, jnp.float32),
-                dystash=jnp.zeros((cap,) + act_shape, jnp.float32),
-                out_f=zero_act,
-                out_b=zero_act,
-                fwd_in=zero_act,
-                bwd_in=zero_act,
-                g_stage=[jnp.zeros_like(p) for p in stage_params],
-                g_embed=[jnp.zeros_like(v) for v in embed_vals],
-                g_head=[jnp.zeros_like(v) for v in head_vals],
-                loss=jnp.zeros((), jnp.float32),
-            )
+            # ---- one-time backward construction (probe traces; ops that
+            # feed only the probe residuals are DCE'd by XLA) -------------
+            def mid_fn(sp, xx):
+                return self._stage_fwd(sp, xx)
 
-            def set_slot(buf, m, val):
-                return jax.lax.dynamic_update_index_in_dim(
-                    buf, val, m % cap, 0)
+            # vjp residuals include the parameter tensors themselves; those
+            # are loop-invariant, so they are filtered OUT of the stash path
+            # (by tracer identity, deterministic across traces) and
+            # re-supplied from scope at B/W time — params never flow through
+            # switch outputs, zero padding, or where-chains.
+            param_ids = {id(p) for p in stage_params} | {
+                id(v) for v in head_vals}
 
-            def get_slot(buf, m):
-                return jax.lax.dynamic_index_in_dim(buf, m % cap, 0,
-                                                    keepdims=False)
+            def split_consts(consts):
+                dyn = [c for c in consts if id(c) not in param_ids]
+                pmap = {i: c for i, c in enumerate(consts)
+                        if id(c) in param_ids}
+                return dyn, pmap
 
-            def idle_br(state, m):
-                return state
+            def merge_consts(dyn, pmap, total):
+                out, di = [], 0
+                for i in range(total):
+                    if i in pmap:
+                        out.append(pmap[i])
+                    else:
+                        out.append(dyn[di])
+                        di += 1
+                return out
 
-            def f_br(state, m):
-                x = jnp.where(rank == 0,
-                              self._embed_fwd(embed_vals, ids_mb[m]),
-                              get_slot(state["instash"], m))
-                y = self._stage_fwd(stage_params, x)
-                st = dict(state)
-                st["instash"] = set_slot(state["instash"], m, x)
-                st["out_f"] = y
-                return st
+            _, vjp_m = jax.vjp(mid_fn, stage_params, zero_act)
+            pure_m, cm_ex = jax.closure_convert(vjp_m, zero_act)
+            cm_dyn_ex, cm_pmap = split_consts(cm_ex)
+            cm_total = len(cm_ex)
+            cm_shapes = [(c.shape, c.dtype) for c in cm_dyn_ex]
+            closed_m = jax.make_jaxpr(
+                lambda dy, *c: pure_m(dy, *c))(zero_act, *cm_ex)
+            bwd_m_b, bwd_m_w, cutm_avals = _split_bwd(closed_m, n_sp)
 
-            def b_br(state, m):
-                x = get_slot(state["instash"], m)
-                dy = get_slot(state["dystash"], m)
+            def last_closed(m):
+                return lambda sp, hv, xx: self._last_chain(
+                    sp, hv, xx, labels_mb[m])
 
-                def last_case(_):
-                    # cotangent 1/M: run() reports the MEAN microbatch loss
-                    lval, vjp = jax.vjp(
-                        lambda xx: self._last_chain(stage_params, head_vals,
-                                                    xx, labels_mb[m]), x)
-                    (dx,) = vjp(jnp.asarray(1.0 / M, jnp.float32))
-                    return dx, lval
+            zero_scalar = jnp.zeros((), f32)
+            # built PER MICROBATCH at BODY level: closure_convert bakes
+            # integer (label-derived) residuals into the converted function,
+            # and branch-scoped construction would leak branch tracers
+            bwd_l = {}
+            cl_shapes = cutl_avals = cl_pmap = cl_total = None
+            for m in range(M):
+                _, vjp_l = jax.vjp(last_closed(m), stage_params, head_vals,
+                                   zero_act)
+                pure_l, cl_ex = jax.closure_convert(vjp_l, zero_scalar)
+                dyn_m, pmap_m = split_consts(cl_ex)
+                shapes_m = [(c.shape, c.dtype) for c in dyn_m]
+                closed_l = jax.make_jaxpr(
+                    lambda dy, *c: pure_l(dy, *c))(zero_scalar, *cl_ex)
+                b_fn, w_fn, cuts_m = _split_bwd(closed_l, n_sp + n_hv)
+                bwd_l[m] = (b_fn, w_fn)
+                if cl_shapes is None:
+                    cl_shapes, cutl_avals = shapes_m, cuts_m
+                    cl_pmap, cl_total = pmap_m, len(cl_ex)
+                else:
+                    assert (shapes_m == cl_shapes
+                            and set(pmap_m) == set(cl_pmap)
+                            and [(a.shape, a.dtype) for a in cuts_m] == [
+                                (a.shape, a.dtype) for a in cutl_avals]), \
+                        "per-microbatch last-chain backward structure diverges"
 
-                def mid_case(_):
-                    _, vjp = jax.vjp(
-                        lambda xx: self._stage_fwd(stage_params, xx), x)
-                    (dx,) = vjp(dy)
-                    return dx, jnp.zeros((), jnp.float32)
+            def fwd_mid(x):
+                """Forward once; residuals extracted, zero recompute later."""
+                y, vjp = jax.vjp(mid_fn, stage_params, x)
+                _, consts = jax.closure_convert(vjp, zero_act)
+                dyn, pmap = split_consts(consts)
+                assert ([(c.shape, c.dtype) for c in dyn] == cm_shapes
+                        and set(pmap) == set(cm_pmap)), \
+                    "non-deterministic vjp residual structure (mid stage)"
+                return y, dyn
 
-                dx, lval = jax.lax.cond(rank == S - 1, last_case, mid_case,
-                                        None)
+            def fwd_last(x, m):
+                lossv, vjp = jax.vjp(last_closed(m), stage_params, head_vals,
+                                     x)
+                _, consts = jax.closure_convert(vjp, zero_scalar)
+                dyn, pmap = split_consts(consts)
+                assert ([(c.shape, c.dtype) for c in dyn] == cl_shapes
+                        and set(pmap) == set(cl_pmap)), \
+                    "non-deterministic vjp residual structure (last stage)"
+                return lossv, dyn
 
-                def embed_case(_):
-                    _, evjp = jax.vjp(
-                        lambda ev: self._embed_fwd(ev, ids_mb[m]), embed_vals)
-                    (ge,) = evjp(dx)
-                    return list(ge)
+            zeros_cm = [jnp.zeros(s, d) for s, d in cm_shapes]
+            zeros_cl = [jnp.zeros(s, d) for s, d in cl_shapes]
+            zeros_cutm = [jnp.zeros(a.shape, a.dtype) for a in cutm_avals]
+            zeros_cutl = [jnp.zeros(a.shape, a.dtype) for a in cutl_avals]
 
-                def no_embed(_):
-                    return [jnp.zeros_like(v) for v in embed_vals]
+            # ---- unrolled tick program -----------------------------------
+            fwd_recv = {}      # tick -> arrived activation (per-rank valid)
+            bwd_recv = {}      # tick -> arrived cotangent
+            cm_out = {}        # tick -> mid residuals produced at that F tick
+            cl_out = {}        # tick -> last-rank residuals
+            cutm_out = {}      # tick -> interior values exported by a mid B
+            cutl_out = {}      # tick -> ... by a last-rank B
+            g_sp = [jnp.zeros_like(p) for p in stage_params]
+            g_hv = [jnp.zeros_like(v) for v in head_vals]
+            g_e = [jnp.zeros_like(v) for v in embed_vals]
+            loss = jnp.zeros((), f32)
+            dbg = {}
 
-                ge = jax.lax.cond(rank == 0, embed_case, no_embed, None)
-                st = dict(state)
-                st["out_b"] = dx
-                st["g_embed"] = [a + b for a, b in zip(state["g_embed"], ge)]
-                st["loss"] = state["loss"] + lval / M
-                return st
+            for t in range(T):
+                F_rs = [r for r in range(S) if op[t, r] == 1]
+                B_rs = [r for r in range(S) if op[t, r] == 2]
+                W_rs = [r for r in range(S) if op[t, r] == 3]
+                if not (F_rs or B_rs or W_rs):
+                    continue
 
-            def w_br(state, m):
-                x = get_slot(state["instash"], m)
-                dy = get_slot(state["dystash"], m)
+                # -- static input preselection (cheap where-chains) --------
+                def chain(rs, of):
+                    val = of(rs[0])
+                    if isinstance(val, list):
+                        for r in rs[1:]:
+                            src = of(r)
+                            val = [jnp.where(rank == r, s, d)
+                                   for s, d in zip(src, val)]
+                        return val
+                    for r in rs[1:]:
+                        val = jnp.where(rank == r, of(r), val)
+                    return val
 
-                def last_case(_):
-                    _, vjp = jax.vjp(
-                        lambda sp, hv: self._last_chain(sp, hv, x,
-                                                        labels_mb[m]),
-                        stage_params, head_vals)
-                    gs, gh = vjp(jnp.asarray(1.0 / M, jnp.float32))
-                    return list(gs), list(gh)
+                x_f = None
+                if F_rs:
+                    def x_of(r):
+                        m = mb[t, r]
+                        if r == 0:
+                            return self._embed_fwd(embed_vals, ids_mb[m])
+                        return fwd_recv[f_tick[r - 1][m]]
 
-                def mid_case(_):
-                    _, vjp = jax.vjp(
-                        lambda sp: self._stage_fwd(sp, x), stage_params)
-                    (gs,) = vjp(dy)
-                    return list(gs), [jnp.zeros_like(v) for v in head_vals]
+                    x_f = chain(F_rs, x_of)
 
-                gs, gh = jax.lax.cond(rank == S - 1, last_case, mid_case,
-                                      None)
-                gs, gh = list(gs), list(gh)
-                st = dict(state)
-                st["g_stage"] = [a + b for a, b in zip(state["g_stage"], gs)]
-                st["g_head"] = [a + b for a, b in zip(state["g_head"], gh)]
-                return st
+                bw_rs = B_rs + W_rs
+                mid_bw = [r for r in bw_rs if r < S - 1]
+                last_bw = (S - 1) in bw_rs
+                dy_sel = (chain(mid_bw, lambda r: bwd_recv[
+                    b_tick[r + 1][mb[t, r]]]) if mid_bw else None)
+                cm_sel = (chain(mid_bw, lambda r: cm_out[
+                    f_tick[r][mb[t, r]]]) if mid_bw else None)
+                cl_sel = (cl_out[f_tick[S - 1][mb[t, S - 1]]]
+                          if last_bw else None)
+                mid_w = [r for r in W_rs if r < S - 1]
+                last_w = (S - 1) in W_rs
+                cutm_sel = (chain(mid_w, lambda r: cutm_out[
+                    b_tick[r][mb[t, r]]]) if mid_w else None)
+                cutl_sel = (cutl_out[b_tick[S - 1][mb[t, S - 1]]]
+                            if last_w else None)
 
-            def tick(state, t):
-                # 1. deliver arrivals (sent by neighbors at t-1)
-                my_op = op_t[t, rank]
-                my_mb = mb_t[t, rank]
-                afv = afv_t[t, rank]
-                abv = abv_t[t, rank]
-                afm = afm_t[t, rank]
-                abm = abm_t[t, rank]
-                inst = state["instash"]
-                inst = jnp.where(afv, set_slot(inst, afm, state["fwd_in"]),
-                                 inst)
-                dyst = state["dystash"]
-                dyst = jnp.where(abv, set_slot(dyst, abm, state["bwd_in"]),
-                                 dyst)
-                state = dict(state, instash=inst, dystash=dyst)
-                # 2. dispatch the scheduled op (collective-free branches)
-                state = jax.lax.switch(
-                    my_op,
-                    [idle_br, f_br, b_br, w_br],
-                    state, my_mb)
-                # 3. unconditional hops (every rank, every tick)
-                state = dict(
-                    state,
-                    fwd_in=jax.lax.ppermute(state["out_f"], "pp", fwd_perm),
-                    bwd_in=jax.lax.ppermute(state["out_b"], "pp", bwd_perm))
-                return state, None
+                # -- which outputs can this tick produce (static)? ---------
+                mids_f = [r for r in F_rs if r < S - 1]
+                last_f = (S - 1) in F_rs
+                mid_b = [r for r in B_rs if r < S - 1]
+                last_b = (S - 1) in B_rs
+                send_fwd = bool(mids_f)
+                send_bwd = any(r > 0 for r in B_rs)
+                prod_cm = bool(mids_f)
+                prod_cl = last_f
+                prod_loss = last_f
+                prod_cutm = bool(mid_b)
+                prod_cutl = last_b
+                upd_gsp = bool(W_rs)
+                upd_ghv = last_w
+                upd_ge = 0 in B_rs
 
-            state, _ = jax.lax.scan(tick, state, jnp.arange(T))
-            loss = jax.lax.psum(state["loss"], "pp")  # only last rank adds
-            # stack grads back over pp; embed/head grads live on one rank
-            g_stage = tuple(g[None] for g in state["g_stage"])
-            g_embed = tuple(jax.lax.psum(g, "pp") for g in state["g_embed"])
-            g_head = tuple(jax.lax.psum(g, "pp") for g in state["g_head"])
+                def ret(y=None, dx=None, cm=None, cl=None, cutm=None,
+                        cutl=None, lossv=None, gsp=None, ghv=None, ge=None):
+                    out = []
+                    if send_fwd:
+                        out.append(y if y is not None else zero_act)
+                    if send_bwd:
+                        out.append(dx if dx is not None else zero_act)
+                    if prod_cm:
+                        out.extend(cm if cm is not None else zeros_cm)
+                    if prod_cl:
+                        out.extend(cl if cl is not None else zeros_cl)
+                    if prod_cutm:
+                        out.extend(cutm if cutm is not None else zeros_cutm)
+                    if prod_cutl:
+                        out.extend(cutl if cutl is not None else zeros_cutl)
+                    if prod_loss:
+                        out.append(lossv if lossv is not None
+                                   else jnp.zeros((), f32))
+                    if upd_gsp:
+                        out.extend(gsp if gsp is not None else acc_gsp)
+                    if upd_ghv:
+                        out.extend(ghv if ghv is not None else acc_ghv)
+                    if upd_ge:
+                        out.extend(ge if ge is not None else acc_ge)
+                    return tuple(out)
+
+                def f_branch(t=t, x_f=x_f, mids_f=mids_f, last_f=last_f):
+                    m_last = mb[t, S - 1]
+                    if mids_f and last_f:
+                        def arm_last(xx):
+                            lossv, cl = fwd_last(xx, m_last)
+                            return (zero_act, zeros_cm, cl, lossv)
+
+                        def arm_mid(xx):
+                            y, cm = fwd_mid(xx)
+                            return (y, cm, zeros_cl, jnp.zeros((), f32))
+
+                        y, cm, cl, lossv = jax.lax.cond(
+                            rank == S - 1, arm_last, arm_mid, x_f)
+                        return ret(y=y, cm=cm, cl=cl, lossv=lossv)
+                    if last_f:
+                        lossv, cl = fwd_last(x_f, m_last)
+                        return ret(cl=cl, lossv=lossv)
+                    y, cm = fwd_mid(x_f)
+                    return ret(y=y, cm=cm)
+
+                def b_branch(t=t, dy_sel=dy_sel, cm_sel=cm_sel, cl_sel=cl_sel,
+                             mid_b=mid_b, last_b=last_b):
+                    cm_full = (merge_consts(cm_sel, cm_pmap, cm_total)
+                               if cm_sel is not None else None)
+                    cl_full = (merge_consts(cl_sel, cl_pmap, cl_total)
+                               if cl_sel is not None else None)
+                    if mid_b and last_b:
+                        def arm_last():
+                            dx, cuts = bwd_l[mb[t, S - 1]][0](inv_m, *cl_full)
+                            return dx, zeros_cutm, cuts
+
+                        def arm_mid():
+                            dx, cuts = bwd_m_b(dy_sel, *cm_full)
+                            return dx, cuts, zeros_cutl
+
+                        dx, cutm, cutl = jax.lax.cond(
+                            rank == S - 1, arm_last, arm_mid)
+                    elif last_b:
+                        dx, cutl = bwd_l[mb[t, S - 1]][0](inv_m, *cl_full)
+                        cutm = None
+                    else:
+                        dx, cutm = bwd_m_b(dy_sel, *cm_full)
+                        cutl = None
+                    ge = None
+                    if upd_ge:
+                        m0 = mb[t, 0]
+
+                        def egrad(dxv):
+                            _, evjp = jax.vjp(
+                                lambda ev: self._embed_fwd(ev, ids_mb[m0]),
+                                embed_vals)
+                            (g,) = evjp(dxv)
+                            return [a + b for a, b in zip(acc_ge, g)]
+
+                        ge = jax.lax.cond(
+                            rank == 0, egrad, lambda _: list(acc_ge), dx)
+                    return ret(dx=dx, cutm=cutm, cutl=cutl, ge=ge)
+
+                def w_branch(t=t, dy_sel=dy_sel, cm_sel=cm_sel, cl_sel=cl_sel,
+                             cutm_sel=cutm_sel, cutl_sel=cutl_sel,
+                             mid_w=mid_w, last_w=last_w):
+                    cm_full = (merge_consts(cm_sel, cm_pmap, cm_total)
+                               if cm_sel is not None else None)
+                    cl_full = (merge_consts(cl_sel, cl_pmap, cl_total)
+                               if cl_sel is not None else None)
+
+                    def arm_mid():
+                        gs = bwd_m_w((dy_sel, *cm_full), cutm_sel)
+                        gsp = [a + b for a, b in zip(acc_gsp, gs)]
+                        return (gsp, list(acc_ghv)) if upd_ghv else (gsp,)
+
+                    def arm_last():
+                        outs = bwd_l[mb[t, S - 1]][1](
+                            (inv_m, *cl_full), cutl_sel)
+                        gsp = [a + b for a, b in zip(acc_gsp, outs[:n_sp])]
+                        ghv = [a + b for a, b in
+                               zip(acc_ghv, outs[n_sp:n_sp + n_hv])]
+                        return (gsp, ghv)
+
+                    if mid_w and last_w:
+                        res = jax.lax.cond(rank == S - 1, arm_last, arm_mid)
+                    elif last_w:
+                        res = arm_last()
+                    else:
+                        res = arm_mid()
+                    return ret(gsp=res[0], ghv=res[1] if upd_ghv else None)
+
+                def idle_branch():
+                    return ret()
+
+                # -- assemble + dispatch the per-tick switch ---------------
+                acc_gsp = g_sp if upd_gsp else []
+                acc_ghv = g_hv if upd_ghv else []
+                acc_ge = g_e if upd_ge else []
+
+                kinds = []
+                if len(F_rs) + len(B_rs) + len(W_rs) < S:
+                    kinds.append((0, idle_branch))
+                if F_rs:
+                    kinds.append((1, f_branch))
+                if B_rs:
+                    kinds.append((2, b_branch))
+                if W_rs:
+                    kinds.append((3, w_branch))
+                lut = np.zeros(4, np.int32)
+                for pos, (code, _) in enumerate(kinds):
+                    lut[code] = pos
+                if len(kinds) == 1:
+                    out = kinds[0][1]()
+                else:
+                    my_op = jnp.asarray(op[t])[rank]
+                    idx = jnp.asarray(lut)[my_op]
+                    out = jax.lax.switch(idx, [br for _, br in kinds])
+
+                # -- unpack + post-tick bookkeeping ------------------------
+                i = 0
+                if send_fwd:
+                    fwd_recv[t] = jax.lax.ppermute(out[i], "pp", fwd_perm)
+                    if getattr(self, "_debug", False):
+                        dbg[f"y_t{t}"] = out[i]
+                    i += 1
+                if send_bwd:
+                    bwd_recv[t] = jax.lax.ppermute(out[i], "pp", bwd_perm)
+                    if getattr(self, "_debug", False):
+                        dbg[f"dx_t{t}"] = out[i]
+                    i += 1
+                if prod_cm:
+                    cm_out[t] = list(out[i:i + len(cm_shapes)])
+                    i += len(cm_shapes)
+                if prod_cl:
+                    cl_out[t] = list(out[i:i + len(cl_shapes)])
+                    i += len(cl_shapes)
+                if prod_cutm:
+                    cutm_out[t] = list(out[i:i + len(cutm_avals)])
+                    i += len(cutm_avals)
+                if prod_cutl:
+                    cutl_out[t] = list(out[i:i + len(cutl_avals)])
+                    i += len(cutl_avals)
+                if prod_loss:
+                    loss = loss + out[i] / M
+                    i += 1
+                if upd_gsp:
+                    g_sp = list(out[i:i + n_sp])
+                    i += n_sp
+                if upd_ghv:
+                    g_hv = list(out[i:i + n_hv])
+                    i += n_hv
+                if upd_ge:
+                    g_e = list(out[i:i + len(g_e)])
+                    i += len(g_e)
+
+            loss = jax.lax.psum(loss, "pp")  # only last rank contributed
+            g_stage = tuple(g[None] for g in g_sp)
+            g_embed = tuple(jax.lax.psum(g, "pp") for g in g_e)
+            g_head = tuple(jax.lax.psum(g, "pp") for g in g_hv)
+            if getattr(self, "_debug", False):
+                return loss, g_stage, g_embed, g_head, {
+                    k: v[None] for k, v in dbg.items()}
             return loss, g_stage, g_embed, g_head
 
         in_specs = (
@@ -343,6 +665,9 @@ class ZBH1PipelinedStep:
             tuple(PartitionSpec() for _ in self._embed_vals),
             tuple(PartitionSpec() for _ in self._head_vals),
         )
+        if getattr(self, "_debug", False):
+            # single prefix spec covers every debug leaf (leading dim -> pp)
+            out_specs = out_specs + (PartitionSpec("pp"),)
         smapped = _shard_map(
             lambda bl, ev, hv, i, l: body(bl, ev, hv, i, l),
             self.mesh, in_specs, out_specs)
@@ -359,9 +684,12 @@ class ZBH1PipelinedStep:
         if self._jitted is None:
             emb_probe = self._embed_fwd(self._embed_vals, ids_mb[0])
             self._build(tuple(emb_probe.shape), ids_mb.dtype)
-        loss, g_stage, g_embed, g_head = self._jitted(
+        res = self._jitted(
             tuple(self._stacked_blocks), tuple(self._embed_vals),
             tuple(self._head_vals), ids_mb, labels_mb)
+        loss, g_stage, g_embed, g_head = res[:4]
+        if getattr(self, "_debug", False):
+            self._dbg_out = res[4]
         return loss, (list(g_embed), list(g_stage), list(g_head))
 
     def __call__(self, ids, labels):
@@ -395,8 +723,12 @@ class ZBH1PipelinedStep:
         self._stacked_blocks = list(new_p[ne:ne + nb])
         self._head_vals = list(new_p[ne + nb:])
         # checkpoint parity: state_dict must reflect the trained step count
-        # (moments live in this step's _opt_states, like PipelinedTrainStep)
-        self.optimizer._step_count = self._step_i
+        # (moments live in this step's _opt_states, like PipelinedTrainStep).
+        # Write the INNERMOST optimizer: fleet wraps it and a write on the
+        # wrapper would shadow the value its state_dict() actually reads.
+        from paddle_tpu.parallel.train_step import _innermost_opt
+
+        _innermost_opt(self.optimizer)._step_count = self._step_i
         return Tensor(loss)
 
     def sync_params_to_model(self):

@@ -262,3 +262,25 @@ class TestZBH1MeasuredBubble:
         # ZB-H1's fill/drain overhead fraction must be measurably lower
         assert bz < bf, (f"zb bubble {bz:.3f} !< 1f1b bubble {bf:.3f} "
                          f"(t_zb={t_zb}, t_fb={t_fb})")
+
+
+class TestZBH1Debug:
+    def test_debug_view_matches_plain(self):
+        """debug=True returns per-tick sent activations/cotangents without
+        changing the numbers (the instrumentation used to diagnose residual
+        routing)."""
+        embed, blocks, head = _modules(4)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, V, (4 * 2, 8)).astype(np.int64)
+        mesh = build_mesh({"pp": 2})
+        plain = ZBH1PipelinedStep(embed, blocks, head, loss_fn, mesh=mesh,
+                                  num_micro=2)
+        l0, _ = plain.run(ids, ids)
+        dbg = ZBH1PipelinedStep(embed, blocks, head, loss_fn, mesh=mesh,
+                                num_micro=2, debug=True)
+        l1, _ = dbg.run(ids, ids)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        assert dbg._dbg_out and any(k.startswith("y_t") for k in dbg._dbg_out)
+        # every debug leaf is stacked over pp (one slice per rank)
+        for v in dbg._dbg_out.values():
+            assert v.shape[0] == 2
