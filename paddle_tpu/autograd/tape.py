@@ -161,6 +161,29 @@ def _topo_order(roots: list[GradNode]) -> list[GradNode]:
     return order
 
 
+_post_backward_callbacks: list = []
+_backward_depth = [0]
+
+
+def register_post_backward_callback(fn, on_error=None):
+    """Register fn() to run after each outermost backward() completes — the
+    analog of the reference EagerReducer's finalize_backward hook
+    (reducer.cc:958): DataParallel's bucketed grad sync flushes and waits
+    here. When backward itself raises, on_error() (if given) runs instead,
+    so an aborted backward resets hook-driven state without masking the
+    original exception. Returns a handle with .remove()."""
+    _post_backward_callbacks.append((fn, on_error))
+
+    class _Handle:
+        def remove(self):
+            try:
+                _post_backward_callbacks.remove((fn, on_error))
+            except ValueError:
+                pass
+
+    return _Handle()
+
+
 def backward(tensors, grad_tensors=None, retain_graph: bool = False):
     """Run reverse-mode AD from `tensors` (engine: reference backward.cc:105).
 
@@ -178,7 +201,6 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
 
     # cotangent accumulator: id(node) -> list per output slot
     cot: dict[int, list] = {}
-    node_by_id: dict[int, GradNode] = {}
     roots: list[GradNode] = []
 
     for t, g in zip(tensors, grad_tensors):
@@ -199,46 +221,95 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
             continue
         if id(node) not in cot:
             cot[id(node)] = [None] * node.n_outputs
-            node_by_id[id(node)] = node
             roots.append(node)
         idx = t._output_index
         cot[id(node)][idx] = _accumulate(cot[id(node)][idx], seed)
 
     order = _topo_order(roots)
 
+    _backward_depth[0] += 1
+    ok = False
+    try:
+        _run_backward(order, cot, retain_graph)
+        ok = True
+    finally:
+        _backward_depth[0] -= 1
+        if _backward_depth[0] == 0:
+            for cb, on_error in list(_post_backward_callbacks):
+                if ok:
+                    cb()
+                elif on_error is not None:
+                    on_error()
+
+
+def _run_backward(order, cot, retain_graph):
+    from paddle_tpu.core.tensor import Tensor  # cycle-free at call time
+
+    # leaf accumulation with dependency counting (reference
+    # GradNodeAccumulation): a leaf used by several nodes receives partial
+    # cotangents; its hooks fire ONCE, with the fully-accumulated sum, when
+    # the last consumer has contributed — hook-driven grad sync (DataParallel
+    # reducer) therefore sees complete per-backward grads, not partials.
+    leaf_pending: dict[int, int] = {}
+    leaf_sum: dict[int, object] = {}
+    for node in order:
+        for t in node.inputs:
+            if t._grad_node is None and not t.stop_gradient:
+                leaf_pending[id(t)] = leaf_pending.get(id(t), 0) + 1
+
+    def leaf_done(t):
+        ct = leaf_sum.pop(id(t), None)
+        if ct is None:
+            return
+        if t._hooks:
+            for h in t._hooks:
+                new = h(ct)
+                if new is not None:
+                    ct = new._value if isinstance(new, Tensor) else new
+        t._accumulate_grad(ct)
+
+    def leaf_contribute(t, ct):
+        if ct is not None:
+            leaf_sum[id(t)] = _accumulate(leaf_sum.get(id(t)), ct)
+        left = leaf_pending[id(t)] - 1
+        leaf_pending[id(t)] = left
+        if left == 0:
+            leaf_done(t)
+
     for node in order:
         slots = cot.pop(id(node), None)
         if slots is None or all(s is None for s in slots):
+            # node never received a cotangent: its leaf inputs will not be
+            # contributed to by it — release their dependency counts
+            for t in node.inputs:
+                if t._grad_node is None and not t.stop_gradient:
+                    leaf_contribute(t, None)
             continue
         if node.hooks:
             for h in node.hooks:
                 slots = h(slots)
         in_cts = node.apply(slots)
         for t, ct in zip(node.inputs, in_cts):
-            if ct is None or _is_float0(ct) or t.stop_gradient:
-                continue
+            dead = ct is None or _is_float0(ct) or t.stop_gradient
             prod = t._grad_node
             if prod is None:
-                if t._hooks:
-                    for h in t._hooks:
-                        new = h(ct)
-                        if new is not None:
-                            ct = new._value if isinstance(new, Tensor) else new
+                if not t.stop_gradient:
+                    leaf_contribute(t, None if dead else ct)
+                continue
+            if dead:
+                continue
+            key = id(prod)
+            if key not in cot:
+                cot[key] = [None] * prod.n_outputs
+            if t._hooks:
+                for h in t._hooks:
+                    new = h(ct)
+                    if new is not None:
+                        ct = new._value if isinstance(new, Tensor) else new
+            idx = t._output_index
+            cot[key][idx] = _accumulate(cot[key][idx], ct)
+            # intermediate tensors marked as retaining grads also get .grad
+            if t._retain_grads:
                 t._accumulate_grad(ct)
-            else:
-                key = id(prod)
-                if key not in cot:
-                    cot[key] = [None] * prod.n_outputs
-                    node_by_id[key] = prod
-                if t._hooks:
-                    for h in t._hooks:
-                        new = h(ct)
-                        if new is not None:
-                            ct = new._value if isinstance(new, Tensor) else new
-                idx = t._output_index
-                cot[key][idx] = _accumulate(cot[key][idx], ct)
-                # intermediate tensors marked as retaining grads also get .grad
-                if t._retain_grads:
-                    t._accumulate_grad(ct)
         if not retain_graph:
             node.release()

@@ -93,9 +93,12 @@ class DataParallel:
     psum fused into the step; the eager tape (and these hooks) never runs
     there, so there is no double sync.
 
-    find_unused_parameters / comm_buffer_size knobs are accepted for parity;
-    collectives are issued per-param in deterministic (parameters()) backward
-    order on every rank, the functional analog of bucketing.
+    comm_buffer_size (MB) is honored by the bucketed reducer: grads coalesce
+    into ~comm_buffer_size MB buckets flushed as single collectives on a comm
+    worker thread that overlaps the rest of backward (reference EagerReducer
+    group assembly reducer.cc:512 + FusedAllReduceSchedule :1093); grads stay
+    on device until their bucket flushes. comm_buffer_size=0 falls back to
+    one blocking collective per parameter.
     """
 
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
@@ -107,6 +110,7 @@ class DataParallel:
         self._group_ranks = list(getattr(group, "ranks", None) or []) or None
         self._grad_sync_enabled = True
         self._hook_handles = []
+        self._reducer = None
 
         from paddle_tpu.distributed import multiproc
 
@@ -116,6 +120,16 @@ class DataParallel:
 
             src = self._group_ranks[0] if self._group_ranks else 0
             sync_params_buffers(layers, comm_group=group, src_rank=src)
+            if comm_buffer_size:
+                from paddle_tpu.distributed.reducer import GradReducer
+
+                # self-registers (weakly) with the tape's post-backward hook
+                self._reducer = GradReducer(
+                    layers.parameters(),
+                    comm_buffer_size=comm_buffer_size,
+                    last_comm_buffer_size=last_comm_buffer_size,
+                    ranks=self._group_ranks,
+                    find_unused_parameters=find_unused_parameters)
         self._install_grad_hooks()
 
     # ---- grad sync --------------------------------------------------------
@@ -140,6 +154,16 @@ class DataParallel:
             if axes:
                 return jax.lax.pmean(ct, axes)
             if not multiproc.cross_process_active():
+                return None
+            if self._reducer is not None and self._reducer.handles(p):
+                # bucketed path: hand the full local grad (device-side) to
+                # the reducer; the post-backward finalize writes the bucket
+                # average into p.grad, overwriting the tape's local value
+                total = ct
+                if getattr(p, "_dp_unsynced", False) and p.grad is not None:
+                    total = ct + p.grad._value.astype(ct.dtype)
+                    p._dp_unsynced = False
+                self._reducer.on_grad(p, total)
                 return None
             prior = None
             if getattr(p, "_dp_unsynced", False) and p.grad is not None:

@@ -1,0 +1,76 @@
+"""Bucket assembly for the DP grad reducer (reference reducer.cc:512).
+
+The multi-process behavior (collective count, overlap, unused-param
+handling, tied weights) runs in tests/workers/mp_worker.py; these are the
+single-process assembly invariants.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.reducer import assign_buckets
+
+
+def _params(sizes, stop=()):
+    ps = []
+    for i, n in enumerate(sizes):
+        layer = paddle.nn.Linear(n, 1)
+        p = layer.weight  # [n, 1] f32
+        if i in stop:
+            p.stop_gradient = True
+        ps.append(p)
+    return ps
+
+
+class TestAssignBuckets:
+    def test_small_first_bucket_then_capacity(self):
+        # 1 KB params; first bucket capped at last_comm_buffer_size, the
+        # rest at comm_buffer_size (caps in MB)
+        n = 256  # 256 f32 = 1KB per param
+        ps = _params([n] * 30)
+        buckets = assign_buckets(ps, comm_buffer_size=10 / 1024,
+                                 last_comm_buffer_size=2 / 1024)
+        assert len(buckets[0].params) == 2, "first bucket must stay small"
+        assert all(len(b.params) == 10 for b in buckets[1:-1])
+        total = sum(len(b.params) for b in buckets)
+        assert total == 30
+
+    def test_reverse_order_and_stop_gradient_excluded(self):
+        ps = _params([8, 8, 8], stop=(1,))
+        buckets = assign_buckets(ps, comm_buffer_size=25)
+        flat = [p for b in buckets for p in b.params]
+        assert flat == [ps[2], ps[0]]  # reversed, trainable only
+
+    def test_dtype_split(self):
+        a = paddle.nn.Linear(8, 1).weight
+        b = paddle.nn.Linear(8, 1).weight
+        b._set_value(b._value.astype("bfloat16"))
+        buckets = assign_buckets([a, b], comm_buffer_size=25,
+                                 last_comm_buffer_size=25)
+        assert len(buckets) == 2
+        assert {bk.dtype.name for bk in buckets} == {"float32", "bfloat16"}
+
+    def test_sizes_shapes_recorded(self):
+        ps = _params([4, 6])
+        (bk,) = assign_buckets(ps, comm_buffer_size=25,
+                               last_comm_buffer_size=25)
+        assert bk.sizes == [6, 4] and bk.shapes == [(6, 1), (4, 1)]
+        assert bk.nbytes() == 10 * 4
+
+
+class TestLeafHookAccumulation:
+    def test_tied_weight_hook_fires_once_with_sum(self):
+        """Tape dependency counting: a leaf used twice gets ONE hook call
+        with the fully-accumulated cotangent (reference
+        GradNodeAccumulation), which the bucketed DP reducer relies on."""
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 4)
+        for p in lin.parameters():
+            p.stop_gradient = False
+        calls = []
+        lin.weight.register_hook(lambda g: calls.append(np.asarray(g)))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        lin(lin(x)).mean().backward()
+        assert len(calls) == 1, f"hook fired {len(calls)} times, want 1"
+        np.testing.assert_allclose(calls[0],
+                                   np.asarray(lin.weight.grad._value),
+                                   rtol=1e-6)
