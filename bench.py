@@ -267,9 +267,15 @@ def _measure(cfg, batch, seq, iters_small, iters_big, remat=False):
         lval = float(loss)
         return time.perf_counter() - t0, lval
 
-    t_small, _ = timed(iters_small)
-    t_big, loss_val = timed(iters_big)
-    dt = max(t_big - t_small, 1e-6) / (iters_big - iters_small)
+    # chip timing varies ±8% run to run; the steps themselves are cheap next
+    # to compile, so take the best differential over BENCH_REPS cycles
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    dt = float("inf")
+    loss_val = None
+    for _ in range(max(reps, 1)):
+        t_small, _ = timed(iters_small)
+        t_big, loss_val = timed(iters_big)
+        dt = min(dt, max(t_big - t_small, 1e-6) / (iters_big - iters_small))
     n_params = sum(pp.size for pp in model.parameters())
     del p, s, step, model, opt
     return {"step_s": dt, "tokens_per_sec": batch * seq / dt,

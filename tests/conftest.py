@@ -35,6 +35,7 @@ SLOW_TEST_MODULES = {
     "test_vision_ops", "test_nn_layers", "test_optimizer",
     "test_aux_subsystems", "test_fft_signal_distribution",
     "test_advice_fixes_r4", "test_static_graph", "test_jit_save_load",
+    "test_parallel_parity",
 }
 
 

@@ -47,6 +47,8 @@ python tools/bench_regression.py
 if [ "$TIER" = "nightly" ]; then
   echo "== [5] loss-curve parity (200 steps, fp32 + bf16, vs torch) =="
   PARITY_STEPS=200 PARITY_BF16=1 python -m pytest tests/test_loss_parity.py -q
+  echo "== [6] parallel-mode loss parity (200 steps, dp/mp/pp/zero2) =="
+  PARALLEL_PARITY_STEPS=200 python -m pytest tests/test_parallel_parity.py -q
 fi
 
 echo "CI PASSED"
