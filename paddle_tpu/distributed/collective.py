@@ -432,10 +432,54 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None, out_split_size
 # (FIFO order, like batch_isend_irecv's op list) emits a single-pair
 # ppermute [(src_pos, dst_pos)] — the device at dst_pos receives the value,
 # every other device receives zeros (XLA ppermute semantics). Positions are
-# the endpoints' positions along the group's mesh axis, so dst/src are global
-# ranks exactly as in the reference API.
+# the endpoints' positions along the group's mesh axis (linearized row-major
+# over a fused multi-axis group), so dst/src are global ranks exactly as in
+# the reference API.
+#
+# Pending sends are SCOPED TO THE ACTIVE TRACE (advisor r4): each entry
+# carries an OpaqueTraceState token; a recv only matches sends of its own
+# trace, and entries left by an aborted trace are pruned instead of being
+# silently wired into an unrelated program.
+#
+# batch_isend_irecv collects ALL edges first and emits batched ppermutes at
+# the batch point, so irecv may precede its isend in the op list and
+# multiple concurrent edges (including several sources in one collective)
+# ride a single ppermute — the analog of the reference's _batched_p2p_ops
+# (p2p_communication.py:322) NCCL group.
 
-_P2P_PENDING: list = []  # (axis, dst_pos, tensor) sends awaiting their recv
+_P2P_PENDING: list = []  # (trace_token, axes_key, dst_pos, tensor)
+
+
+def _trace_token():
+    from jax._src import core as _core
+
+    return _core.get_opaque_trace_state()
+
+
+def _current_sends(token):
+    """Drop entries from other (dead or unrelated) traces; return ours."""
+    keep = [e for e in _P2P_PENDING if e[0] == token]
+    if len(keep) != len(_P2P_PENDING):
+        _P2P_PENDING[:] = keep
+    return keep
+
+
+def _axes_key(group):
+    return tuple(_bound_axes(_axis_names(group)))
+
+
+def _fused_axis_size(axes) -> int:
+    n = 1
+    for a in axes:
+        n *= mesh_axis_size(a)
+    return n
+
+
+def _lin_axis_index(axes):
+    idx = jax.lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * mesh_axis_size(a) + jax.lax.axis_index(a)
+    return idx
 
 
 def _ppermute(tensor, axis, shift):
@@ -444,41 +488,48 @@ def _ppermute(tensor, axis, shift):
     return apply_op(lambda v: jax.lax.ppermute(v, axis, perm), tensor, name="ppermute")
 
 
-def _peer_pos(group: Group | None, global_rank: int, axis: str) -> int:
-    """Map a peer rank to its DEVICE position along the p2p axis (ppermute
+def _peer_pos(group: Group | None, global_rank: int, axes) -> int:
+    """Map a peer rank to its DEVICE position along the p2p axes (ppermute
     moves data between devices, so rank-list indices are only valid when they
-    coincide with axis positions).
+    coincide with axis positions). `axes` is the bound axes tuple; a fused
+    multi-axis group uses the row-major linearized position.
 
-    Single-process SPMD: peers ARE axis positions — validate range. Multi-
-    process: a process's position is well-defined only when all its devices
-    share one coordinate on the axis (Group._axis_position); anything else
-    raises rather than silently addressing the wrong chip."""
+    Single-process SPMD: peers ARE (linearized) axis positions — validate
+    range. Multi-process: a process's position is well-defined only when all
+    its devices share one coordinate on the single axis
+    (Group._axis_position); anything else raises rather than silently
+    addressing the wrong chip."""
+    if isinstance(axes, str):
+        axes = (axes,)
     g = group if group is not None else _global_group()
     r = int(global_rank)
     if get_world_size() > 1:
+        if len(axes) > 1:
+            raise NotImplementedError(
+                "multi-process in-graph p2p over a fused multi-axis group "
+                "has no 1:1 rank->position map; use a per-axis group")
         pos = g._axis_position(r)
         if pos is None:
             raise ValueError(
                 f"rank {r} has no well-defined device position along axis "
-                f"{axis!r} (its devices span several positions, or the mesh "
-                f"is absent); in-graph p2p needs a 1:1 rank->position map")
+                f"{axes[0]!r} (its devices span several positions, or the "
+                f"mesh is absent); in-graph p2p needs a 1:1 rank->position "
+                "map")
         return int(pos)
-    n = mesh_axis_size(axis)
+    n = _fused_axis_size(axes)
     if not 0 <= r < n:
         raise ValueError(
-            f"in-graph p2p peer {r} out of range for axis {axis!r} "
+            f"in-graph p2p peer {r} out of range for axes {axes!r} "
             f"(size {n}); in single-process SPMD peers are axis positions")
     return r
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    axes = _bound_axes(_axis_names(group))
+    axes = _axes_key(group)
     if axes:
-        if len(axes) > 1:
-            raise NotImplementedError(
-                "in-graph send() over a fused multi-axis group has no single "
-                "ppermute axis; use a per-axis group")
-        _P2P_PENDING.append((axes[0], _peer_pos(group, dst, axes[0]), tensor))
+        tok = _trace_token()
+        _current_sends(tok)  # prune aborted-trace leftovers
+        _P2P_PENDING.append((tok, axes, _peer_pos(group, dst, axes), tensor))
         return tensor
     if multiproc.cross_process_active():
         multiproc.store_send(np.asarray(tensor._value), dst)
@@ -492,26 +543,27 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    axes = _bound_axes(_axis_names(group))
+    axes = _axes_key(group)
     if axes:
-        if len(axes) > 1:
-            raise NotImplementedError(
-                "in-graph recv() over a fused multi-axis group has no single "
-                "ppermute axis; use a per-axis group")
-        # FIFO among sends on THIS axis — sends queued for another axis
-        # (another group) must not be consumed by this recv
+        tok = _trace_token()
+        _current_sends(tok)  # prune aborted-trace leftovers
+        # FIFO among THIS trace's sends on THIS axes key — sends queued for
+        # another axis (another group) or left by an aborted trace must not
+        # be consumed by this recv
         match = next((i for i, e in enumerate(_P2P_PENDING)
-                      if e[0] == axes[0]), None)
+                      if e[0] == tok and e[1] == axes), None)
         if match is None:
             raise RuntimeError(
-                f"in-graph recv() on axis {axes[0]!r} with no matching "
+                f"in-graph recv() on axes {axes!r} with no matching "
                 "send() earlier in this trace: SPMD p2p is a send/recv pair "
                 "forming one ppermute edge (send must appear first in "
-                "program order)")
-        axis, dst_pos, val = _P2P_PENDING.pop(match)
-        src_pos = _peer_pos(group, src, axis)
+                "program order; for recv-before-send or multi-edge patterns "
+                "use paddle_tpu.distributed.batch_isend_irecv)")
+        _, _, dst_pos, val = _P2P_PENDING.pop(match)
+        src_pos = _peer_pos(group, src, axes)
+        ax = axes[0] if len(axes) == 1 else list(axes)
         out = apply_op(
-            lambda v: jax.lax.ppermute(v, axis, [(src_pos, dst_pos)]),
+            lambda v: jax.lax.ppermute(v, ax, [(src_pos, dst_pos)]),
             val, name="p2p_ppermute")
         tensor._set_value(out._value)
         tensor._grad_node = out._grad_node
@@ -644,12 +696,102 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list: Sequence[P2POp]):
-    """reference: communication/batch_isend_irecv.py. In-graph pipeline p2p is
-    expressed as one ppermute per direction (XLA batches them on ICI)."""
-    tasks = []
-    for op in p2p_op_list:
-        tasks.append(op.op(op.tensor, op.peer, op.group))
-    return tasks
+    """reference: communication/batch_isend_irecv.py over _batched_p2p_ops
+    (p2p_communication.py:322). In-graph: ALL edges are collected first and
+    emitted as batched ppermutes at this point, so an irecv may precede its
+    isend in the op list and multiple concurrent edges (several sources,
+    incl. fused-axis groups) ride one collective. Sends pair with recvs in
+    list order per axes key (the reference's op-list pairing); edges sharing
+    shape/dtype with distinct sources and destinations share one ppermute.
+    Eager path: ops execute in order over the host data plane."""
+    ops = list(p2p_op_list)
+    if not ops:
+        return []
+    if not _axes_key(ops[0].group):
+        return [op.op(op.tensor, op.peer, op.group) for op in ops]
+
+    from collections import defaultdict
+
+    sends = defaultdict(list)
+    recvs = defaultdict(list)
+    for op in ops:
+        axes = _axes_key(op.group)
+        if not axes:
+            raise RuntimeError(
+                "batch_isend_irecv: mixed in-graph and eager ops in one "
+                "batch are not addressable")
+        pos = _peer_pos(op.group, op.peer, axes)
+        if op.op in (isend, send):
+            sends[axes].append((pos, op))
+        elif op.op in (irecv, recv):
+            recvs[axes].append((pos, op))
+        else:
+            raise ValueError(f"unsupported P2POp op {op.op!r}")
+    results = [None] * len(ops)
+    order = {id(op): i for i, op in enumerate(ops)}
+    for axes in sorted(set(sends) | set(recvs)):
+        ss, rr = sends[axes], recvs[axes]
+        if len(ss) != len(rr):
+            raise RuntimeError(
+                f"batch_isend_irecv: {len(ss)} isend vs {len(rr)} irecv on "
+                f"axes {axes!r} — every in-graph edge needs one of each")
+        # edge k: src = k-th irecv's peer position, dst = k-th isend's peer
+        edges = [(src_pos, dst_pos, sop, rop)
+                 for (dst_pos, sop), (src_pos, rop) in zip(ss, rr)]
+        # wave packing: one ppermute per set of edges with identical
+        # shape/dtype and pairwise-distinct sources and destinations
+        waves = []
+        for e in edges:
+            src_pos, dst_pos, sop, rop = e
+            sig = (tuple(sop.tensor.shape), str(sop.tensor._value.dtype))
+            for w in waves:
+                if (w["sig"] == sig
+                        and src_pos not in w["srcs"]
+                        and dst_pos not in w["dsts"]):
+                    w["edges"].append(e)
+                    w["srcs"].add(src_pos)
+                    w["dsts"].add(dst_pos)
+                    break
+            else:
+                waves.append({"sig": sig, "edges": [e],
+                              "srcs": {src_pos}, "dsts": {dst_pos}})
+        ax = axes[0] if len(axes) == 1 else list(axes)
+        for w in waves:
+            perm = [(e[0], e[1]) for e in w["edges"]]
+            vals = [e[2].tensor for e in w["edges"]]
+
+            def emit(*vs, _perm=perm, _edges=w["edges"], _axes=axes,
+                     _ax=ax):
+                # operand: each source device contributes ITS edge's value.
+                # axes/ax pinned as defaults: the static recorder replays
+                # these closures after the loop has moved on
+                if len(vs) == 1:
+                    operand = vs[0]
+                else:
+                    idx = _lin_axis_index(_axes)
+                    operand = vs[0]
+                    for (src_pos, _, _, _), v in zip(_edges[1:], vs[1:]):
+                        operand = jnp.where(idx == src_pos, v, operand)
+                return jax.lax.ppermute(operand, _ax, _perm)
+
+            out = apply_op(emit, *vals, name="batched_p2p_ppermute")
+            for e in w["edges"]:
+                src_pos, dst_pos, sop, rop = e
+
+                def mask(o, _dst=dst_pos, _axes=axes):
+                    i = _lin_axis_index(_axes)
+                    return jnp.where(i == _dst, o, jnp.zeros_like(o))
+
+                masked = (apply_op(mask, out, name="p2p_recv_mask")
+                          if len(w["edges"]) > 1 else out)
+                buf = rop.tensor
+                buf._set_value(masked._value)
+                buf._grad_node = masked._grad_node
+                buf._output_index = masked._output_index
+                buf.stop_gradient = masked.stop_gradient
+                results[order[id(rop)]] = buf
+                results[order[id(sop)]] = sop.tensor
+    return results
 
 
 def barrier(group=None):

@@ -1,21 +1,36 @@
-"""dy2static control-flow conversion (restricted AST pass) + guided errors.
+"""dy2static control-flow conversion (AST passes) + guided errors.
 
 Reference parity: paddle.jit's SOT bytecode capture (jit/sot/translate.py:32)
-and the AST dy2static package (jit/dy2static/) convert data-dependent Python
-control flow (`if tensor:`, `while tensor:`, `for i in range(tensor):`) into
-graph ops. TPU-native design: capture-by-trace makes ordinary Python the
-translator, so only DATA-DEPENDENT control flow needs help. Two pieces:
+and the AST dy2static package (jit/dy2static/ — in particular the
+return/break/continue transformers) convert data-dependent Python control
+flow (`if tensor:`, `while tensor:`, `for i in range(tensor):`) into graph
+ops. TPU-native design: capture-by-trace makes ordinary Python the
+translator, so only DATA-DEPENDENT control flow needs help. Pieces:
 
 1. Detection: `Tensor.__bool__` under a jax trace raises
    `Dy2StaticControlFlowError` naming `paddle.jit.cond/while_loop` (instead
    of jax's tracer-leak message).
-2. Conversion: `convert_control_flow(fn)` rewrites SIMPLE tensor-conditioned
-   `if`/`while`/`for ... in range(...)` statements (straight-line bodies that
-   only assign local names — no return/break/continue/yield) into
-   `lax.cond` / `lax.while_loop` / `lax.fori_loop` calls.
+2. Conversion: `convert_control_flow(fn)` runs three AST passes and compiles
+   the result (reference jit/dy2static analogs in parentheses):
+   a. loop-exit rewriting (break_continue_transformer / return_transformer):
+      `break`/`continue`/`return` inside `while`/`for range` loops become
+      boolean flags threaded through the loop carry — the loop test gains
+      `not break_flag`, statements after a flag-set are predicated, and a
+      `return` exits the loop and re-raises as a post-loop early return;
+      `for range` loops with exits are first rewritten into `while` form;
+   b. early-return splitting (return_transformer): an `if` containing
+      `return` is rewritten so both branches end in a return (the statements
+      AFTER the if are duplicated into the fall-through branch), then the
+      branch returns become assignments of one `__pt_rv_*` local and a
+      single `return` follows — the `if` is now a plain assigning branch;
+   c. the branch converter: tensor-conditioned `if`/`while`/`for range`
+      statements — now including NESTED converted blocks — become
+      `lax.cond` / `lax.while_loop` / `lax.fori_loop` calls over the
+      assigned locals.
    `StaticFunction.__call__` retries with the converted function when the
-   first trace hits the detection error; unconvertible functions re-raise
-   the guided message.
+   first trace hits the detection error; unconvertible functions (yield,
+   raise, non-tensor carried locals, structure-mismatched returns) re-raise
+   the guided message naming the offending local where possible.
 """
 from __future__ import annotations
 
@@ -63,28 +78,47 @@ def _wrap_out(vals):
                  or isinstance(v, jax.core.Tracer) else v for v in vals)
 
 
-def _unwrap_tuple(t):
-    return tuple(jnp.asarray(_v(x)) for x in t)
+def _unwrap_tuple(t, names=None):
+    """Array-ify carried locals; a non-tensor local raises the GUIDED error
+    naming the variable instead of an opaque jax failure (advisor r4)."""
+    out = []
+    for i, x in enumerate(t):
+        try:
+            out.append(jnp.asarray(_v(x)))
+        except (TypeError, ValueError) as e:
+            name = names[i] if names and i < len(names) else f"#{i}"
+            raise Dy2StaticControlFlowError(
+                f"dy2static: local '{name}' holds a non-tensor value "
+                f"({type(x).__name__}: {x!r}) across converted control "
+                f"flow, which cannot be carried through lax.cond/"
+                f"while_loop. {GUIDANCE}") from e
+    return tuple(out)
 
 
-def _pt_cvt_if(cond, true_fn, false_fn, env):
+def _pt_cvt_if(cond, true_fn, false_fn, env, names=None):
     if not _is_traced(cond):
         return true_fn(env) if bool(_v(cond)) else false_fn(env)
 
     def br(fn):
         def g(_):
-            return _unwrap_tuple(fn(env))
+            return _unwrap_tuple(fn(env), names)
 
         return g
 
-    outs = jax.lax.cond(jnp.asarray(_v(cond)).astype(bool),
-                        br(true_fn), br(false_fn), None)
+    try:
+        outs = jax.lax.cond(jnp.asarray(_v(cond)).astype(bool),
+                            br(true_fn), br(false_fn), None)
+    except TypeError as e:
+        if isinstance(e, Dy2StaticControlFlowError):
+            raise
+        raise Dy2StaticControlFlowError(
+            f"dy2static: converted branches of `if` produce mismatched "
+            f"shapes/types for locals {list(names or [])} "
+            f"({e}). {GUIDANCE}") from e
     return _wrap_out(outs)
 
 
-def _pt_cvt_while(cond_fn, body_fn, carry):
-    from paddle_tpu.core.tensor import Tensor
-
+def _pt_cvt_while(cond_fn, body_fn, carry, names=None):
     probe = cond_fn(tuple(carry))
     if not _is_traced(probe) and not any(_is_traced(c) for c in carry):
         carry = tuple(carry)
@@ -96,13 +130,21 @@ def _pt_cvt_while(cond_fn, body_fn, carry):
         return jnp.asarray(_v(cond_fn(_wrap_out(cu)))).astype(bool)
 
     def b(cu):
-        return _unwrap_tuple(body_fn(_wrap_out(cu)))
+        return _unwrap_tuple(body_fn(_wrap_out(cu)), names)
 
-    outs = jax.lax.while_loop(c, b, _unwrap_tuple(carry))
+    try:
+        outs = jax.lax.while_loop(c, b, _unwrap_tuple(carry, names))
+    except TypeError as e:
+        if isinstance(e, Dy2StaticControlFlowError):
+            raise
+        raise Dy2StaticControlFlowError(
+            f"dy2static: converted `while` carry changes shape/type across "
+            f"iterations for locals {list(names or [])} ({e}). "
+            f"{GUIDANCE}") from e
     return _wrap_out(outs)
 
 
-def _pt_cvt_for(n, body_fn, carry):
+def _pt_cvt_for(n, body_fn, carry, names=None):
     if not _is_traced(n):
         carry = tuple(carry)
         for i in range(int(_v(n))):
@@ -112,15 +154,47 @@ def _pt_cvt_for(n, body_fn, carry):
     def b(i, cu):
         from paddle_tpu.core.tensor import Tensor
 
-        return _unwrap_tuple(body_fn(Tensor(i), _wrap_out(cu)))
+        return _unwrap_tuple(body_fn(Tensor(i), _wrap_out(cu)), names)
 
     outs = jax.lax.fori_loop(0, jnp.asarray(_v(n)).astype(jnp.int32),
-                             b, _unwrap_tuple(carry))
+                             b, _unwrap_tuple(carry, names))
     return _wrap_out(outs)
 
 
+def _pt_and_not(flag, value):
+    """`(not flag) and value` with tensor semantics (loop-exit flags)."""
+    f = jnp.asarray(_v(flag)).astype(bool)
+    v = jnp.asarray(_v(value)).astype(bool)
+    return jnp.logical_and(jnp.logical_not(f), v)
+
+
+def _pt_or(a, b):
+    return jnp.logical_or(jnp.asarray(_v(a)).astype(bool),
+                          jnp.asarray(_v(b)).astype(bool))
+
+
+def _pt_not(a):
+    return jnp.logical_not(jnp.asarray(_v(a)).astype(bool))
+
+
+def _pt_zeros_like(x):
+    """Shape/dtype seed for a loop-carried early-return value."""
+    return jnp.zeros_like(jnp.asarray(_v(x)))
+
+
+def _pt_seed_fail(e):
+    raise Dy2StaticControlFlowError(
+        "dy2static: a `return` inside a converted loop must return a value "
+        f"derivable from PRE-loop locals (its shape seeds the loop carry); "
+        f"evaluating the seed failed with {type(e).__name__}: {e}. "
+        + GUIDANCE)
+
+
 _HELPERS = {"__pt_cvt_if": _pt_cvt_if, "__pt_cvt_while": _pt_cvt_while,
-            "__pt_cvt_for": _pt_cvt_for}
+            "__pt_cvt_for": _pt_cvt_for, "__pt_and_not": _pt_and_not,
+            "__pt_or": _pt_or, "__pt_not": _pt_not,
+            "__pt_zeros_like": _pt_zeros_like,
+            "__pt_seed_fail": _pt_seed_fail}
 
 
 # --------------------------------------------------------------------------
@@ -148,14 +222,272 @@ def _collect_assigned(stmts) -> set:
 
 
 def _straight_line(stmts) -> bool:
+    """No exotic control flow. Generated __pt_* defs (already-converted
+    NESTED control flow) are opaque and fine — their bodies are not
+    descended into; user-defined inner defs are rejected."""
     for s in stmts:
-        for node in ast.walk(s):
+        stack = [s]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not node.name.startswith("__pt_"):
+                    return False
+                continue  # converted-subtree internals are fine
             if isinstance(node, (ast.Return, ast.Break, ast.Continue,
                                  ast.Yield, ast.YieldFrom, ast.Raise,
-                                 ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Lambda, ast.Global, ast.Nonlocal)):
                 return False
+            stack.extend(ast.iter_child_nodes(node))
     return True
+
+
+# --------------------------------------------------------------------------
+# pass a: loop-exit rewriting (reference jit/dy2static break_continue_
+# transformer + return_transformer) — break/continue/return inside loops
+# become carried boolean flags with predicated continuation
+
+
+def _call(fname, args):
+    return ast.Call(ast.Name(fname, ast.Load()), args, [])
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[ast.Name(name, ast.Store())], value=value)
+
+
+def _sets_flag(stmt, flags) -> bool:
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Name) and t.id in flags
+                        and isinstance(node.value, ast.Constant)
+                        and node.value.value is True):
+                    return True
+    return False
+
+
+def _guard_rest(stmts, flags):
+    """Predicate statements that follow a possible flag-set: the rest of the
+    block runs under `if not (f1 or f2 ...):` (tensor-safe helper calls)."""
+    out = []
+    for i, s in enumerate(stmts):
+        out.append(s)
+        if _sets_flag(s, flags) and i + 1 < len(stmts):
+            test = ast.Name(sorted(flags)[0], ast.Load())
+            for f in sorted(flags)[1:]:
+                test = _call("__pt_or", [test, ast.Name(f, ast.Load())])
+            test = _call("__pt_not", [test])
+            out.append(ast.If(test=test,
+                              body=_guard_rest(stmts[i + 1:], flags),
+                              orelse=[]))
+            return out
+    return out
+
+
+def _guard_deep(stmts, flags):
+    """_guard_rest applied recursively inside if-branches (a statement after
+    `break`/`continue` INSIDE the same branch must be predicated too); does
+    not descend into nested loops or defs — their exits are their own."""
+    rewritten = []
+    for s in stmts:
+        if isinstance(s, ast.If):
+            rewritten.append(ast.If(test=s.test,
+                                    body=_guard_deep(s.body, flags),
+                                    orelse=_guard_deep(s.orelse, flags)))
+        else:
+            rewritten.append(s)
+    return _guard_rest(rewritten, flags)
+
+
+def _rewrite_exits(stmts, brk, cont, retf, rv, state):
+    """Replace break/continue/return belonging to THIS loop level (recursion
+    stops at nested loops / function defs)."""
+    out = []
+    for s in stmts:
+        if isinstance(s, ast.Break):
+            state["brk"] = True
+            out.append(_assign(brk, ast.Constant(True)))
+        elif isinstance(s, ast.Continue):
+            state["cont"] = True
+            out.append(_assign(cont, ast.Constant(True)))
+        elif isinstance(s, ast.Return):
+            state["ret"] = True
+            val = s.value if s.value is not None else ast.Constant(0.0)
+            if "ret_expr" not in state:
+                import copy as _copy
+
+                state["ret_expr"] = _copy.deepcopy(val)
+            out.append(_assign(rv, val))
+            out.append(_assign(retf, ast.Constant(True)))
+            out.append(_assign(brk, ast.Constant(True)))
+        elif isinstance(s, ast.If):
+            out.append(ast.If(
+                test=s.test,
+                body=_rewrite_exits(s.body, brk, cont, retf, rv, state),
+                orelse=_rewrite_exits(s.orelse, brk, cont, retf, rv, state)))
+        elif isinstance(s, (ast.While, ast.For, ast.FunctionDef,
+                            ast.AsyncFunctionDef)):
+            out.append(s)  # exits inside belong to the inner construct
+        else:
+            out.append(s)
+    return out
+
+
+class _LoopExitPass(ast.NodeTransformer):
+    """Bottom-up: rewrite while/for-range loops containing break/continue/
+    return into flag-carried whiles; a loop return re-raises as a post-loop
+    early return (consumed by the split pass)."""
+
+    def __init__(self):
+        self.k = 0
+
+    def _loop_has_exit(self, body) -> bool:
+        found = [False]
+
+        def walk(stmts):
+            for s in stmts:
+                if isinstance(s, (ast.Break, ast.Continue, ast.Return)):
+                    found[0] = True
+                elif isinstance(s, ast.If):
+                    walk(s.body)
+                    walk(s.orelse)
+        walk(body)
+        return found[0]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or not self._loop_has_exit(node.body):
+            return node
+        return self._rewrite(node.test, node.body)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (node.orelse or not self._loop_has_exit(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range"
+                        and len(node.iter.args) == 1)):
+            return node
+        # for x in range(n) with exits -> while form (index incremented at
+        # iteration START so `continue` cannot skip it)
+        k = self.k
+        iname, nname = f"__pt_fi_{k}", f"__pt_fn_{k}"
+        body = ([_assign(node.target.id, ast.Name(iname, ast.Load())),
+                 _assign(iname, ast.BinOp(ast.Name(iname, ast.Load()),
+                                          ast.Add(), ast.Constant(1)))]
+                + list(node.body))
+        test = ast.Compare(ast.Name(iname, ast.Load()), [ast.Lt()],
+                           [ast.Name(nname, ast.Load())])
+        pre = [_assign(iname, ast.Constant(0)),
+               _assign(nname, node.iter.args[0])]
+        return pre + self._rewrite(test, body)
+
+    def _rewrite(self, test, body):
+        k = self.k
+        self.k += 1
+        brk, cont = f"__pt_brk_{k}", f"__pt_cont_{k}"
+        retf, rv = f"__pt_lret_{k}", f"__pt_lrv_{k}"
+        state = {}
+        body = _rewrite_exits(body, brk, cont, retf, rv, state)
+        flags = set()
+        if state.get("brk") or state.get("ret"):
+            flags.add(brk)
+        if state.get("cont"):
+            flags.add(cont)
+        body = _guard_deep(body, flags)
+        if state.get("cont"):
+            body = [_assign(cont, ast.Constant(False))] + body
+        new_test = (_call("__pt_and_not",
+                          [ast.Name(brk, ast.Load()), test])
+                    if brk in flags else test)
+        pre = [_assign(brk, ast.Constant(False))]
+        if state.get("cont"):
+            pre.append(_assign(cont, ast.Constant(False)))
+        post = []
+        if state.get("ret"):
+            pre.append(_assign(retf, ast.Constant(False)))
+            seed = _assign(rv, _call("__pt_zeros_like", [state["ret_expr"]]))
+            handler = ast.ExceptHandler(
+                type=ast.Name("Exception", ast.Load()), name="__pt_e",
+                body=[ast.Expr(_call("__pt_seed_fail",
+                                     [ast.Name("__pt_e", ast.Load())]))])
+            pre.append(ast.Try(body=[seed], handlers=[handler], orelse=[],
+                               finalbody=[]))
+            post = [ast.If(test=ast.Name(retf, ast.Load()),
+                           body=[ast.Return(ast.Name(rv, ast.Load()))],
+                           orelse=[])]
+        return pre + [ast.While(test=new_test, body=body, orelse=[])] + post
+
+
+# --------------------------------------------------------------------------
+# pass b: early-return splitting (reference return_transformer) — an `if`
+# containing `return` absorbs the statements that follow it into its
+# fall-through paths, then every path's return becomes one local assignment
+
+
+def _has_return(stmts) -> bool:
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            return True
+        if isinstance(s, ast.If):
+            if _has_return(s.body) or _has_return(s.orelse):
+                return True
+    return False
+
+
+def _ends_return(stmts) -> bool:
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return _ends_return(last.body) and _ends_return(last.orelse)
+    return False
+
+
+def _returns_to_assign(stmts, rv):
+    out = []
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            out.append(_assign(
+                rv, s.value if s.value is not None else ast.Constant(0.0)))
+        elif isinstance(s, ast.If):
+            out.append(ast.If(test=s.test,
+                              body=_returns_to_assign(s.body, rv),
+                              orelse=_returns_to_assign(s.orelse, rv)))
+        else:
+            out.append(s)
+    return out
+
+
+def _split_returns(stmts, counter):
+    import copy as _copy
+
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.If) and (_has_return(s.body)
+                                      or _has_return(s.orelse)):
+            rest = stmts[i + 1:]
+            j = counter[0]
+            counter[0] += 1
+            rv = f"__pt_frv_{j}"
+            tb = list(s.body)
+            if not _ends_return(tb):
+                tb += ([_copy.deepcopy(r) for r in rest]
+                       or [ast.Return(ast.Constant(0.0))])
+            fb = list(s.orelse)
+            if not _ends_return(fb):
+                fb += ([_copy.deepcopy(r) for r in rest]
+                       or [ast.Return(ast.Constant(0.0))])
+            tb = _returns_to_assign(_split_returns(tb, counter), rv)
+            fb = _returns_to_assign(_split_returns(fb, counter), rv)
+            out.append(ast.If(test=s.test, body=tb, orelse=fb))
+            out.append(ast.Return(ast.Name(rv, ast.Load())))
+            return out
+        out.append(s)
+    return out
 
 
 def _names_tuple(names, ctx):
@@ -210,7 +542,9 @@ class _Transformer(ast.NodeTransformer):
                            [node.test,
                             ast.Name(f"__pt_true_{i}", ast.Load()),
                             ast.Name(f"__pt_false_{i}", ast.Load()),
-                            ast.Call(ast.Name("locals", ast.Load()), [], [])],
+                            ast.Call(ast.Name("locals", ast.Load()), [], []),
+                            ast.Tuple([ast.Constant(n) for n in names],
+                                      ast.Load())],
                            []))
         return [tdef, fdef, assign]
 
@@ -235,7 +569,9 @@ class _Transformer(ast.NodeTransformer):
             value=ast.Call(ast.Name("__pt_cvt_while", ast.Load()),
                            [ast.Name(f"__pt_cond_{i}", ast.Load()),
                             ast.Name(f"__pt_body_{i}", ast.Load()),
-                            _names_tuple(names, ast.Load)], []))
+                            _names_tuple(names, ast.Load),
+                            ast.Tuple([ast.Constant(n) for n in names],
+                                      ast.Load())], []))
         return [cdef, bdef, assign]
 
     def visit_For(self, node):
@@ -264,7 +600,9 @@ class _Transformer(ast.NodeTransformer):
             value=ast.Call(ast.Name("__pt_cvt_for", ast.Load()),
                            [node.iter.args[0],
                             ast.Name(f"__pt_body_{i}", ast.Load()),
-                            _names_tuple(names, ast.Load)], []))
+                            _names_tuple(names, ast.Load),
+                            ast.Tuple([ast.Constant(n) for n in names],
+                                      ast.Load())], []))
         return [bdef, assign]
 
 
@@ -286,6 +624,11 @@ def convert_control_flow(fn):
     if not isinstance(fdef, ast.FunctionDef):
         return None
     fdef.decorator_list = []  # don't re-apply @to_static etc.
+    # pass a: loop exits -> carried flags; pass b: early returns -> one
+    # assigned local per split point (reference jit/dy2static transformers)
+    fdef = _LoopExitPass().visit(fdef)
+    fdef.body = _split_returns(fdef.body, [0])
+    tree.body[0] = fdef
     tr = _Transformer()
     tree = tr.visit(tree)
     if tr.converted == 0:

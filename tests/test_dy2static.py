@@ -15,13 +15,15 @@ from paddle_tpu.jit.dy2static import (Dy2StaticControlFlowError,
 class TestDetection:
     def test_bool_on_traced_tensor_raises_guided_error(self):
         def f(x):
-            if x.sum() > 0:  # data-dependent branch, not convertible result
-                return x * 2
-            return x - 1
+            if x.sum() > 0:  # branch carries a non-tensor local: guided error
+                note = "positive"
+            else:
+                note = None
+            return x * 2 if note else x - 1
 
         sf = jit.to_static(f)
         with pytest.raises(Dy2StaticControlFlowError,
-                           match="cond.*while_loop|while_loop"):
+                           match="cond|while_loop|non-tensor"):
             sf(paddle.to_tensor(np.ones(4, np.float32)))
 
     def test_eager_bool_still_works(self):
@@ -86,19 +88,30 @@ class TestConversion:
             np.asarray(conv(x)._value),
             np.asarray(simple_if(x)._value), atol=1e-6)
 
-    def test_unconvertible_returns_none(self):
+    def test_return_in_branch_now_converts(self):
+        """round-5: early returns convert (split pass); the corpus in
+        test_dy2static_corpus.py covers the breadth."""
         def with_return_in_branch(x):
             if x.sum() > 0:
                 return x
             return -x
 
-        assert convert_control_flow(with_return_in_branch) is None
+        conv = convert_control_flow(with_return_in_branch)
+        assert conv is not None and conv.__dy2static_converted__
+        for v in (np.ones(3, np.float32), -np.ones(3, np.float32)):
+            np.testing.assert_allclose(
+                np.asarray(conv(paddle.to_tensor(v))._value),
+                np.asarray(with_return_in_branch(
+                    paddle.to_tensor(v))._value), atol=1e-6)
 
     def test_unconvertible_raises_guided_error_via_to_static(self):
         def f(x):
-            if x.sum() > 0:  # early return: AST pass must refuse
-                return x * 2
-            return x
+            acc = []
+            if x.sum() > 0:  # branch mutates a python list: unconvertible
+                acc.append(x * 2)
+            else:
+                acc.append(x)
+            return acc[0]
 
         sf = jit.to_static(f)
         with pytest.raises(Dy2StaticControlFlowError):
