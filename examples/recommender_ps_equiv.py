@@ -1,0 +1,120 @@
+"""Giant-embedding recommender: the parameter-server-equivalence demo
+(round-5 verdict item 8; PARITY.md "Parameter server" row).
+
+The reference serves sparse-training workloads with a brpc parameter server
+(paddle/fluid/distributed/ps/, the_one_ps.py): embedding tables too large
+for one trainer live sharded on PS nodes, trainers look up/update rows
+remotely. The TPU-native equivalent is demonstrated here concretely:
+
+  * the embedding table's VOCAB DIM is sharded over the 'mp' mesh axis
+    (VocabParallelEmbedding — each device holds rows [r*V/mp, (r+1)*V/mp));
+  * AdamW moments are ADDITIONALLY sharded over the 'dp' axis (ZeRO via
+    CompiledTrainStep(zero_axis='dp'));
+  * sparse id lookups hit only the owning shard, out-of-shard rows
+    contribute zeros summed by the mp allreduce — the "lookup a remote
+    table" of the PS, as one XLA program over ICI instead of brpc RPCs.
+
+The run asserts the MEASURED per-device shard sizes: every device holds
+1/mp of the table and 1/(mp*dp) of each optimizer moment, so the fittable
+table scales linearly with the pod — a v5p-64 pod at these fractions holds
+a 1B-row x 128 table + moments (~1.5 TB total state) that no single host
+could, which is the PS capability. PARITY.md cites this example.
+
+Run: python examples/recommender_ps_equiv.py
+"""
+import numpy as np
+
+from _common import ensure_cpu_mesh, env_int
+
+ensure_cpu_mesh()
+
+import jax  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: E402
+    VocabParallelEmbedding)
+from paddle_tpu.distributed.mesh import build_mesh  # noqa: E402
+from paddle_tpu.parallel import CompiledTrainStep  # noqa: E402
+
+VOCAB = env_int("VOCAB", 200_000)
+DIM = env_int("DIM", 64)
+STEPS = env_int("STEPS", 8)
+BATCH = env_int("BATCH", 64)
+SLOTS = 8  # sparse feature slots per sample
+
+
+class Recommender(nn.Layer):
+    """DLRM-lite: sparse slots -> sharded embedding -> sum-pool -> MLP."""
+
+    def __init__(self):
+        super().__init__()
+        self.emb = VocabParallelEmbedding(VOCAB, DIM)
+        self.fc1 = nn.Linear(DIM, 128)
+        self.fc2 = nn.Linear(128, 1)
+
+    def forward(self, ids, labels):
+        e = self.emb(ids)                      # [B, SLOTS, DIM]
+        pooled = e.sum(axis=1)                 # [B, DIM]
+        logit = self.fc2(F.relu(self.fc1(pooled)))[:, 0]
+        return F.binary_cross_entropy_with_logits(logit, labels)
+
+
+def main():
+    n = len(jax.devices())
+    mp = 4 if n % 4 == 0 else 2
+    dp = max(n // mp, 1)
+    mesh = build_mesh({"dp": dp, "mp": mp})
+    paddle.seed(0)
+    model = Recommender()
+    model.train()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    step = CompiledTrainStep(model, lambda out, lab: out, optimizer=opt,
+                             mesh=mesh, zero_axis="dp")
+
+    rng = np.random.RandomState(0)
+    # clicky synthetic data: ids with a learnable popularity signal
+    hot = rng.randint(0, VOCAB, 512)
+    losses = []
+    for i in range(STEPS):
+        clicks = rng.rand(BATCH) < 0.5
+        ids = rng.randint(0, VOCAB, (BATCH, SLOTS))
+        ids[clicks, 0] = hot[rng.randint(0, len(hot), clicks.sum())]
+        loss = step(paddle.to_tensor(ids.astype(np.int32)),
+                    paddle.to_tensor(clicks.astype(np.float32)),
+                    paddle.to_tensor(clicks.astype(np.float32)))
+        losses.append(float(loss))
+
+    # --- the PS-capability evidence: measured shard fractions --------------
+    step._build()
+    emb_val = step._param_vals[0]  # embedding weight is parameters()[0]
+    assert emb_val.shape == (VOCAB, DIM)
+    per_dev_rows = emb_val.addressable_shards[0].data.shape[0]
+    assert per_dev_rows == VOCAB // mp, \
+        f"table not vocab-sharded: {per_dev_rows} rows/device"
+    # optimizer moment for the embedding: sharded over dp ON TOP of mp
+    flat_m = [s for s in jax.tree_util.tree_leaves(step._opt_states)
+              if getattr(s, "shape", None) == (VOCAB, DIM)]
+    assert flat_m, "no embedding-shaped optimizer moment found"
+    m_shard = flat_m[0].addressable_shards[0].data.shape
+    per_dev_m_elems = int(np.prod(m_shard))
+    assert per_dev_m_elems == VOCAB * DIM // (mp * dp), \
+        f"moments not ZeRO-sharded on top of mp: {m_shard}/device"
+
+    table_gb = VOCAB * DIM * 4 / 1e9
+    per_dev_gb = (table_gb / mp              # weight shard
+                  + 2 * table_gb / (mp * dp))  # AdamW m+v shards
+    print(f"recommender: vocab {VOCAB} x {DIM} sharded mp={mp} dp={dp}: "
+          f"{per_dev_rows} table rows/device, moment shard {m_shard} "
+          f"/device -> {per_dev_gb:.4f} GB/device of "
+          f"{3 * table_gb:.3f} GB total state")
+    print(f"  losses {losses[0]:.4f} -> {losses[-1]:.4f}")
+    assert losses[-1] < losses[0], "recommender did not learn"
+    print(f"ps-equivalence OK: sharded-embedding + ZeRO trains "
+          f"(loss {losses[0]:.3f} -> {losses[-1]:.3f})")
+
+
+if __name__ == "__main__":
+    main()

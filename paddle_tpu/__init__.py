@@ -65,7 +65,6 @@ from paddle_tpu import batch as _batch_mod  # noqa: F401
 from paddle_tpu.batch import batch  # noqa: F401
 from paddle_tpu import callbacks  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
-from paddle_tpu import onnx  # noqa: F401
 from paddle_tpu import sysconfig  # noqa: F401
 from paddle_tpu import _C_ops  # noqa: F401
 from paddle_tpu import reader  # noqa: F401

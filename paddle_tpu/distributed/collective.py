@@ -456,14 +456,6 @@ def _trace_token():
     return _core.get_opaque_trace_state()
 
 
-def _current_sends(token):
-    """Drop entries from other (dead or unrelated) traces; return ours."""
-    keep = [e for e in _P2P_PENDING if e[0] == token]
-    if len(keep) != len(_P2P_PENDING):
-        _P2P_PENDING[:] = keep
-    return keep
-
-
 def _axes_key(group):
     return tuple(_bound_axes(_axis_names(group)))
 
@@ -528,7 +520,6 @@ def send(tensor, dst=0, group=None, sync_op=True):
     axes = _axes_key(group)
     if axes:
         tok = _trace_token()
-        _current_sends(tok)  # prune aborted-trace leftovers
         _P2P_PENDING.append((tok, axes, _peer_pos(group, dst, axes), tensor))
         return tensor
     if multiproc.cross_process_active():
@@ -546,13 +537,16 @@ def recv(tensor, src=0, group=None, sync_op=True):
     axes = _axes_key(group)
     if axes:
         tok = _trace_token()
-        _current_sends(tok)  # prune aborted-trace leftovers
         # FIFO among THIS trace's sends on THIS axes key — sends queued for
         # another axis (another group) or left by an aborted trace must not
         # be consumed by this recv
         match = next((i for i, e in enumerate(_P2P_PENDING)
                       if e[0] == tok and e[1] == axes), None)
         if match is None:
+            # sweep aborted-trace leftovers so later backwards start clean;
+            # raising is already certain, and live concurrent traces never
+            # reach this path (their sends are token-matched above)
+            _P2P_PENDING[:] = [e for e in _P2P_PENDING if e[0] == tok]
             raise RuntimeError(
                 f"in-graph recv() on axes {axes!r} with no matching "
                 "send() earlier in this trace: SPMD p2p is a send/recv pair "

@@ -226,7 +226,15 @@ class SocketPlane:
 
     def recv(self, src: int, tag: str, timeout: float = 300.0) -> np.ndarray:
         self.ensure_started()
-        out = self._inbox(src, tag).get(timeout=timeout)
+        import queue as _queue
+
+        try:
+            out = self._inbox(src, tag).get(timeout=timeout)
+        except _queue.Empty:
+            raise TimeoutError(
+                f"socket plane: recv from rank {src} (tag {tag!r}) timed "
+                f"out after {timeout}s — the peer died or never sent; check "
+                "the peer's log and the watchdog dump") from None
         # tags are single-use (seq-numbered): drop the inbox entry so the
         # dict cannot grow over a long run (the _gc_keys analog)
         with self._in_lock:

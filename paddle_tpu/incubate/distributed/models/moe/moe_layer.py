@@ -150,12 +150,19 @@ def _route(logits, rng, *, k, routing):
                            * 2.0 * eps + 1.0 - eps)
     probs = jax.nn.softmax(logits, axis=-1)
     topv, topi = jax.lax.top_k(probs, k)
+    raw_topv = topv  # pre-renormalization softmax probs
     topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
     if kind == "gshard" and cfg.get("random_routing", False):
-        # keep the second expert with probability min(1, 2*p2)
+        # reference gshard_gate.py:77-84 _random_routing: keep the second
+        # expert with probability min(1, 2*p2) where p2 is the RAW (pre-
+        # renormalization) top-2 softmax prob. Ordering note: the drop is
+        # applied here, BEFORE capacity bucketing, so dropped tokens free
+        # capacity for survivors (the GShard-paper dispatch order); the
+        # reference applies it after limit_by_capacity, so its token-drop
+        # statistics differ slightly at saturation.
         rng, sub = jax.random.split(rng)
         pr = jax.random.uniform(sub, (logits.shape[0],))
-        drop2 = 2.0 * topv[:, 1] < pr
+        drop2 = 2.0 * raw_topv[:, 1] < pr
         topi = topi.at[:, 1].set(jnp.where(drop2, -1, topi[:, 1]))
     return topv, topi, probs
 

@@ -1,0 +1,161 @@
+"""Standalone serving of `jit.save` artifacts (deployment without the
+training frontend).
+
+Reference parity: the C++ AnalysisPredictor + C API
+(paddle/fluid/inference/api/analysis_predictor.cc, inference/capi_exp/) are
+the reference's deployable product: they load the saved inference program +
+params and serve it with no Python training stack. TPU-native: the
+`jit.save` artifact is serialized StableHLO (jax.export) + parameter
+arrays; this module deserializes and executes it through PJRT using ONLY
+`jax` and `numpy` — importing no paddle_tpu model classes, layers, or the
+Tensor frontend (guarded by examples/inference_deploy.py with an import
+hook).
+
+Usage:
+    python -m paddle_tpu.inference.serve ARTIFACT [--warmup N] [--bench N]
+        [--http PORT]
+
+  --bench runs N timed inferences on synthesized (shape-derived) inputs and
+  prints one JSON line with p50/p90/p99 latency. --http serves POST /run
+  with an .npz body of arrays inp0..inpK, answering an .npz of out0..outN.
+  Parameters are made device-resident ONCE at load; benchmark inputs are
+  transferred once and reused (pinned IO), so steady-state latency measures
+  compute + output D2H only.
+"""
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import pickle
+import time
+
+import numpy as np
+
+__all__ = ["Artifact", "main"]
+
+
+_SYNTH_DIM = 1  # symbolic/batch dims synthesize at 1 for warmup/bench
+
+
+def _np_dtype(s: str):
+    if s == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(s)
+
+
+class Artifact:
+    """A loaded StableHLO deployment artifact: resident params + compiled
+    call. No model-class import happens here or below."""
+
+    def __init__(self, path: str, warmup: int = 0):
+        import jax
+        from jax import export as jexport
+
+        if not path.endswith(".pdmodel"):
+            path = path + ".pdmodel"
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self._exported = jexport.deserialize(bytearray(blob["stablehlo"]))
+        # params become device-resident once (the AnalysisPredictor's
+        # weights-on-device analog); inference calls never re-upload them
+        self._params = [jax.device_put(np.asarray(v))
+                        for v in blob["params"]]
+        jax.block_until_ready(self._params)
+        self.in_shapes = blob.get("in_shapes", [])
+        self.platform = jax.devices()[0].platform
+        self._jax = jax
+        if warmup:
+            args = self.synth_inputs()
+            for _ in range(warmup):
+                jax.block_until_ready(self._exported.call(self._params,
+                                                          args))
+
+    def synth_inputs(self):
+        """Device-resident inputs synthesized from the artifact's declared
+        shapes (symbolic dims -> 1)."""
+        arrays = []
+        for shape, dtype in self.in_shapes:
+            dims = tuple(d if isinstance(d, int) else _SYNTH_DIM
+                         for d in shape)
+            arrays.append(self._jax.device_put(
+                np.zeros(dims, _np_dtype(dtype))))
+        self._jax.block_until_ready(arrays)
+        return arrays
+
+    def run(self, arrays):
+        """One inference; returns numpy outputs."""
+        outs = self._exported.call(self._params, list(arrays))
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return [np.asarray(o) for o in outs]
+
+    def bench(self, iters: int):
+        """Timed inferences on pinned synthesized inputs; latency stats."""
+        args = self.synth_inputs()
+        lats = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            outs = self._exported.call(self._params, args)
+            self._jax.block_until_ready(outs)
+            lats.append((time.perf_counter() - t0) * 1e3)
+        lats.sort()
+
+        def pct(p):
+            return round(lats[min(int(len(lats) * p / 100),
+                                  len(lats) - 1)], 3)
+
+        return {"iters": iters, "p50_ms": pct(50), "p90_ms": pct(90),
+                "p99_ms": pct(99), "platform": self.platform}
+
+
+def _serve_http(artifact: Artifact, port: int):
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            if self.path != "/run":
+                self.send_error(404)
+                return
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            with np.load(io.BytesIO(body)) as z:
+                args = [z[f"inp{i}"] for i in range(len(z.files))]
+            outs = artifact.run(args)
+            buf = io.BytesIO()
+            np.savez(buf, **{f"out{i}": o for i, o in enumerate(outs)})
+            data = buf.getvalue()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", port), Handler)
+    print(json.dumps({"serving": True, "port": srv.server_port}), flush=True)
+    srv.serve_forever()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="serve a jit.save StableHLO artifact through PJRT "
+                    "without the paddle_tpu model frontend")
+    ap.add_argument("artifact")
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--bench", type=int, default=0)
+    ap.add_argument("--http", type=int, default=None)
+    args = ap.parse_args(argv)
+    art = Artifact(args.artifact, warmup=args.warmup)
+    if args.bench:
+        print(json.dumps(art.bench(args.bench)), flush=True)
+    if args.http is not None:
+        _serve_http(art, args.http)
+    return art
+
+
+if __name__ == "__main__":
+    main()

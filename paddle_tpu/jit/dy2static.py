@@ -312,7 +312,7 @@ def _rewrite_exits(stmts, brk, cont, retf, rv, state):
             out.append(_assign(cont, ast.Constant(True)))
         elif isinstance(s, ast.Return):
             state["ret"] = True
-            val = s.value if s.value is not None else ast.Constant(0.0)
+            val = s.value if s.value is not None else ast.Constant(None)
             if "ret_expr" not in state:
                 import copy as _copy
 
@@ -452,7 +452,7 @@ def _returns_to_assign(stmts, rv):
     for s in stmts:
         if isinstance(s, ast.Return):
             out.append(_assign(
-                rv, s.value if s.value is not None else ast.Constant(0.0)))
+                rv, s.value if s.value is not None else ast.Constant(None)))
         elif isinstance(s, ast.If):
             out.append(ast.If(test=s.test,
                               body=_returns_to_assign(s.body, rv),
@@ -473,14 +473,17 @@ def _split_returns(stmts, counter):
             j = counter[0]
             counter[0] += 1
             rv = f"__pt_frv_{j}"
+            # a fall-through path returns None (eager semantics); carrying
+            # None through lax.cond fails with the GUIDED non-tensor error
+            # rather than silently substituting a value
             tb = list(s.body)
             if not _ends_return(tb):
                 tb += ([_copy.deepcopy(r) for r in rest]
-                       or [ast.Return(ast.Constant(0.0))])
+                       or [ast.Return(ast.Constant(None))])
             fb = list(s.orelse)
             if not _ends_return(fb):
                 fb += ([_copy.deepcopy(r) for r in rest]
-                       or [ast.Return(ast.Constant(0.0))])
+                       or [ast.Return(ast.Constant(None))])
             tb = _returns_to_assign(_split_returns(tb, counter), rv)
             fb = _returns_to_assign(_split_returns(fb, counter), rv)
             out.append(ast.If(test=s.test, body=tb, orelse=fb))

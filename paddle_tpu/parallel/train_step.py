@@ -132,16 +132,22 @@ def _param_pspec(p: Tensor, mesh: Mesh | None) -> PartitionSpec:
 
 
 def _state_pspec(p_spec: PartitionSpec, state_val, axis: str | None, mesh: Mesh | None):
-    """ZeRO: shard optimizer state over `axis` on dim 0 when divisible and the
-    dim isn't already mp-sharded."""
+    """ZeRO: shard optimizer state over `axis` on the FIRST dim that is not
+    already mp-sharded and is divisible — an mp-sharded table (dim 0 over
+    'mp') still gets its moments dp-sharded on dim 1, so per-device state is
+    1/(mp*dp) of the total (the PS-scale sparse-table layout)."""
     if mesh is None or axis is None or axis not in mesh.shape or mesh.shape[axis] <= 1:
         return p_spec
     dims = list(p_spec) + [None] * (state_val.ndim - len(list(p_spec)))
     if state_val.ndim == 0:
         return PartitionSpec()
-    if dims[0] is None and state_val.shape[0] % mesh.shape[axis] == 0:
-        dims[0] = axis
-        return PartitionSpec(*dims[: state_val.ndim])
+    flat_axes = [a for entry in dims if entry
+                 for a in (entry if isinstance(entry, tuple) else (entry,))]
+    if axis not in flat_axes:  # zero-3 already shards params over `axis`
+        for d in range(state_val.ndim):
+            if dims[d] is None and state_val.shape[d] % mesh.shape[axis] == 0:
+                dims[d] = axis
+                break
     return PartitionSpec(*dims[: state_val.ndim])
 
 

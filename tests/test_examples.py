@@ -13,6 +13,7 @@ _EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
     "mnist_lenet.py", "resnet_cifar_dp.py", "bert_mlm_zero2.py",
     "llama_tp_pp.py", "llama_zero_bubble.py", "gpt_moe_ep.py",
     "static_mode_mnist.py", "inference_deploy.py",
+    "recommender_ps_equiv.py",
 ])
 def test_example_runs(script):
     env = dict(os.environ)
