@@ -20,7 +20,8 @@ table scales linearly with the pod — a v5p-64 pod at these fractions holds
 a 1B-row x 128 table + moments (~1.5 TB total state) that no single host
 could, which is the PS capability. PARITY.md cites this example.
 
-Run: python examples/recommender_ps_equiv.py
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+       python examples/recommender_ps_equiv.py
 """
 import numpy as np
 
@@ -63,7 +64,7 @@ class Recommender(nn.Layer):
 
 def main():
     n = len(jax.devices())
-    mp = 4 if n % 4 == 0 else 2
+    mp = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
     dp = max(n // mp, 1)
     mesh = build_mesh({"dp": dp, "mp": mp})
     paddle.seed(0)

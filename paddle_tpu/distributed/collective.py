@@ -520,6 +520,13 @@ def send(tensor, dst=0, group=None, sync_op=True):
     axes = _axes_key(group)
     if axes:
         tok = _trace_token()
+        if len(_P2P_PENDING) > 64:
+            import warnings
+
+            warnings.warn(
+                f"{len(_P2P_PENDING)} pending in-graph sends accumulated — "
+                "likely leftovers of aborted traces (each pins its trace); "
+                "they are never matched by other traces but do hold memory")
         _P2P_PENDING.append((tok, axes, _peer_pos(group, dst, axes), tensor))
         return tensor
     if multiproc.cross_process_active():
@@ -543,10 +550,12 @@ def recv(tensor, src=0, group=None, sync_op=True):
         match = next((i for i, e in enumerate(_P2P_PENDING)
                       if e[0] == tok and e[1] == axes), None)
         if match is None:
-            # sweep aborted-trace leftovers so later backwards start clean;
-            # raising is already certain, and live concurrent traces never
-            # reach this path (their sends are token-matched above)
-            _P2P_PENDING[:] = [e for e in _P2P_PENDING if e[0] == tok]
+            # drop THIS trace's own pending sends (they die with this
+            # raise); other tokens' entries are left untouched — they may
+            # belong to a live enclosing trace. Aborted-trace leftovers are
+            # therefore bounded by the abort count (dead traces cannot be
+            # detected reliably); the send() path warns when they pile up.
+            _P2P_PENDING[:] = [e for e in _P2P_PENDING if e[0] != tok]
             raise RuntimeError(
                 f"in-graph recv() on axes {axes!r} with no matching "
                 "send() earlier in this trace: SPMD p2p is a send/recv pair "

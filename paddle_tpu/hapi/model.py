@@ -11,7 +11,8 @@ import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.io import DataLoader, Dataset
 
-__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping", "LRScheduler"]
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRScheduler", "ReduceLROnPlateau"]
 
 
 class Callback:
@@ -47,25 +48,47 @@ class Callback:
 
 
 class ProgBarLogger(Callback):
+    """reference hapi/callbacks.py ProgBarLogger + progressbar.py: a text
+    progress bar with ETA and steps/sec at verbose=1, line-per-log_freq at
+    verbose=2."""
+
     def __init__(self, log_freq=10, verbose=2):
         self.log_freq = log_freq
         self.verbose = verbose
+        self.steps = None
+
+    def on_train_begin(self, logs=None):
+        self.steps = (getattr(self, "params", None) or {}).get("steps")
 
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
         self.t0 = time.time()
 
+    def _items(self, logs):
+        return " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                          if isinstance(v, (int, float)))
+
     def on_train_batch_end(self, step, logs=None):
-        if self.verbose and step % self.log_freq == 0:
-            items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
-                               if isinstance(v, (int, float)))
-            print(f"epoch {self.epoch} step {step}: {items}")
+        if not self.verbose or step % self.log_freq:
+            return
+        dt = max(time.time() - self.t0, 1e-9)
+        ips = (step + 1) / dt
+        if self.verbose == 1 and self.steps:
+            done = int(25 * (step + 1) / self.steps)
+            eta = (self.steps - step - 1) / max(ips, 1e-9)
+            bar = "=" * done + ">" + "." * (25 - done)
+            print(f"\rstep {step + 1}/{self.steps} [{bar}] "
+                  f"- ETA {eta:.0f}s - {ips:.1f} step/s - "
+                  f"{self._items(logs)}", end="", flush=True)
+        else:
+            print(f"epoch {self.epoch} step {step}: {self._items(logs)} "
+                  f"- {ips:.1f} step/s")
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
-                               if isinstance(v, (int, float)))
-            print(f"epoch {epoch} done in {time.time()-self.t0:.1f}s - {items}")
+            end = "\n" if self.verbose == 1 else ""
+            print(f"{end}epoch {epoch} done in {time.time()-self.t0:.1f}s "
+                  f"- {self._items(logs)}")
 
 
 class ModelCheckpoint(Callback):
@@ -105,6 +128,45 @@ class EarlyStopping(Callback):
                 self.stopped = True
 
 
+class ReduceLROnPlateau(Callback):
+    """reference hapi/callbacks.py ReduceLROnPlateau: scale the optimizer lr
+    by `factor` after `patience` evals without improvement."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=3, mode="min",
+                 min_delta=1e-4, min_lr=0.0, verbose=1):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = min_delta
+        self.min_lr = min_lr
+        self.verbose = verbose
+        self.best = None
+        self.wait = 0
+
+    def on_eval_end(self, logs=None):
+        val = (logs or {}).get(self.monitor)
+        if val is None:
+            return
+        better = (self.best is None
+                  or (self.mode == "min" and val < self.best - self.min_delta)
+                  or (self.mode == "max" and val > self.best + self.min_delta))
+        if better:
+            self.best = val
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            self.wait = 0
+            opt = self.model._optimizer
+            lr = opt.get_lr()
+            new_lr = max(lr * self.factor, self.min_lr)
+            if new_lr < lr:
+                opt.set_lr(new_lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {lr:.2e} -> {new_lr:.2e}")
+
+
 class LRScheduler(Callback):
     def __init__(self, by_step=True, by_epoch=False):
         self.by_step = by_step
@@ -130,6 +192,8 @@ class Model:
 
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._inputs = (list(inputs) if isinstance(inputs, (list, tuple))
+                        else ([inputs] if inputs is not None else None))
         self._optimizer = None
         self._loss = None
         self._metrics = []
@@ -228,8 +292,14 @@ class Model:
             cbs.append(ProgBarLogger(log_freq, verbose))
         if save_dir:
             cbs.append(ModelCheckpoint(save_freq, save_dir))
+        try:
+            n_steps = len(loader)
+        except TypeError:
+            n_steps = None
         for cb in cbs:
             cb.set_model(self)
+            cb.set_params({"steps": n_steps, "epochs": epochs,
+                           "verbose": verbose})
         history = []
         for cb in cbs:
             cb.on_train_begin()
@@ -294,8 +364,19 @@ class Model:
     # -- persistence ----------------------------------------------------------
     def save(self, path, training=True):
         self._sync_dist()
+        if not training:
+            # reference model.py save(training=False): export the INFERENCE
+            # artifact (here: jit.save's StableHLO + params, servable via
+            # paddle.inference / python -m paddle_tpu.inference.serve)
+            if self._inputs is None:
+                raise ValueError(
+                    "Model.save(training=False) exports the inference "
+                    "artifact and needs Model(network, inputs=[InputSpec...])")
+            self.network.eval()
+            paddle.jit.save(self.network, path, input_spec=self._inputs)
+            return
         paddle.save(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             paddle.save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):

@@ -172,6 +172,41 @@ class Predictor:
     def get_output_handle(self, name: str) -> Tensor:
         return self._outputs[name]
 
+    # -- warmup / latency (round-5; the AnalysisPredictor deployment story;
+    # the frontend-free variant lives in paddle_tpu.inference.serve) --------
+    def warmup(self, iters: int = 3):
+        """Compile + settle the program on synthesized inputs derived from
+        the artifact's declared shapes (symbolic dims -> 1)."""
+        from paddle_tpu.inference.serve import _np_dtype
+
+        for name, (shape, dtype) in zip(self._inputs,
+                                        self._layer.in_shapes or []):
+            if self._inputs[name]._data is None:
+                dims = tuple(d if isinstance(d, int) else 1 for d in shape)
+                self._inputs[name].copy_from_cpu(
+                    np.zeros(dims, _np_dtype(dtype)))
+        for _ in range(max(iters, 1)):
+            self.run()
+        return self
+
+    def benchmark(self, iters: int = 20):
+        """p50/p90/p99 run() latency (ms) on the currently-bound inputs."""
+        import time
+
+        self.warmup(1)
+        lats = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            self.run()
+            lats.append((time.perf_counter() - t0) * 1e3)
+        lats.sort()
+
+        def pct(p):
+            return round(lats[min(int(len(lats) * p / 100), len(lats) - 1)], 3)
+
+        return {"iters": iters, "p50_ms": pct(50), "p90_ms": pct(90),
+                "p99_ms": pct(99)}
+
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)

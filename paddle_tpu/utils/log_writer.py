@@ -64,7 +64,8 @@ class LogWriter:
                     "step": int(step)})
 
     def flush(self):
-        self._f.flush()
+        if not self._f.closed:
+            self._f.flush()
         self._since_flush = 0
         self._last_flush = time.time()
 
@@ -105,41 +106,61 @@ class LogReader:
         return sorted(out)
 
 
-def _hapi_callback_base():
-    from paddle_tpu.hapi.model import Callback
+class VisualDLCallback:
+    """hapi callback streaming per-step train scalars, per-epoch metrics and
+    eval scalars into a LogWriter (reference hapi/callbacks.py VisualDL).
+    Standalone (duck-typed) so this module never imports hapi — hapi
+    re-exports it; every hook the fit loop calls exists."""
 
-    return Callback
-
-
-class VisualDLCallback(_hapi_callback_base()):
-    """hapi callback streaming per-step loss + per-epoch metrics into a
-    LogWriter (the visualdl callback analog). Subclasses hapi Callback so
-    every hook (incl. eval) exists."""
-
-    def __init__(self, logdir="./runs", tag_prefix="train"):
-        self.writer = LogWriter(logdir)
+    def __init__(self, logdir="./runs", tag_prefix="train", log_dir=None):
+        self.writer = LogWriter(log_dir or logdir)
         self.prefix = tag_prefix
         self._step = 0
+
+    @staticmethod
+    def _num(v):
+        v = v[0] if isinstance(v, (list, tuple)) else v
+        return float(v) if isinstance(v, (int, float)) else None
 
     def on_epoch_begin(self, epoch, logs=None):
         self._epoch = epoch
 
     def on_train_batch_end(self, step, logs=None):
-        logs = logs or {}
-        if "loss" in logs:
-            v = logs["loss"]
-            v = v[0] if isinstance(v, (list, tuple)) else v
-            self.writer.add_scalar(f"{self.prefix}/loss", float(v), self._step)
+        for k, v in (logs or {}).items():
+            vv = self._num(v)
+            if vv is not None:
+                self.writer.add_scalar(f"{self.prefix}/{k}", vv, self._step)
         self._step += 1
 
     def on_epoch_end(self, epoch, logs=None):
         for k, v in (logs or {}).items():
-            try:
-                vv = v[0] if isinstance(v, (list, tuple)) else v
-                self.writer.add_scalar(f"{self.prefix}/{k}", float(vv), epoch)
-            except (TypeError, ValueError):
-                pass
+            vv = self._num(v)
+            if vv is not None:
+                self.writer.add_scalar(f"{self.prefix}/{k}", vv, epoch)
+        self.writer.flush()
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            vv = self._num(v)
+            if vv is not None:
+                self.writer.add_scalar(f"eval/{k}", vv, self._step)
         self.writer.flush()
 
     def on_train_end(self, logs=None):
         self.writer.close()
+
+    # duck-typed remainder of the hapi Callback protocol
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
