@@ -106,7 +106,28 @@ def distributed_model(model):
     if mode == ParallelMode.TENSOR_PARALLEL:
         return TensorParallel(model, hcg, get_strategy())
     if mode in (ParallelMode.DATA_PARALLEL, ParallelMode.SHARDING_PARALLEL):
-        return DataParallel(model)
+        # the strategy's DP knobs feed the bucketed reducer (reference
+        # fleet/model.py:140 passes comm_buffer_size / find_unused through).
+        # Grad sync spans the FUSED dp+sharding group (topology.py:259,
+        # built exactly for grad sync): a dp-only group would skip the
+        # sharding axis, and in SHARDING_PARALLEL mode (dp=1) it would be a
+        # singleton — silently never reducing across ranks.
+        strat = get_strategy()
+        group = None
+        try:
+            group = hcg.get_dp_sharding_parallel_group()
+        except Exception:
+            try:
+                group = hcg.get_data_parallel_group()
+            except Exception:
+                pass
+        return DataParallel(
+            model, group=group,
+            comm_buffer_size=(getattr(strat, "fuse_grad_size_in_MB", 25)
+                              if getattr(strat, "fuse_all_reduce_ops", True)
+                              else 0),
+            find_unused_parameters=getattr(strat, "find_unused_parameters",
+                                           False))
     return model
 
 

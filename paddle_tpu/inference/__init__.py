@@ -177,14 +177,17 @@ class Predictor:
     def warmup(self, iters: int = 3):
         """Compile + settle the program on synthesized inputs derived from
         the artifact's declared shapes (symbolic dims -> 1)."""
-        from paddle_tpu.inference.serve import _np_dtype
+        from paddle_tpu.inference.serve import synth_host_inputs
 
-        for name, (shape, dtype) in zip(self._inputs,
-                                        self._layer.in_shapes or []):
+        shapes = self._layer.in_shapes or []
+        if len(shapes) < len(self._inputs):
+            raise RuntimeError(
+                "warmup() needs the artifact's input shape metadata "
+                "(in_shapes); this .pdmodel predates it — re-export with "
+                "jit.save, or copy_from_cpu real inputs and call run()")
+        for name, arr in zip(self._inputs, synth_host_inputs(shapes)):
             if self._inputs[name]._data is None:
-                dims = tuple(d if isinstance(d, int) else 1 for d in shape)
-                self._inputs[name].copy_from_cpu(
-                    np.zeros(dims, _np_dtype(dtype)))
+                self._inputs[name].copy_from_cpu(arr)
         for _ in range(max(iters, 1)):
             self.run()
         return self

@@ -38,6 +38,15 @@ __all__ = ["Artifact", "main"]
 _SYNTH_DIM = 1  # symbolic/batch dims synthesize at 1 for warmup/bench
 
 
+def synth_host_inputs(in_shapes):
+    """Host arrays synthesized from an artifact's declared (shape, dtype)
+    list — the one shape-synthesis rule, shared by the standalone Artifact
+    and the in-process Predictor.warmup()."""
+    return [np.zeros(tuple(d if isinstance(d, int) else _SYNTH_DIM
+                           for d in shape), _np_dtype(dtype))
+            for shape, dtype in in_shapes]
+
+
 def _np_dtype(s: str):
     if s == "bfloat16":
         import ml_dtypes
@@ -76,12 +85,8 @@ class Artifact:
     def synth_inputs(self):
         """Device-resident inputs synthesized from the artifact's declared
         shapes (symbolic dims -> 1)."""
-        arrays = []
-        for shape, dtype in self.in_shapes:
-            dims = tuple(d if isinstance(d, int) else _SYNTH_DIM
-                         for d in shape)
-            arrays.append(self._jax.device_put(
-                np.zeros(dims, _np_dtype(dtype))))
+        arrays = [self._jax.device_put(a)
+                  for a in synth_host_inputs(self.in_shapes)]
         self._jax.block_until_ready(arrays)
         return arrays
 
