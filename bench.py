@@ -76,18 +76,26 @@ class Block(nn.Layer):
 
 
 class Head(nn.Layer):
+    # fused head+loss protocol (paddle_tpu.parallel.fused_head): the
+    # schedules then run the chunked fused CE on the last stage
     def __init__(self):
         super().__init__()
-        self.h = nn.Linear(D, V)
+        self.lm_head = nn.Linear(D, V)
+
+    def forward_features(self, x):
+        return x
 
     def forward(self, x):
-        return self.h(x)
+        return self.lm_head(x)
 
 
 def loss_fn(logits, labels):
     import paddle_tpu.nn.functional as F
 
     return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))
+
+
+loss_fn._fused_ce_spec = {"ignore_index": -100, "reduction": "mean"}
 
 
 build_mesh({"pp": S})
@@ -193,7 +201,16 @@ H100_ASSUMED_MFU = 0.40  # what a tuned Megatron-style 7B run delivers
 LLAMA2_7B_LAYERS = 32
 
 
-def _measure(cfg, batch, seq, iters_small, iters_big, remat=False):
+def _has_full_logits(lowered_text, batch, seq, vocab):
+    """True when the lowered step program holds a [tokens, vocab]-shaped
+    live intermediate (the unfused logits) in any training dtype."""
+    dims = (f"{batch}x{seq}x{vocab}", f"{batch * seq}x{vocab}")
+    return any(f"tensor<{d}x{t}>" in lowered_text
+               for d in dims for t in ("f32", "bf16", "f16"))
+
+
+def _measure(cfg, batch, seq, iters_small, iters_big, remat=False,
+             fused_head=True):
     """Train `iters_big` fori_loop steps and return differential timing.
 
     N optimizer steps inside ONE jitted fori_loop; on tunneled platforms
@@ -206,9 +223,16 @@ def _measure(cfg, batch, seq, iters_small, iters_big, remat=False):
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
+    from paddle_tpu.core.flags import flag, set_flags
     from paddle_tpu.models.llama import LlamaForCausalLM
     from paddle_tpu.parallel import CompiledTrainStep
 
+    # fused_head=False is the escape-hatch arm: the unfused head+CE
+    # baseline the fused numbers are compared against
+    prev_flags = {k: flag(k) for k in ("use_fused_head_loss",
+                                       "use_fused_cross_entropy")}
+    set_flags({"use_fused_head_loss": bool(fused_head),
+               "use_fused_cross_entropy": bool(fused_head)})
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     model.to(dtype="bfloat16")
@@ -233,15 +257,18 @@ def _measure(cfg, batch, seq, iters_small, iters_big, remat=False):
     iv = ids._value
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    flash_on_hot_path = False
-    if on_tpu:
-        # prove the Pallas flash kernel is on the hot path: the lowered step
-        # program must contain a tpu_custom_call (cheap: no XLA compile)
-        lowered = jax.jit(step._step_fn).lower(
-            step._param_vals, step._opt_states, (iv, iv, iv),
-            jax.random.key(0), jnp.asarray(1e-4, jnp.float32),
-            jnp.asarray(1, jnp.int32))
-        flash_on_hot_path = "tpu_custom_call" in lowered.as_text()
+    # prove what is on the hot path from the lowered step program (cheap: no
+    # XLA compile): the Pallas flash kernel must appear (TPU), and with the
+    # fused head the [tokens, vocab] logits must NOT
+    lowered = jax.jit(step._step_fn).lower(
+        step._param_vals, step._opt_states, (iv, iv, iv),
+        jax.random.key(0), jnp.asarray(1e-4, jnp.float32),
+        jnp.asarray(1, jnp.int32))
+    lowered_txt = lowered.as_text()
+    flash_on_hot_path = on_tpu and "tpu_custom_call" in lowered_txt
+    full_logits_live = _has_full_logits(lowered_txt, batch, seq,
+                                        cfg.vocab_size)
+    del lowered, lowered_txt
 
     def body(i, carry):
         params, states, _ = carry
@@ -278,9 +305,11 @@ def _measure(cfg, batch, seq, iters_small, iters_big, remat=False):
         dt = min(dt, max(t_big - t_small, 1e-6) / (iters_big - iters_small))
     n_params = sum(pp.size for pp in model.parameters())
     del p, s, step, model, opt
+    set_flags(prev_flags)
     return {"step_s": dt, "tokens_per_sec": batch * seq / dt,
             "n_params": int(n_params), "loss": loss_val,
-            "flash_on_hot_path": flash_on_hot_path}
+            "flash_on_hot_path": flash_on_hot_path,
+            "full_logits_live": full_logits_live}
 
 
 def main():
@@ -292,12 +321,14 @@ def main():
     on_tpu = jax.devices()[0].platform != "cpu"
 
     def llama7b_geom(layers, seq):
-        """TRUE LLaMA-2-7B layer dimensions (BASELINE.json configs[3])."""
+        """TRUE LLaMA-2-7B layer dimensions (BASELINE.json configs[3]).
+        use_parallel_cross_entropy=True: the measured path runs the
+        mp-shardable parallel softmax-CE (fused by default)."""
         return LlamaConfig(vocab_size=32000, hidden_size=4096,
                            intermediate_size=11008, num_hidden_layers=layers,
                            num_attention_heads=32, num_key_value_heads=32,
                            max_position_embeddings=seq,
-                           use_parallel_cross_entropy=False)
+                           use_parallel_cross_entropy=True)
 
     if on_tpu:
         # 3 true-7B layers + embed/head (869M params w/ full AdamW state) is
@@ -307,6 +338,10 @@ def main():
         seq = int(os.environ.get("BENCH_SEQ", 4096))
         main_m = _measure(llama7b_geom(layers, seq), batch, seq, 3, 12)
         head_m = _measure(llama7b_geom(0, seq), batch, seq, 3, 12)
+        # the "before" arm: unfused head+CE via the escape hatch, so the
+        # report carries embed_head_ms before/after on the same geometry
+        head_m_unfused = _measure(llama7b_geom(0, seq), batch, seq, 3, 12,
+                                  fused_head=False)
         peak = V5E_BF16_PEAK
     else:  # CPU smoke (CI)
         layers, batch, seq = 2, 4, 128
@@ -314,9 +349,19 @@ def main():
                           intermediate_size=256, num_hidden_layers=layers,
                           num_attention_heads=4, num_key_value_heads=4,
                           max_position_embeddings=256,
-                          use_parallel_cross_entropy=False)
-        main_m = _measure(cfg, batch, seq, 2, 5)
-        head_m = None
+                          use_parallel_cross_entropy=True)
+        # the smoke problem fits one tile under the ~4M-element auto bound
+        # (512 tokens x 1K vocab); pin a smaller token chunk so the lowered
+        # program demonstrates the chunked path (full_logits_live: false)
+        # exactly as the auto bound yields at the real 7B geometry
+        from paddle_tpu.core.flags import set_flags as _set_flags
+
+        _set_flags({"fused_ce_chunk_tokens": 128})
+        try:
+            main_m = _measure(cfg, batch, seq, 2, 5)
+        finally:
+            _set_flags({"fused_ce_chunk_tokens": 0})
+        head_m = head_m_unfused = None
         peak = 1e12
 
     # measured MFU at the benched depth
@@ -342,9 +387,20 @@ def main():
         tps_7b_v5p = mfu_7b * V5P_BF16_PEAK / fpt_7b
         h100_bar = 0.5 * H100_ASSUMED_MFU * H100_BF16_PEAK / fpt_7b
         vs_baseline = round(tps_7b_v5p / h100_bar, 4)
+        # fused-head accounting: the unfused arm's full logits vs the
+        # fused kernel's largest live tile (fp32 elements x 4 bytes)
+        from paddle_tpu.ops.pallas.fused_ce import resolve_chunks
+
+        ct, _ = resolve_chunks(batch * seq, 32000)
         projection = {
             "per_layer_ms": round(per_layer_s * 1e3, 2),
             "embed_head_ms": round(head_m["step_s"] * 1e3, 2),
+            "embed_head_ms_unfused": round(
+                head_m_unfused["step_s"] * 1e3, 2),
+            "peak_logits_bytes_unfused": int(batch * seq * 32000 * 4),
+            "peak_logits_tile_bytes_fused": int(ct * 32000 * 4),
+            "full_logits_live_fused": head_m["full_logits_live"],
+            "full_logits_live_unfused": head_m_unfused["full_logits_live"],
             "t_7b_step_ms": round(t7b * 1e3, 2),
             "params_7b": int(params_7b),
             "tokens_per_sec_per_chip_7b_v5e": round(tps_7b_v5e, 1),
@@ -370,6 +426,7 @@ def main():
                    "loss": main_m["loss"], "devices": ndev,
                    "platform": jax.devices()[0].platform,
                    "flash_on_hot_path": main_m["flash_on_hot_path"],
+                   "full_logits_live": main_m["full_logits_live"],
                    "projection_7b": projection,
                    "pipeline": pipe},
     }))

@@ -96,3 +96,10 @@ define_flag("flash_block_q", 0, "flash-attention Q tile override (0 = auto-tuned
 define_flag("flash_block_k", 0, "flash-attention K tile override (0 = auto-tuned default)", type=int)
 define_flag("flash_bwd_block_q", 0, "flash-attention BACKWARD Q tile override (0 = same as forward)", type=int)
 define_flag("flash_bwd_block_k", 0, "flash-attention BACKWARD K tile override (0 = same as forward)", type=int)
+define_flag("use_fused_cross_entropy", True,
+            "chunked fused softmax-CE fast path in F.cross_entropy (escape hatch: set False)")
+define_flag("use_fused_head_loss", True,
+            "fuse LM-head projection + CE in models/pipeline head stages (escape hatch: set False)")
+define_flag("fused_ce_chunk_tokens", 0, "fused-CE token chunk override (0 = auto ~4M-element tiles)", type=int)
+define_flag("fused_ce_chunk_vocab", 0, "fused-CE vocab chunk override (0 = auto)", type=int)
+define_flag("fused_ce_variant", "auto", "fused-CE strategy: auto|tokens|vocab|pallas")

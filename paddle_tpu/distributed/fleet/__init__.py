@@ -114,18 +114,21 @@ def distributed_model(model):
         # singleton — silently never reducing across ranks.
         strat = get_strategy()
         group = None
+        # AttributeError only: any OTHER failure in an hcg accessor must
+        # surface, not silently widen grad sync to the global world
         try:
             group = hcg.get_dp_sharding_parallel_group()
-        except Exception:
+        except AttributeError:
             try:
                 group = hcg.get_data_parallel_group()
-            except Exception:
+            except AttributeError:
                 pass
         return DataParallel(
             model, group=group,
             comm_buffer_size=(getattr(strat, "fuse_grad_size_in_MB", 25)
                               if getattr(strat, "fuse_all_reduce_ops", True)
                               else 0),
+            last_comm_buffer_size=getattr(strat, "last_comm_group_size_MB", 1),
             find_unused_parameters=getattr(strat, "find_unused_parameters",
                                            False))
     return model

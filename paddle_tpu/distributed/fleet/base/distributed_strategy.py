@@ -49,6 +49,9 @@ class DistributedStrategy:
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
+        # cap of the FIRST grad bucket (reference last_comm_group_size_MB):
+        # small so its collective posts early in backward
+        self.last_comm_group_size_MB = 1
         self.without_graph_optimization = False
         self.a_sync = False
         # everything set above is the honored surface; later unknown sets warn

@@ -161,7 +161,10 @@ class ParallelCrossEntropy(Layer):
     """reference: mp_layers.py:742 — softmax CE over vocab sharded on mp.
 
     TPU-native: logits stay vocab-sharded; the max/denominator reduce with
-    psum over the mp axis so no rank materializes the full vocab row.
+    psum over the mp axis so no rank materializes the full vocab row. The
+    hot path is the chunked fused CE kernel — `F.parallel_cross_entropy`
+    (`paddle_tpu.ops.pallas.fused_ce`), escape hatch
+    `use_fused_cross_entropy=False`.
     """
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
@@ -169,37 +172,5 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        def f(logits, lab):
-            bound = mp_axis_bound()
-            # stop_gradient BEFORE pmax: zero tangent lets the (non-differentiable)
-            # pmax primitive be skipped by AD
-            lmax = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
-            if bound:
-                lmax = jax.lax.pmax(lmax, MP_AXIS)
-            shifted = logits - lmax
-            sumexp = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
-            if bound:
-                sumexp = jax.lax.psum(sumexp, MP_AXIS)
-            logz = jnp.log(sumexp)
-            if bound:
-                # local vocab shard offset
-                n_local = logits.shape[-1]
-                start = jax.lax.axis_index(MP_AXIS) * n_local
-                local_lab = lab - start
-                in_range = (local_lab >= 0) & (local_lab < n_local)
-                safe = jnp.clip(local_lab, 0, n_local - 1)
-                picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
-                picked = jnp.where(in_range[..., None], picked, 0.0)
-                picked = jax.lax.psum(picked, MP_AXIS)
-            else:
-                picked = jnp.take_along_axis(shifted, lab[..., None], axis=-1)
-            loss = (logz - picked)[..., 0]
-            valid = lab != self.ignore_index
-            return jnp.where(valid, loss, 0.0)
-
-        lab = label
-        if lab.ndim == input.ndim:
-            from paddle_tpu.ops.manipulation import squeeze
-
-            lab = squeeze(lab, -1)
-        return apply_op(f, input, lab, name="parallel_cross_entropy")
+        return F.parallel_cross_entropy(input, label,
+                                        ignore_index=self.ignore_index)

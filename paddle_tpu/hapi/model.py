@@ -372,8 +372,18 @@ class Model:
                 raise ValueError(
                     "Model.save(training=False) exports the inference "
                     "artifact and needs Model(network, inputs=[InputSpec...])")
+            # mid-training export must not disturb layer modes: snapshot
+            # every sublayer's training flag and put each back as it was
+            # (a blanket .train() would un-freeze deliberately eval'd
+            # sublayers, e.g. frozen BN during fine-tuning)
+            modes = [(l, l.training)
+                     for l in self.network.sublayers(include_self=True)]
             self.network.eval()
-            paddle.jit.save(self.network, path, input_spec=self._inputs)
+            try:
+                paddle.jit.save(self.network, path, input_spec=self._inputs)
+            finally:
+                for l, m in modes:
+                    l.training = m
             return
         paddle.save(self.network.state_dict(), path + ".pdparams")
         if self._optimizer is not None:

@@ -5,7 +5,8 @@ from __future__ import annotations
 from paddle_tpu.nn import functional as _F
 
 __all__ = ["memory_efficient_attention", "FusedLinear",
-           "FusedMultiHeadAttention", "FusedFeedForward", "functional"]
+           "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedLinearCrossEntropy", "functional"]
 
 
 def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0, scale=None,
@@ -21,4 +22,5 @@ from paddle_tpu.nn.layer.common import Linear as FusedLinear  # noqa: E402
 from paddle_tpu.incubate.nn.fused_transformer import (  # noqa: E402
     FusedFeedForward, FusedMultiHeadAttention,
 )
+from paddle_tpu.incubate.nn.loss import FusedLinearCrossEntropy  # noqa: E402
 from paddle_tpu.incubate.nn import functional  # noqa: E402

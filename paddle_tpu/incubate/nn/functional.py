@@ -17,7 +17,12 @@ from paddle_tpu.core.tensor import Tensor, apply_op
 
 __all__ = ["swiglu", "fused_rotary_position_embedding", "fused_rms_norm",
            "fused_layer_norm", "fused_matmul_bias", "fused_dropout_add",
-           "fused_dot_product_attention", "fused_linear"]
+           "fused_dot_product_attention", "fused_linear",
+           "fused_linear_cross_entropy"]
+
+# chunked LM-head + CE without materializing logits (the Liger-kernel op
+# shape); the implementation lives on the core functional surface
+fused_linear_cross_entropy = F.fused_linear_cross_entropy
 
 
 def swiglu(x, y=None, name=None):

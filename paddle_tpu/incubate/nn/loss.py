@@ -1,0 +1,65 @@
+"""Fused loss layers.
+
+`FusedLinearCrossEntropy` is the public layer over the chunked fused
+LM-head + softmax-CE kernel (paddle_tpu.ops.pallas.fused_ce): it owns the
+vocab projection weight and computes ``CE(x @ W [+ b], labels)`` without
+ever materializing the `[tokens, vocab]` logits in forward or backward —
+the Liger-kernel fused_linear_cross_entropy / Megatron parallel-CE shape of
+the op. Under a bound "mp" mesh axis the weight is the local vocab shard
+and the softmax stats reduce over the axis (Megatron-style), so no rank
+holds a full vocab row either. See docs/fused_head_cross_entropy.md.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["FusedLinearCrossEntropy"]
+
+
+class FusedLinearCrossEntropy(Layer):
+    """loss = CE(x @ weight [+ bias], labels), logits never materialized.
+
+    Args:
+        in_features: hidden size H of the incoming activations.
+        num_classes: vocabulary size V (the LOCAL shard size under manual
+            mp sharding).
+        has_bias: add a projection bias (default False, the LM-head shape).
+        ignore_index: labels equal to this contribute zero loss.
+        reduction: "mean" (over non-ignored tokens), "sum", or "none"
+            (per-token losses shaped like labels).
+        label_smoothing: uniform smoothing mass in [0, 1).
+        z_loss: coefficient of the `z * logsumexp^2` stabilizer (PaLM/
+            Megatron), folded into the same chunked pass.
+        chunk_tokens / chunk_vocab / variant: chunking overrides forwarded
+            to the kernel (0/"auto" = flag-driven defaults).
+    """
+
+    def __init__(self, in_features, num_classes, has_bias=False,
+                 ignore_index=-100, reduction="mean", label_smoothing=0.0,
+                 z_loss=0.0, chunk_tokens=0, chunk_vocab=0, variant="auto",
+                 weight_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.num_classes = num_classes
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.label_smoothing = label_smoothing
+        self.z_loss = z_loss
+        self.chunk_tokens = chunk_tokens
+        self.chunk_vocab = chunk_vocab
+        self.variant = variant
+        self.weight = self.create_parameter(
+            [in_features, num_classes], weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = (self.create_parameter([num_classes], None, is_bias=True)
+                     if has_bias else None)
+
+    def forward(self, x, labels):
+        return F.fused_linear_cross_entropy(
+            x, self.weight, labels, bias=self.bias,
+            ignore_index=self.ignore_index, reduction=self.reduction,
+            label_smoothing=self.label_smoothing, z_loss=self.z_loss,
+            chunk_tokens=self.chunk_tokens, chunk_vocab=self.chunk_vocab,
+            variant=self.variant)

@@ -21,6 +21,14 @@ Usage:
   Parameters are made device-resident ONCE at load; benchmark inputs are
   transferred once and reused (pinned IO), so steady-state latency measures
   compute + output D2H only.
+
+.. warning:: **Trust assumption.** The ``.pdmodel`` artifact is a pickle
+  stream; ``pickle.load`` executes arbitrary code embedded in a malicious
+  file. Serve ONLY artifacts you produced yourself or obtained from a
+  trusted source over a trusted channel — treat an artifact exactly like
+  the Python code that created it. (Same posture as the reference's
+  inference program files and torch.load; see
+  docs/fused_head_cross_entropy.md "Serving trust note".)
 """
 from __future__ import annotations
 
@@ -66,6 +74,8 @@ class Artifact:
         if not path.endswith(".pdmodel"):
             path = path + ".pdmodel"
         with open(path, "rb") as f:
+            # pickle executes code from the stream: trusted artifacts only
+            # (module docstring "Trust assumption")
             blob = pickle.load(f)
         self._exported = jexport.deserialize(bytearray(blob["stablehlo"]))
         # params become device-resident once (the AnalysisPredictor's

@@ -184,10 +184,10 @@ def _pt_zeros_like(x):
 
 def _pt_seed_fail(e):
     raise Dy2StaticControlFlowError(
-        "dy2static: a `return` inside a converted loop must return a value "
-        f"derivable from PRE-loop locals (its shape seeds the loop carry); "
-        f"evaluating the seed failed with {type(e).__name__}: {e}. "
-        + GUIDANCE)
+        "dy2static: a `return` inside a converted loop or branch must "
+        "return a value derivable from locals defined BEFORE the construct "
+        f"(its shape seeds the carry); evaluating the seed failed with "
+        f"{type(e).__name__}: {e}. " + GUIDANCE)
 
 
 _HELPERS = {"__pt_cvt_if": _pt_cvt_if, "__pt_cvt_while": _pt_cvt_while,
@@ -447,24 +447,79 @@ def _ends_return(stmts) -> bool:
     return False
 
 
-def _returns_to_assign(stmts, rv):
+def _returns_to_assign(stmts, rv, rf=None):
+    """Map every `return X` to `rv = X` (plus `rf = True` when a return
+    flag is threaded)."""
     out = []
     for s in stmts:
         if isinstance(s, ast.Return):
             out.append(_assign(
                 rv, s.value if s.value is not None else ast.Constant(None)))
+            if rf is not None:
+                out.append(_assign(rf, ast.Constant(True)))
         elif isinstance(s, ast.If):
             out.append(ast.If(test=s.test,
-                              body=_returns_to_assign(s.body, rv),
-                              orelse=_returns_to_assign(s.orelse, rv)))
+                              body=_returns_to_assign(s.body, rv, rf),
+                              orelse=_returns_to_assign(s.orelse, rv, rf)))
         else:
             out.append(s)
     return out
 
 
-def _split_returns(stmts, counter):
-    import copy as _copy
+def _flag_returns(stmts, rv, rf):
+    """Convert the body of a branch whose fall-through continues in the
+    ENCLOSING scope: every `return X` becomes `rv = X; rf = True`, and the
+    statements after a maybe-returning `if` are predicated on `not rf`.
+    Unlike `_split_returns`, fall-through does NOT return None — it simply
+    leaves rf unset so the enclosing scope's trailing code runs. Each
+    trailing suffix is emitted once (linear total size)."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Return):
+            out.append(_assign(
+                rv, s.value if s.value is not None else ast.Constant(None)))
+            out.append(_assign(rf, ast.Constant(True)))
+            return out  # anything after a return is unreachable
+        if isinstance(s, ast.If) and (_has_return(s.body)
+                                      or _has_return(s.orelse)):
+            out.append(ast.If(test=s.test,
+                              body=_flag_returns(s.body, rv, rf),
+                              orelse=_flag_returns(s.orelse, rv, rf)))
+            rest = stmts[i + 1:]
+            if rest:
+                out.append(ast.If(
+                    test=_call("__pt_not", [ast.Name(rf, ast.Load())]),
+                    body=_flag_returns(rest, rv, rf), orelse=[]))
+            return out
+        out.append(s)
+    return out
 
+
+def _first_return_expr(stmts):
+    for s in stmts:
+        if isinstance(s, ast.Return) and s.value is not None:
+            return s.value
+        if isinstance(s, ast.If):
+            e = _first_return_expr(s.body) or _first_return_expr(s.orelse)
+            if e is not None:
+                return e
+    return None
+
+
+def _seed_needs_branch_locals(seed_expr, tb, fb) -> bool:
+    """True when `seed_expr` reads a name assigned inside the branch bodies
+    — evaluating zeros_like(seed_expr) BEFORE the branch would then hit an
+    unbound local. Conservative: any store anywhere in either branch."""
+    local = set()
+    for s in tb + fb:
+        for node in ast.walk(s):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                local.add(node.id)
+    return any(isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+               and n.id in local for n in ast.walk(seed_expr))
+
+
+def _split_returns(stmts, counter):
     out = []
     for i, s in enumerate(stmts):
         if isinstance(s, ast.If) and (_has_return(s.body)
@@ -473,20 +528,84 @@ def _split_returns(stmts, counter):
             j = counter[0]
             counter[0] += 1
             rv = f"__pt_frv_{j}"
-            # a fall-through path returns None (eager semantics); carrying
-            # None through lax.cond fails with the GUIDED non-tensor error
-            # rather than silently substituting a value
+            rf = f"__pt_frf_{j}"
             tb = list(s.body)
-            if not _ends_return(tb):
-                tb += ([_copy.deepcopy(r) for r in rest]
-                       or [ast.Return(ast.Constant(None))])
             fb = list(s.orelse)
-            if not _ends_return(fb):
-                fb += ([_copy.deepcopy(r) for r in rest]
-                       or [ast.Return(ast.Constant(None))])
-            tb = _returns_to_assign(_split_returns(tb, counter), rv)
-            fb = _returns_to_assign(_split_returns(fb, counter), rv)
+            t_ret, f_ret = _ends_return(tb), _ends_return(fb)
+            if rest and (t_ret or f_ret):
+                # guard-clause shape: MOVE the trailing statements into the
+                # one fall-through branch (emitted once — the old deep-copy
+                # into both branches cost O(2^N) for N sequential guards);
+                # the chain converts to nested if/else of linear total size
+                if not t_ret:
+                    tb += rest
+                elif not f_ret:
+                    fb += rest
+                rest = []
+            if not rest:
+                # a fall-through path returns None (eager semantics);
+                # carrying None through lax.cond fails with the GUIDED
+                # non-tensor error rather than silently substituting a value
+                if not _ends_return(tb):
+                    tb.append(ast.Return(ast.Constant(None)))
+                if not _ends_return(fb):
+                    fb.append(ast.Return(ast.Constant(None)))
+                tb = _returns_to_assign(_split_returns(tb, counter), rv)
+                fb = _returns_to_assign(_split_returns(fb, counter), rv)
+                out.append(ast.If(test=s.test, body=tb, orelse=fb))
+                out.append(ast.Return(ast.Name(rv, ast.Load())))
+                return out
+            # BOTH branches fall through (returns only nested deeper): the
+            # trailing statements are emitted ONCE, predicated on a return
+            # flag. rv is seeded zeros_like(first return expr) — the loop
+            # pass's carry-seed idiom — so the converted cond carries a
+            # type-consistent value on the not-yet-returned path
+            import copy as _copy
+
+            seed_expr = _first_return_expr(tb) or _first_return_expr(fb)
+            if seed_expr is not None and _seed_needs_branch_locals(
+                    seed_expr, tb, fb):
+                # the seed reads branch-local names, so it cannot evaluate
+                # before the branch: fall back to the deep-copy split (the
+                # pre-flag shape — quadratic only across consecutive such
+                # ifs, which guard-clause chains never produce)
+                tb += [_copy.deepcopy(r) for r in rest]
+                fb += [_copy.deepcopy(r) for r in rest]
+                if not _ends_return(tb):
+                    tb.append(ast.Return(ast.Constant(None)))
+                if not _ends_return(fb):
+                    fb.append(ast.Return(ast.Constant(None)))
+                tb = _returns_to_assign(_split_returns(tb, counter), rv)
+                fb = _returns_to_assign(_split_returns(fb, counter), rv)
+                out.append(ast.If(test=s.test, body=tb, orelse=fb))
+                out.append(ast.Return(ast.Name(rv, ast.Load())))
+                return out
+            # branch fall-through continues at the trailing statements, so
+            # the branches convert with _flag_returns (NOT the function-
+            # level _split_returns, whose fall-through returns None)
+            tb = _flag_returns(tb, rv, rf)
+            fb = _flag_returns(fb, rv, rf)
+            out.append(_assign(rf, ast.Constant(False)))
+            if seed_expr is None:
+                out.append(_assign(rv, ast.Constant(None)))
+            else:
+                seed = _assign(rv, _call("__pt_zeros_like",
+                                         [_copy.deepcopy(seed_expr)]))
+                handler = ast.ExceptHandler(
+                    type=ast.Name("Exception", ast.Load()), name="__pt_e",
+                    body=[ast.Expr(_call("__pt_seed_fail",
+                                         [ast.Name("__pt_e", ast.Load())]))])
+                out.append(ast.Try(body=[seed], handlers=[handler],
+                                   orelse=[], finalbody=[]))
             out.append(ast.If(test=s.test, body=tb, orelse=fb))
+            rest_s = _split_returns(list(rest), counter)
+            ends = _ends_return(rest_s)
+            rest_t = _returns_to_assign(rest_s, rv, rf)
+            if not ends:
+                rest_t.append(_assign(rv, ast.Constant(None)))
+            out.append(ast.If(
+                test=_call("__pt_not", [ast.Name(rf, ast.Load())]),
+                body=rest_t, orelse=[]))
             out.append(ast.Return(ast.Name(rv, ast.Load())))
             return out
         out.append(s)

@@ -173,6 +173,22 @@ class LlamaPretrainingCriterion(nn.Layer):
         return F.cross_entropy(
             logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
 
+    def forward_fused(self, hidden, lm_head, labels):
+        """Joint head-projection + CE through the chunked fused kernel
+        (paddle_tpu.ops.pallas.fused_ce): `CE(hidden @ W_head, labels)`
+        without ever materializing the [tokens, vocab] logits, preserving
+        this criterion's exact reduction semantics — per-token parallel CE
+        then mean over ALL tokens when use_parallel_cross_entropy, else
+        F.cross_entropy's mean over non-ignored tokens."""
+        if self.parallel_ce is not None:
+            per_tok = F.fused_linear_cross_entropy(
+                hidden, lm_head.weight, labels, bias=lm_head.bias,
+                ignore_index=self.parallel_ce.ignore_index, reduction="none")
+            return per_tok.mean()
+        return F.fused_linear_cross_entropy(
+            hidden, lm_head.weight, labels, bias=lm_head.bias,
+            reduction="mean")
+
 
 class LlamaForCausalLM(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -185,10 +201,17 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         hidden = self.llama(input_ids, attn_mask)
-        logits = self.lm_head(hidden)
         if labels is not None:
-            return self.criterion(logits, labels)
-        return logits
+            from paddle_tpu.core.flags import flag
+
+            if flag("use_fused_head_loss"):
+                # head projection + CE in one chunked custom-vjp: the
+                # [tokens, vocab] logits never exist (escape hatch:
+                # use_fused_head_loss=False restores the unfused path)
+                return self.criterion.forward_fused(hidden, self.lm_head,
+                                                    labels)
+            return self.criterion(self.lm_head(hidden), labels)
+        return self.lm_head(hidden)
 
     # ---- pipeline-parallel factory ----------------------------------------
     @staticmethod
@@ -219,5 +242,10 @@ class _HeadStage(nn.Layer):
         self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
                                             has_bias=False, gather_output=False)
 
+    def forward_features(self, x):
+        """Pre-projection hidden — the fused head+loss protocol
+        (paddle_tpu.parallel.fused_head): forward == lm_head(forward_features)."""
+        return self.norm(x)
+
     def forward(self, x):
-        return self.lm_head(self.norm(x))
+        return self.lm_head(self.forward_features(x))

@@ -40,7 +40,8 @@ __all__ = [
     # dropout
     "dropout", "dropout2d", "alpha_dropout",
     # losses
-    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "cross_entropy", "parallel_cross_entropy", "fused_linear_cross_entropy",
+    "softmax_with_cross_entropy", "binary_cross_entropy",
     "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
     "smooth_l1_loss", "kl_div", "margin_ranking_loss", "cosine_similarity",
     "ctc_loss", "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
@@ -790,9 +791,50 @@ def _reduce(val, reduction):
     return val
 
 
+def _fused_ce_reduce(nll, valid, reduction, out_shape, dtype):
+    """Shared reduction over fp32 per-token fused-CE losses, matching the
+    unfused path's semantics exactly (mean = over non-ignored tokens)."""
+    if reduction == "mean":
+        out = jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(nll.dtype)), 1.0)
+    elif reduction == "sum":
+        out = jnp.sum(nll)
+    else:
+        out = nll.reshape(out_shape)
+    return out.astype(dtype)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
-                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0):
-    """reference: python/paddle/nn/functional/loss.py cross_entropy."""
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  use_fused=None):
+    """reference: python/paddle/nn/functional/loss.py cross_entropy.
+
+    Fast path: hard-label softmax CE lowers to the chunked fused kernel
+    (`paddle_tpu.ops.pallas.fused_ce`) — a custom-vjp that never materializes
+    the [tokens, classes] log-softmax in forward or backward. `use_fused`
+    overrides the `use_fused_cross_entropy` flag per call (the escape hatch).
+    """
+    input = _t(input)
+    nd = input._value.ndim
+    fused_ok = (use_fused if use_fused is not None
+                else flag("use_fused_cross_entropy"))
+    if (fused_ok and use_softmax and not soft_label and weight is None
+            and nd >= 2 and axis in (-1, nd - 1)):
+        def f(logits, lab):
+            from paddle_tpu.ops.pallas.fused_ce import (
+                softmax_cross_entropy_loss)
+
+            lv = lab
+            if lv.ndim == logits.ndim:
+                lv = jnp.squeeze(lv, -1)
+            flat = logits.reshape(-1, logits.shape[-1])
+            labf = lv.reshape(-1)
+            nll = softmax_cross_entropy_loss(
+                flat, labf, ignore_index=ignore_index,
+                label_smoothing=label_smoothing, mp_axis=None)
+            return _fused_ce_reduce(nll, labf != ignore_index, reduction,
+                                    lv.shape, logits.dtype)
+
+        return apply_op(f, input, _t(label), name="cross_entropy")
 
     def f(logits, lab, *w):
         if use_softmax:
@@ -838,6 +880,107 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     if weight is not None:
         args.append(_t(weight))
     return apply_op(f, *args, name="cross_entropy")
+
+
+def parallel_cross_entropy(input, label, ignore_index=-100,
+                           label_smoothing=0.0, use_fused=None):
+    """Megatron-style vocab-parallel softmax CE (reference
+    ParallelCrossEntropy, fleet/layers/mpu/mp_layers.py:742) on
+    (possibly mp-sharded) logits. Returns the PER-TOKEN loss shaped like
+    `label`, with ignored tokens contributing 0.
+
+    Inside shard_map with the "mp" axis bound, `input` is the local vocab
+    shard: the max / sum-exp / target-logit stats reduce over the axis with
+    pmax/psum so no rank materializes a full vocab row. The hot path is the
+    chunked fused kernel (custom vjp, fp32 stats); `use_fused=False` (or the
+    `use_fused_cross_entropy` flag) falls back to the unfused formula."""
+    input = _t(input)
+    lab = _t(label)
+    if lab._value.ndim == input._value.ndim:
+        from paddle_tpu.ops.manipulation import squeeze
+
+        lab = squeeze(lab, -1)
+    fused_ok = (use_fused if use_fused is not None
+                else flag("use_fused_cross_entropy"))
+    if fused_ok:
+        def f(logits, lv):
+            from paddle_tpu.ops.pallas.fused_ce import (
+                softmax_cross_entropy_loss)
+
+            flat = logits.reshape(-1, logits.shape[-1])
+            nll = softmax_cross_entropy_loss(
+                flat, lv.reshape(-1), ignore_index=ignore_index,
+                label_smoothing=label_smoothing, mp_axis="auto")
+            return nll.reshape(lv.shape)
+
+        return apply_op(f, input, lab, name="parallel_cross_entropy")
+
+    def f(logits, lv):
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_ops import (
+            MP_AXIS, mp_axis_bound)
+
+        bound = mp_axis_bound()
+        lmax = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+        if bound:
+            lmax = jax.lax.pmax(lmax, MP_AXIS)
+        shifted = logits - lmax
+        sumexp = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
+        if bound:
+            sumexp = jax.lax.psum(sumexp, MP_AXIS)
+        logz = jnp.log(sumexp)
+        if bound:
+            n_local = logits.shape[-1]
+            start = jax.lax.axis_index(MP_AXIS) * n_local
+            local_lab = lv - start
+            in_range = (local_lab >= 0) & (local_lab < n_local)
+            safe = jnp.clip(local_lab, 0, n_local - 1)
+            picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
+            picked = jnp.where(in_range[..., None], picked, 0.0)
+            picked = jax.lax.psum(picked, MP_AXIS)
+        else:
+            picked = jnp.take_along_axis(shifted, lv[..., None], axis=-1)
+        loss = (logz - picked)[..., 0]
+        valid = lv != ignore_index
+        return jnp.where(valid, loss, 0.0)
+
+    return apply_op(f, input, lab, name="parallel_cross_entropy")
+
+
+def fused_linear_cross_entropy(x, weight, label, bias=None, ignore_index=-100,
+                               reduction="mean", label_smoothing=0.0,
+                               z_loss=0.0, chunk_tokens=0, chunk_vocab=0,
+                               variant="auto"):
+    """loss = CE(x @ weight [+ bias], label) WITHOUT materializing the
+    [tokens, vocab] logits in forward or backward (chunked custom vjp,
+    `paddle_tpu.ops.pallas.fused_ce`; see docs/fused_head_cross_entropy.md).
+
+    x: [..., hidden]; weight: [hidden, vocab] (the local shard under bound
+    mp — stats then reduce over the "mp" axis, Megatron-style); label:
+    integer [...] matching x's leading dims. `z_loss` adds the
+    `z * logsumexp^2` stabilizer to both value and gradient."""
+    x = _t(x)
+    lab = _t(label)
+    if lab._value.ndim == x._value.ndim:
+        from paddle_tpu.ops.manipulation import squeeze
+
+        lab = squeeze(lab, -1)
+
+    def f(xv, wv, lv, *bv):
+        from paddle_tpu.ops.pallas.fused_ce import (
+            fused_linear_cross_entropy_loss)
+
+        flat = xv.reshape(-1, xv.shape[-1])
+        labf = lv.reshape(-1)
+        nll = fused_linear_cross_entropy_loss(
+            flat, wv, labf, bv[0] if bv else None,
+            ignore_index=ignore_index, label_smoothing=label_smoothing,
+            z_loss=z_loss, chunk_tokens=chunk_tokens, chunk_vocab=chunk_vocab,
+            variant=variant, mp_axis="auto")
+        return _fused_ce_reduce(nll, labf != ignore_index, reduction,
+                                lv.shape, jnp.float32)
+
+    args = [x, _t(weight), lab] + ([_t(bias)] if bias is not None else [])
+    return apply_op(f, *args, name="fused_linear_cross_entropy")
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,

@@ -10,8 +10,13 @@ __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
 
 
 class CrossEntropyLoss(Layer):
+    """`use_fused=None` defers to the `use_fused_cross_entropy` flag: hard-
+    label softmax CE then runs the chunked fused kernel (no [N, C]
+    log-softmax materialized; see docs/fused_head_cross_entropy.md)."""
+
     def __init__(self, weight=None, ignore_index=-100, reduction="mean",
-                 soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+                 soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                 use_fused=None, name=None):
         super().__init__()
         self.weight = weight
         self.ignore_index = ignore_index
@@ -20,12 +25,14 @@ class CrossEntropyLoss(Layer):
         self.axis = axis
         self.use_softmax = use_softmax
         self.label_smoothing = label_smoothing
+        self.use_fused = use_fused
 
     def forward(self, input, label):
         return F.cross_entropy(
             input, label, weight=self.weight, ignore_index=self.ignore_index,
             reduction=self.reduction, soft_label=self.soft_label, axis=self.axis,
             use_softmax=self.use_softmax, label_smoothing=self.label_smoothing,
+            use_fused=self.use_fused,
         )
 
 

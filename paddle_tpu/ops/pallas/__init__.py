@@ -1,1 +1,4 @@
 from paddle_tpu.ops.pallas.rmsnorm_kernel import rmsnorm  # noqa: F401
+from paddle_tpu.ops.pallas.fused_ce import (  # noqa: F401
+    fused_linear_cross_entropy_loss, softmax_cross_entropy_loss,
+)

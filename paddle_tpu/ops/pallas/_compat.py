@@ -1,0 +1,14 @@
+"""Shared Pallas-kernel compatibility helpers."""
+from __future__ import annotations
+
+__all__ = ["x64_off"]
+
+
+def x64_off():
+    """x64 mode (paddle int64 parity, enabled at package import) makes Pallas
+    index maps emit i64 constants Mosaic can't legalize. `jax.enable_x64` was
+    removed upstream; `jax.experimental.disable_x64` is the surviving
+    spelling of the same trace-local override."""
+    from jax.experimental import disable_x64
+
+    return disable_x64()

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from paddle_tpu.ops.pallas._compat import x64_off as _x64_off
+
 try:  # pallas TPU backend may be absent on pure-CPU installs
     from jax.experimental.pallas import tpu as pltpu
 
@@ -153,7 +155,7 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, group: int, interpret: bool)
     )
     # Mosaic lowering mishandles 64-bit index types; the kernel is pure
     # f32/bf16/i32, so trace it with x64 off regardless of the global setting.
-    with jax.enable_x64(False):
+    with _x64_off():
         out, lse = pl.pallas_call(
             kernel,
             grid=grid,
@@ -269,7 +271,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal: bool, scale: float, group: int,
                     keepdims=True)                       # [BHq, S, 1]
     lse3 = lse[..., None]                                # [BHq, S, 1]
 
-    with jax.enable_x64(False):
+    with _x64_off():
         dq = pl.pallas_call(
             functools.partial(_dq_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k),

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from paddle_tpu.ops.pallas._compat import x64_off as _x64_off
+
 __all__ = ["rmsnorm"]
 
 
@@ -84,7 +86,7 @@ def _fwd(x, w, eps, block_rows):
     interpret = not _on_tpu()
     # x64 mode (paddle int64 parity, enabled at package import) makes index
     # maps emit i64 constants Mosaic can't legalize — same guard as flash
-    with jax.enable_x64(False):
+    with _x64_off():
         out = pl.pallas_call(
             functools.partial(_rmsnorm_fwd_kernel, eps=eps),
             grid=(pl.cdiv(rows, br),),
@@ -103,7 +105,7 @@ def _bwd(eps, block_rows, res, dy):
     br = min(block_rows, rows)
     n_blocks = pl.cdiv(rows, br)
     interpret = not _on_tpu()
-    with jax.enable_x64(False):
+    with _x64_off():
         dx, dw_acc = pl.pallas_call(
             functools.partial(_rmsnorm_bwd_kernel, eps=eps),
             grid=(n_blocks,),

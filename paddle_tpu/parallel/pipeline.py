@@ -305,10 +305,21 @@ class PipelinedTrainStep:
         mesh = self.mesh
         dp = self._dp_axes
         lead = "pp" if self.M % self.S == 0 else None
+        # joint head+loss fusion (chunked fused CE, no [tokens, vocab]
+        # logits) when the head/loss pair opts in; None -> unfused path.
+        # Resolved at trace time: flipping use_fused_head_loss after the
+        # first step does not retrace.
+        from paddle_tpu.parallel.fused_head import (fused_head_loss,
+                                                    fused_head_spec)
+
+        fspec = fused_head_spec(self.head, self.loss_fn)
 
         def body(out_loc, lab_loc, hv):
             def per_mb(args):
                 out_m, lab_m = args
+                if fspec is not None:
+                    return fused_head_loss(self.head, hv, out_m, lab_m,
+                                           fspec).astype(jnp.float32)
                 head_out = functional_call(self.head, hv, (Tensor(out_m),))
                 o = head_out._value if isinstance(head_out, Tensor) else head_out
                 loss_t = self.loss_fn(Tensor(o), Tensor(lab_m))

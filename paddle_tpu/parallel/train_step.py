@@ -175,10 +175,13 @@ def host_memory_supported() -> bool:
         return False
 
 
-def functional_call(model, params_vals: Sequence, args, kwargs=None, training=True):
+def functional_call(model, params_vals: Sequence, args, kwargs=None, training=True,
+                    method=None):
     """Run `model` with its parameters temporarily bound to `params_vals`
     (possibly tracers). All paddle_tpu ops are pure jax fns of Tensor._value,
-    so ordinary Python execution under tracers IS the graph capture."""
+    so ordinary Python execution under tracers IS the graph capture.
+    `method` names an alternative entry point (e.g. "forward_features" for
+    the fused-head protocol) instead of `model.__call__`."""
     kwargs = kwargs or {}
     params = model.parameters()
     old = [p._value for p in params]
@@ -186,8 +189,9 @@ def functional_call(model, params_vals: Sequence, args, kwargs=None, training=Tr
         for p, v in zip(params, params_vals):
             p._set_value(v)
         t_args = [Tensor(a) if isinstance(a, jax.Array) else a for a in args]
+        fn = getattr(model, method) if method else model
         with _tape.no_grad():
-            out = model(*t_args, **kwargs)
+            out = fn(*t_args, **kwargs)
         return out
     finally:
         for p, v in zip(params, old):

@@ -275,6 +275,16 @@ class ZBH1PipelinedStep:
     def _last_chain(self, stage_params, head_vals, x, labels_mb):
         """loss(head(stage(x))) for the last rank."""
         y = self._stage_fwd(stage_params, x)
+        from paddle_tpu.parallel.fused_head import (fused_head_loss,
+                                                    fused_head_spec)
+
+        fspec = fused_head_spec(self.head, self.loss_fn)
+        if fspec is not None:
+            # chunked fused head+CE (no [tokens, vocab] logits); labels are
+            # closure constants here, satisfying the integer-residual rule
+            # this module's docstring describes
+            return fused_head_loss(self.head, head_vals, y, labels_mb,
+                                   fspec).astype(jnp.float32)
         h = functional_call(self.head, head_vals, (Tensor(y),))
         hv = h._value if isinstance(h, Tensor) else h
         loss = self.loss_fn(Tensor(hv), Tensor(labels_mb))

@@ -150,3 +150,97 @@ class TestConversion:
         # d/dx of branch (x>0): z + y where y = 2x+10, z = 2y -> 3y -> d=6
         np.testing.assert_allclose(np.asarray(x.grad._value),
                                    np.full(4, 6.0), atol=1e-5)
+
+
+class TestGuardClauseReturns:
+    def test_many_sequential_early_returns_bounded(self):
+        """12 sequential guard-clause returns: the split pass predicates the
+        trailing statements on one return-flag local instead of deep-copying
+        them into both branches (which cost O(2^N) AST copies and hung
+        conversion well before N=12)."""
+        import time
+
+        import linecache
+
+        src = ["def guards(x):"]
+        for k in range(12):
+            src.append(f"    if x.sum() > {k + 1}.5:")
+            src.append(f"        return x * {k + 2}.0")
+        src.append("    return -x")
+        code = "\n".join(src) + "\n"
+        # exec'd functions carry no retrievable source; register it so
+        # inspect.getsource (which convert_control_flow relies on) works
+        fname = "<dy2static-guards-test>"
+        linecache.cache[fname] = (len(code), None, code.splitlines(True),
+                                  fname)
+        ns = {}
+        exec(compile(code, fname, "exec"), ns)
+        guards = ns["guards"]
+
+        t0 = time.perf_counter()
+        conv = convert_control_flow(guards)
+        elapsed = time.perf_counter() - t0
+        assert conv is not None and conv.__dy2static_converted__
+        assert elapsed < 10.0, f"conversion took {elapsed:.1f}s (exponential?)"
+        for s in (0.0, 3.2, 7.8, 100.0):
+            x = paddle.to_tensor(np.full(4, s / 4, np.float32))
+            np.testing.assert_allclose(
+                np.asarray(conv(x)._value),
+                np.asarray(guards(x)._value), atol=1e-6,
+                err_msg=f"sum={s}")
+
+    def test_nested_return_deeper_than_fallthrough(self):
+        """A return nested DEEPER than the branch that falls through must
+        not swallow the enclosing scope's trailing statements (the branch
+        converts via the return flag, not function-level fall-through)."""
+        def f(x):
+            if x.sum() > 0.0:
+                if x.sum() > 10.0:
+                    return x * 2.0
+            return x - 1.0
+
+        conv = convert_control_flow(f)
+        assert conv is not None and conv.__dy2static_converted__
+        for v in (4.0, 20.0, -3.0):
+            x = paddle.to_tensor(np.full(2, v / 2, np.float32))
+            np.testing.assert_allclose(
+                np.asarray(conv(x)._value),
+                np.asarray(f(x)._value), atol=1e-6, err_msg=f"v={v}")
+
+    def test_nested_return_referencing_branch_local(self):
+        """The rv seed can't pre-evaluate a return expression that reads a
+        branch-local — that shape must fall back to the deep-copy split
+        instead of raising at call time."""
+        def f(x):
+            if x.sum() > 0.0:
+                y = x * 2.0
+                if y.sum() > 10.0:
+                    return y
+            return x - 1.0
+
+        conv = convert_control_flow(f)
+        assert conv is not None and conv.__dy2static_converted__
+        for v in (3.0, 30.0, -2.0):
+            x = paddle.to_tensor(np.full(2, v / 2, np.float32))
+            np.testing.assert_allclose(
+                np.asarray(conv(x)._value),
+                np.asarray(f(x)._value), atol=1e-6, err_msg=f"v={v}")
+
+    def test_early_return_with_trailing_work(self):
+        """The trailing statements run exactly once on the fall-through
+        path and are skipped once a guard has returned."""
+        def f(x):
+            if x.sum() > 1.5:
+                return x * 10.0
+            y = x + 1.0
+            if y.sum() > 1.5:
+                return y * 100.0
+            return y - 7.0
+
+        conv = convert_control_flow(f)
+        assert conv is not None and conv.__dy2static_converted__
+        for v in (1.0, 0.3, -2.0):
+            x = paddle.to_tensor(np.full(2, v, np.float32))
+            np.testing.assert_allclose(
+                np.asarray(conv(x)._value),
+                np.asarray(f(x)._value), atol=1e-6, err_msg=f"v={v}")

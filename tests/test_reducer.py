@@ -74,3 +74,53 @@ class TestLeafHookAccumulation:
         np.testing.assert_allclose(calls[0],
                                    np.asarray(lin.weight.grad._value),
                                    rtol=1e-6)
+
+
+class TestStrictBucketOrder:
+    """Collectives must POST in ascending bucket-index order even when
+    buckets COMPLETE out of order (rank-divergent usage under
+    find_unused_parameters=True would otherwise pair mismatched
+    collectives across ranks; the cross-process case runs in
+    tests/workers/mp_worker.py)."""
+
+    def _reducer_and_params(self):
+        from paddle_tpu.distributed.reducer import GradReducer
+
+        ps = _params([8, 8, 8])
+        tiny = 32 / (1 << 20)  # 32-byte cap: one param per bucket
+        r = GradReducer(ps, comm_buffer_size=tiny, last_comm_buffer_size=tiny)
+        assert len(r._buckets) == 3
+        return r, ps
+
+    def test_out_of_order_completion_posts_in_index_order(self, monkeypatch):
+        import jax.numpy as jnp
+
+        r, ps = self._reducer_and_params()
+        posted = []
+        monkeypatch.setattr(
+            r, "_post", lambda task: posted.append(task.bucket.index))
+        g = jnp.zeros((8, 1))
+        # reverse-param assembly: bucket 0 holds ps[2], bucket 2 holds ps[0]
+        r.on_grad(ps[0], g)  # completes bucket 2 -> held
+        assert posted == []
+        r.on_grad(ps[1], g)  # completes bucket 1 -> held
+        assert posted == []
+        r.on_grad(ps[2], g)  # completes bucket 0 -> releases 0, 1, 2
+        assert posted == [0, 1, 2]
+        assert not r._ready and r._next_bucket == 3
+
+    def test_finalize_releases_held_buckets_through_pointer(self, monkeypatch):
+        import jax.numpy as jnp
+
+        r, ps = self._reducer_and_params()
+        r._find_unused = True
+        posted = []
+        monkeypatch.setattr(
+            r, "_post", lambda task: posted.append(task.bucket.index))
+        monkeypatch.setattr(r, "_drain", lambda: None)
+        g = jnp.zeros((8, 1))
+        r.on_grad(ps[0], g)  # bucket 2 complete, buckets 0/1 never fire
+        assert posted == []
+        r.finalize()  # zero-fills 0 and 1, then posts strictly in order
+        assert posted == [0, 1, 2]
+        assert not r._ready and r._next_bucket == 0  # reset for next backward
