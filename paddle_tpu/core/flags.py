@@ -103,3 +103,10 @@ define_flag("use_fused_head_loss", True,
 define_flag("fused_ce_chunk_tokens", 0, "fused-CE token chunk override (0 = auto ~4M-element tiles)", type=int)
 define_flag("fused_ce_chunk_vocab", 0, "fused-CE vocab chunk override (0 = auto)", type=int)
 define_flag("fused_ce_variant", "auto", "fused-CE strategy: auto|tokens|vocab|pallas")
+define_flag("scan_layers", False,
+            "run homogeneous decoder stacks as ONE lax.scan over layer-stacked "
+            "params (O(1)-in-depth HLO size and compile time)")
+define_flag("remat_policy", "none",
+            "default selective-rematerialization policy, consulted when a "
+            "step is constructed with remat=None (the CompiledTrainStep "
+            "default): none|full|save_dots|save_nothing|offload_residuals")

@@ -453,7 +453,13 @@ _P2P_PENDING: list = []  # (trace_token, axes_key, dst_pos, tensor)
 def _trace_token():
     from jax._src import core as _core
 
-    return _core.get_opaque_trace_state()
+    try:
+        return _core.get_opaque_trace_state()
+    except TypeError:
+        # this jax's signature requires a convention tag; any fixed value
+        # yields a token with trace-identity equality, which is all the
+        # send/recv matching needs
+        return _core.get_opaque_trace_state(convention="nnx")
 
 
 def _axes_key(group):

@@ -49,7 +49,23 @@ class Binomial(Distribution):
         shp = _shape(shape, self.total_count, self.probs)
         n = jnp.broadcast_to(self.total_count, shp)
         p = jnp.broadcast_to(self.probs, shp)
-        return Tensor(jax.random.binomial(_key(), n.astype(jnp.float32), p))
+        try:
+            return Tensor(jax.random.binomial(_key(), n.astype(jnp.float32), p))
+        except TypeError:
+            # this jax's binomial sampler trips an internal lax.clamp dtype
+            # mismatch; draw exactly: count bernoulli successes over n_max
+            # trials, masking trials past each element's own count
+            n_max = max(int(jnp.max(self.total_count)), 1)
+            if n_max > 4096:
+                # the exact draw allocates shape x n_max; for large counts
+                # use the clipped-rounded normal approximation instead
+                nf = n.astype(jnp.float32)
+                g = jax.random.normal(_key(), tuple(shp))
+                s = jnp.rint(nf * p + g * jnp.sqrt(nf * p * (1.0 - p)))
+                return Tensor(jnp.clip(s, 0.0, nf).astype(p.dtype))
+            u = jax.random.uniform(_key(), tuple(shp) + (n_max,))
+            hits = (u < p[..., None]) & (jnp.arange(n_max) < n[..., None])
+            return Tensor(jnp.sum(hits, axis=-1).astype(p.dtype))
 
     def log_prob(self, value):
         def f(x, p):

@@ -14,9 +14,11 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -45,6 +47,10 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_parallel_cross_entropy: bool = True
     dtype: str = "float32"
+    # run the homogeneous decoder stack as ONE lax.scan over layer-stacked
+    # params (O(1)-in-depth HLO/compile time); the global `scan_layers` flag
+    # or a compiled step's scan packing can also turn this on
+    scan_layers: bool = False
 
 
 def llama_7b_config(**overrides) -> LlamaConfig:
@@ -64,6 +70,27 @@ def _rope_tables(head_dim: int, max_pos: int, theta: float):
     t = jnp.arange(max_pos, dtype=jnp.float32)
     freqs = jnp.outer(t, inv)  # [max_pos, head_dim/2]
     return jnp.cos(freqs), jnp.sin(freqs)
+
+
+@lru_cache(maxsize=8)
+def _shared_rope_tables(head_dim: int, max_pos: int, theta: float):
+    """Process-wide RoPE cos/sin tables (fp32), shared by every attention
+    layer of the same geometry. Layers no longer register their own buffer
+    copies — LlamaModel holds ONE pair and passes it down; standalone layers
+    (pipeline LayerDesc stages, GPT-MoE blocks) fall back to this cache.
+    ensure_compile_time_eval: the first call may happen under a jit trace,
+    and caching staged tracers would poison the cache for later traces."""
+    with jax.ensure_compile_time_eval():
+        return _rope_tables(head_dim, max_pos, theta)
+
+
+def _tag_residual(x):
+    """`checkpoint_name` tag on the residual stream: the selective-remat
+    policies (paddle_tpu.parallel.scan_layers) key on it, e.g.
+    `offload_residuals` moves exactly these activations to pinned host
+    memory. Numerically the identity."""
+    return apply_op(lambda v: checkpoint_name(v, "residual"), x,
+                    name="checkpoint_name")
 
 
 def apply_rotary(q, k, cos, sin):
@@ -91,20 +118,26 @@ class LlamaAttention(nn.Layer):
         self.k_proj = ColumnParallelLinear(h, kv, has_bias=False, gather_output=False)
         self.v_proj = ColumnParallelLinear(h, kv, has_bias=False, gather_output=False)
         self.o_proj = RowParallelLinear(h, h, has_bias=False, input_is_parallel=True)
-        cos, sin = _rope_tables(self.head_dim, config.max_position_embeddings, config.rope_theta)
-        self.register_buffer("rope_cos", cos, persistable=False)
-        self.register_buffer("rope_sin", sin, persistable=False)
+        self._rope_geom = (self.head_dim, config.max_position_embeddings,
+                          config.rope_theta)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, rope=None):
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, -1, self.head_dim])
         k = self.k_proj(x).reshape([b, s, -1, self.head_dim])
         v = self.v_proj(x).reshape([b, s, -1, self.head_dim])
 
-        cos, sin = self.rope_cos, self.rope_sin
+        # rope: (cos, sin) handed down by LlamaModel (one shared buffer pair
+        # for the whole stack); standalone use falls back to the process-wide
+        # cache — either way no per-layer buffer copies exist in the pytree
+        if rope is None:
+            rope = _shared_rope_tables(*self._rope_geom)
+        cos, sin = (r._value if isinstance(r, Tensor) else r for r in rope)
 
         def rope_fn(qv, kv_, c, sn):
-            return apply_rotary(qv, kv_, c[:s], sn[:s])
+            c = c[:s].astype(qv.dtype)
+            sn = sn[:s].astype(qv.dtype)
+            return apply_rotary(qv, kv_, c, sn)
 
         q, k = apply_op(rope_fn, q, k, cos, sin, name="rope", n_outputs=2)
 
@@ -136,13 +169,19 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, attn_mask=None):
-        x = x + self.self_attn(self.input_layernorm(x), attn_mask)
-        x = x + self.mlp(self.post_attention_layernorm(x))
+    def forward(self, x, attn_mask=None, rope=None):
+        x = _tag_residual(x + self.self_attn(self.input_layernorm(x),
+                                             attn_mask, rope=rope))
+        x = _tag_residual(x + self.mlp(self.post_attention_layernorm(x)))
         return x
 
 
 class LlamaModel(nn.Layer):
+    # cooperation protocol (paddle_tpu.parallel.scan_layers): compiled steps
+    # deliver the per-layer remat policy / stacked scan params via
+    # layer_execution() instead of wrapping the whole loss in jax.checkpoint
+    layer_remat_capable = True
+
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -150,12 +189,69 @@ class LlamaModel(nn.Layer):
         self.layers = nn.LayerList([LlamaDecoderLayer(config)
                                     for _ in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        # ONE shared RoPE table pair for the whole stack (previously every
+        # attention layer registered its own [max_pos, head_dim/2] copies)
+        head_dim = config.hidden_size // config.num_attention_heads
+        cos, sin = _rope_tables(head_dim, config.max_position_embeddings,
+                                config.rope_theta)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    def scan_group(self):
+        """The homogeneous decoder stack, for scan-over-layers packing."""
+        return list(self.layers)
 
     def forward(self, input_ids, attn_mask=None):
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            x = layer(x, attn_mask)
+        x = self._run_layers(x, attn_mask)
         return self.norm(x)
+
+    def _run_layers(self, x, attn_mask):
+        """Apply the decoder stack: unrolled python loop, or ONE lax.scan
+        over layer-stacked params, with the active selective-remat policy
+        applied PER LAYER (embed/norm/head never sit in a remat region)."""
+        from paddle_tpu.core.flags import flag
+        from paddle_tpu.parallel.scan_layers import (
+            current_layer_ctx, scan_layer_stack, stack_layer_vals,
+            unrolled_layer_call)
+
+        rope = (self.rope_cos._value, self.rope_sin._value)
+        layers = list(self.layers)
+        ctx = current_layer_ctx()
+        policy = ctx.policy if ctx is not None else flag("remat_policy")
+        stacked = ctx.stacked if ctx is not None else None
+        kwargs = {"attn_mask": attn_mask, "rope": rope}
+        use_scan = stacked is not None or (
+            len(layers) > 1 and (self.config.scan_layers
+                                 or flag("scan_layers")))
+        if not use_scan:
+            if policy == "none":
+                for layer in layers:
+                    x = layer(x, attn_mask, rope=rope)
+                return x
+            for layer in layers:
+                x = unrolled_layer_call(layer, x, kwargs=kwargs,
+                                        policy=policy)
+            return x
+        template = layers[0]
+        if stacked is not None:
+            # stacked [L, ...] arrays arrive from the compiled step's packing
+            # (jit inputs — the program never stacks or slices per layer)
+            return Tensor(scan_layer_stack(template, stacked, x._value,
+                                           kwargs=kwargs, policy=policy))
+        # stack the per-layer parameter values in-program (eager / unpacked
+        # traced mode); the tape records ONE scan op with per-param grads
+        n_per = len(template.parameters())
+        n_layers = len(layers)
+        flat = [p for layer in layers for p in layer.parameters()]
+
+        def scan_all(hv, *leafs):
+            svals = stack_layer_vals(
+                [leafs[l * n_per:(l + 1) * n_per] for l in range(n_layers)])
+            return scan_layer_stack(template, svals, hv, kwargs=kwargs,
+                                    policy=policy)
+
+        return apply_op(scan_all, x, *flat, name="scan_layers")
 
 
 class LlamaPretrainingCriterion(nn.Layer):
@@ -191,6 +287,8 @@ class LlamaPretrainingCriterion(nn.Layer):
 
 
 class LlamaForCausalLM(nn.Layer):
+    layer_remat_capable = True
+
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -198,6 +296,9 @@ class LlamaForCausalLM(nn.Layer):
         self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
                                             has_bias=False, gather_output=False)
         self.criterion = LlamaPretrainingCriterion(config)
+
+    def scan_group(self):
+        return self.llama.scan_group()
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         hidden = self.llama(input_ids, attn_mask)

@@ -6,4 +6,8 @@ shardings on ONE compiled XLA program instead of per-rank programs + NCCL.
 """
 from paddle_tpu.parallel.train_step import CompiledTrainStep, functional_call  # noqa: F401
 from paddle_tpu.parallel import pipeline_schedules  # noqa: F401
+from paddle_tpu.parallel.pipeline import PipelinedTrainStep  # noqa: F401
 from paddle_tpu.parallel.zero_bubble import ZBH1PipelinedStep  # noqa: F401
+from paddle_tpu.parallel.scan_layers import (  # noqa: F401
+    REMAT_POLICIES, normalize_remat, remat_wrap, scan_layer_stack,
+)

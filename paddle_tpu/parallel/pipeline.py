@@ -150,7 +150,17 @@ class PipelinedTrainStep:
 
     def __init__(self, embed_layer, blocks: Sequence, head_layer, loss_fn: Callable,
                  optimizer=None, mesh: Mesh | None = None, num_micro: int = 1,
-                 remat: bool = True, seed: int = 0, virtual_pp: int = 1):
+                 remat: bool | str | None = True, seed: int = 0,
+                 virtual_pp: int = 1):
+        from paddle_tpu.core.flags import flag
+        from paddle_tpu.parallel.scan_layers import normalize_remat
+
+        # remat: policy string (none|full|save_dots|save_nothing|
+        # offload_residuals) applied PER SCANNED LAYER in each stage's chunk;
+        # bool back-compat (True -> 'full'), None reads the remat_policy flag
+        self.remat_policy = normalize_remat(
+            flag("remat_policy") if remat is None else remat)
+        self.remat = self.remat_policy != "none"
         self.mesh = mesh if mesh is not None else get_mesh()
         if self.mesh is None or "pp" not in self.mesh.shape:
             raise ValueError("PipelinedTrainStep requires a mesh with a 'pp' axis")
@@ -166,7 +176,6 @@ class PipelinedTrainStep:
         self.head = head_layer
         self.loss_fn = loss_fn
         self.optimizer = optimizer
-        self.remat = remat
         self._key = jax.random.key(seed)
         # resume parity: continue from a restored optimizer's step count
         from paddle_tpu.parallel.train_step import _innermost_opt
@@ -250,9 +259,12 @@ class PipelinedTrainStep:
                 fleet_rng._tls.active_key_fn = prev
             return out._value if isinstance(out, Tensor) else out, None
 
-        block_fn = one_block
-        if self.remat:
-            block_fn = jax.checkpoint(one_block)
+        from paddle_tpu.parallel.scan_layers import remat_wrap
+
+        # selective remat per scanned layer: 'full' recomputes the block
+        # interior (the old remat=True), 'save_dots' keeps matmul outputs,
+        # 'offload_residuals' parks tagged residuals in pinned host memory
+        block_fn = remat_wrap(one_block, self.remat_policy, in_scan=True)
         h, _ = jax.lax.scan(block_fn, x, stage_params_local)
         return h
 

@@ -1,0 +1,239 @@
+"""Scan-over-layers compilation + policy-based selective rematerialization.
+
+Reference analog: the recompute pass / `recompute_interval` knob of the
+reference's hybrid-parallel stack (fleet recompute, SURVEY §2.1) — but
+TPU-native, the T5X/MaxText way:
+
+* **Scan-over-layers.** A homogeneous decoder stack (N identical layers) is
+  executed as ONE `jax.lax.scan` over the layer parameters stacked along a
+  leading layer axis, so the traced program contains the layer body once and
+  HLO size / XLA compile time are O(1) in depth instead of O(N).
+* **Selective remat policies.** The all-or-nothing `remat: bool` knob becomes
+  a policy string applied PER LAYER via `jax.checkpoint` +
+  `jax.checkpoint_policies`:
+
+    - ``none``              no rematerialization (save everything XLA keeps)
+    - ``full``              `jax.checkpoint` default: save only layer
+                            boundaries, recompute the layer interior
+    - ``save_nothing``      explicit `nothing_saveable` (alias of ``full``'s
+                            default policy, spelled out)
+    - ``save_dots``         `dots_with_no_batch_dims_saveable`: keep matmul
+                            outputs, recompute the cheap elementwise tail
+    - ``offload_residuals`` residual-stream activations (tagged
+                            `checkpoint_name(..., "residual")` by the layer)
+                            are offloaded to pinned host memory via
+                            `save_and_offload_only_these_names` when the
+                            backend has one (`host_memory_supported()`),
+                            else saved on device (`save_only_these_names`)
+
+  Because the policy wraps each layer (or the scan body), the embed / fused
+  LM-head / CE segment is NEVER inside a remat region: the fused head is
+  computed exactly once even under ``full``.
+
+Cooperation protocol (how a compiled step talks to a model):
+
+* A model that can apply per-layer remat itself sets
+  ``layer_remat_capable = True`` and reads :func:`current_layer_ctx` in its
+  forward. `CompiledTrainStep` then delivers the policy via
+  :func:`layer_execution` instead of wrapping the whole loss in
+  `jax.checkpoint` (the legacy behavior, kept for non-cooperating models).
+* A model whose homogeneous stack can be scanned exposes ``scan_group()``
+  returning the list of identical layers. `CompiledTrainStep(scan_layers=
+  True)` stacks each layer parameter across the group OUTSIDE the program
+  (one `[L, ...]` jit input per parameter) and delivers the stacked arrays
+  through the same context; the model consumes them with
+  :func:`scan_layer_stack`.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "REMAT_POLICIES", "normalize_remat", "remat_wrap", "layer_execution",
+    "current_layer_ctx", "LayerExecContext", "stack_layer_vals",
+    "scan_layer_stack", "unrolled_layer_call",
+]
+
+REMAT_POLICIES = ("none", "full", "save_dots", "save_nothing",
+                  "offload_residuals")
+
+# checkpoint_name tag the decoder layers put on their residual stream; the
+# offload_residuals policy keys on it
+RESIDUAL_TAG = "residual"
+
+
+def normalize_remat(remat) -> str:
+    """Map the legacy bool knob onto the policy namespace.
+
+    True -> 'full' (the old whole-graph remat semantics, now applied per
+    layer for cooperating models), False/None -> 'none'; policy strings pass
+    through validated.
+    """
+    if remat is None or remat is False:
+        return "none"
+    if remat is True:
+        return "full"
+    policy = str(remat)
+    if policy not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {policy!r}; expected one of "
+            f"{'|'.join(REMAT_POLICIES)} (or a bool)")
+    return policy
+
+
+def _offload_policy():
+    from paddle_tpu.parallel.train_step import host_memory_supported
+
+    if host_memory_supported():
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=[RESIDUAL_TAG],
+            offload_src="device", offload_dst="pinned_host")
+    # no pinned-host space (CPU test backend): degrade to device-saved names,
+    # preserving the recompute structure (and numerics) of the offload policy
+    return jax.checkpoint_policies.save_only_these_names(RESIDUAL_TAG)
+
+
+def remat_wrap(fn: Callable, policy: str, in_scan: bool = False) -> Callable:
+    """Wrap `fn` (a pure jax function) in `jax.checkpoint` per `policy`.
+
+    `in_scan=True` relaxes `prevent_cse` (safe and faster under
+    `lax.scan`/`while`, per the jax.checkpoint docs).
+    """
+    policy = normalize_remat(policy)
+    if policy == "none":
+        return fn
+    kw = dict(prevent_cse=not in_scan)
+    if policy == "save_nothing":
+        kw["policy"] = jax.checkpoint_policies.nothing_saveable
+    elif policy == "save_dots":
+        kw["policy"] = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    elif policy == "offload_residuals":
+        kw["policy"] = _offload_policy()
+    # 'full': jax.checkpoint's default (save only the wrapped fn's inputs)
+    return jax.checkpoint(fn, **kw)
+
+
+class LayerExecContext:
+    """What a compiled step asks of a cooperating model's layer stack."""
+
+    __slots__ = ("policy", "stacked")
+
+    def __init__(self, policy: str = "none", stacked=None):
+        self.policy = policy
+        # stacked: per-parameter [L, ...] arrays for the model's scan_group()
+        # (stacked OUTSIDE the traced program), or None when the model should
+        # use its own (bound) per-layer parameters
+        self.stacked = stacked
+
+
+class _CtxTLS(threading.local):
+    def __init__(self):
+        self.ctx = None
+
+
+_tls = _CtxTLS()
+
+
+def current_layer_ctx() -> LayerExecContext | None:
+    return _tls.ctx
+
+
+@contextmanager
+def layer_execution(policy: str = "none", stacked=None):
+    prev = _tls.ctx
+    _tls.ctx = LayerExecContext(policy, stacked)
+    try:
+        yield _tls.ctx
+    finally:
+        _tls.ctx = prev
+
+
+def stack_layer_vals(per_layer_vals: Sequence[Sequence]) -> list:
+    """list[L][P] parameter values -> list[P] arrays stacked on a new leading
+    layer axis (the MaxText/T5X scanned-weights layout)."""
+    n = len(per_layer_vals[0])
+    for lp in per_layer_vals:
+        if len(lp) != n:
+            raise ValueError("scan group layers are not homogeneous")
+    return [jnp.stack([lp[j] for lp in per_layer_vals]) for j in range(n)]
+
+
+def _fold_rng(idx):
+    """Scope fleet RNG streams by layer index: the scan body traces ONCE, so
+    without the fold every layer would replay identical dropout keys."""
+    from contextlib import contextmanager as _cm
+
+    from paddle_tpu.distributed.fleet import rng as fleet_rng
+
+    @_cm
+    def scope():
+        prev = fleet_rng._tls.active_key_fn
+        if prev is not None:
+            fleet_rng._tls.active_key_fn = \
+                lambda: jax.random.fold_in(prev(), idx)
+        try:
+            yield
+        finally:
+            fleet_rng._tls.active_key_fn = prev
+
+    return scope()
+
+
+def scan_layer_stack(template, stacked_vals: Sequence, x, args: tuple = (),
+                     kwargs: dict | None = None, policy: str = "none"):
+    """Run a homogeneous layer stack as `jax.lax.scan` over stacked params.
+
+    template: one layer instance (the body is traced through it via
+    `functional_call`, so its parameter Tensors are only used as binding
+    slots). stacked_vals: one [L, ...] array per template parameter. x: the
+    carried hidden-state ARRAY. args/kwargs: broadcast (layer-invariant)
+    extras passed to every layer call. Returns the final hidden array.
+    """
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.parallel.train_step import functional_call
+
+    kwargs = kwargs or {}
+    n_layers = stacked_vals[0].shape[0]
+
+    def body(carry, xs):
+        idx = xs[0]
+        layer_vals = list(xs[1:])
+        with _fold_rng(idx):
+            out = functional_call(template, layer_vals, (Tensor(carry),) + args,
+                                  kwargs=kwargs)
+        return (out._value if isinstance(out, Tensor) else out), None
+
+    body = remat_wrap(body, policy, in_scan=True)
+    xs = (jnp.arange(n_layers),) + tuple(stacked_vals)
+    h, _ = jax.lax.scan(body, x, xs)
+    return h
+
+
+def unrolled_layer_call(layer, x, args: tuple = (), kwargs: dict | None = None,
+                        policy: str = "none"):
+    """One layer applied to hidden-state ARRAY `x` with the remat policy as a
+    per-layer `jax.checkpoint` region (the unrolled-loop counterpart of
+    `scan_layer_stack`); embed/head stay outside the region by construction.
+    """
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.parallel.train_step import functional_call
+
+    kwargs = kwargs or {}
+    params = layer.parameters()
+
+    def one(hv, *param_vals):
+        out = functional_call(layer, list(param_vals), (Tensor(hv),) + args,
+                              kwargs=kwargs)
+        return out._value if isinstance(out, Tensor) else out
+
+    wrapped = remat_wrap(one, policy)
+    from paddle_tpu.core.tensor import apply_op
+
+    return apply_op(wrapped, Tensor(x) if not isinstance(x, Tensor) else x,
+                    *params, name="remat_layer")

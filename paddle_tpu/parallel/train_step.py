@@ -176,14 +176,17 @@ def host_memory_supported() -> bool:
 
 
 def functional_call(model, params_vals: Sequence, args, kwargs=None, training=True,
-                    method=None):
+                    method=None, params=None):
     """Run `model` with its parameters temporarily bound to `params_vals`
     (possibly tracers). All paddle_tpu ops are pure jax fns of Tensor._value,
     so ordinary Python execution under tracers IS the graph capture.
     `method` names an alternative entry point (e.g. "forward_features" for
-    the fused-head protocol) instead of `model.__call__`."""
+    the fused-head protocol) instead of `model.__call__`. `params` restricts
+    the binding to a subset of the model's parameters (scan-over-layers
+    packing binds only the non-stacked ones; the stacked group arrives via
+    the layer-execution context instead)."""
     kwargs = kwargs or {}
-    params = model.parameters()
+    params = model.parameters() if params is None else params
     old = [p._value for p in params]
     try:
         for p, v in zip(params, params_vals):
@@ -210,19 +213,84 @@ class CompiledTrainStep:
     offload_optimizer: place optimizer state in pinned host memory
       (reference sharding offload variants); requires backend host-memory
       support (TPU), silently stays in HBM otherwise.
+    remat: selective-rematerialization policy — a string from
+      paddle_tpu.parallel.scan_layers.REMAT_POLICIES
+      (none|full|save_dots|save_nothing|offload_residuals), a bool
+      (back-compat: True -> 'full', False -> 'none'), or None to read the
+      `remat_policy` flag. Cooperating models (`layer_remat_capable`) get the
+      policy applied PER LAYER, so the embed/fused-head/CE segment is never
+      recomputed; other models fall back to the legacy whole-loss
+      `jax.checkpoint` region (with the policy attached).
+    scan_layers: stack the model's `scan_group()` layer parameters along a
+      leading layer axis OUTSIDE the program and run the stack as one
+      `lax.scan` — HLO size and compile time become O(1) in depth. None reads
+      the `scan_layers` flag. State-dict layout, per-layer optimizer resume,
+      and `sync_params_to_model`/`sync_states_to_optimizer` round-trips are
+      preserved (stacked arrays are split back per layer on sync).
     """
 
     def __init__(self, model, loss_fn: Callable, optimizer=None, mesh: Mesh | None = None,
                  batch_spec: PartitionSpec | None = None, zero_axis: str | None = None,
                  zero_stage: int = 1, offload_optimizer: bool = False,
-                 donate: bool = True, remat: bool = False, seed: int = 0):
+                 donate: bool = True, remat: bool | str | None = None,
+                 scan_layers: bool | None = None, seed: int = 0):
+        from paddle_tpu.core.flags import flag
+        from paddle_tpu.parallel.scan_layers import normalize_remat
+
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh if mesh is not None else get_mesh()
         self._params = model.parameters()
-        self._trainable = [not p.stop_gradient for p in self._params]
-        self.remat = remat
+        self.remat_policy = normalize_remat(
+            flag("remat_policy") if remat is None else remat)
+        self.remat = self.remat_policy != "none"
+        self._layer_capable = bool(getattr(model, "layer_remat_capable", False))
+        if scan_layers is None:
+            scan_layers = bool(flag("scan_layers"))
+
+        # ---- scan-over-layers packing --------------------------------------
+        # outer params bind through functional_call as before; each column j
+        # of the homogeneous scan_group becomes ONE stacked [L, ...] value
+        self.scan_layers = False
+        self._outer_params = self._params
+        self._group_cols: list[list] = []  # [P][L] per-layer Parameters
+        # packing requires BOTH halves of the cooperation protocol: a model
+        # that only exposes scan_group() but never reads the layer-execution
+        # context would trace its own (unbound) param values as constants and
+        # train frozen weights. It also requires an ELEMENTWISE optimizer
+        # update: Lamb/Lars compute a per-PARAMETER trust-ratio norm, which
+        # over a stacked [L, ...] entry would couple all layers into one
+        # ratio — silently different math than the unrolled run.
+        if scan_layers and not self._layer_capable:
+            scan_layers = False
+        if scan_layers and optimizer is not None:
+            from paddle_tpu.optimizer import Lamb, Lars
+
+            if isinstance(_innermost_opt(optimizer), (Lamb, Lars)):
+                scan_layers = False
+        if scan_layers:
+            sg = getattr(model, "scan_group", None)
+            group = list(sg()) if callable(sg) else []
+            if len(group) >= 2:
+                per_layer = [list(l.parameters()) for l in group]
+                n_per = len(per_layer[0])
+                flat_group = [p for lp in per_layer for p in lp]
+                own = {id(p) for p in self._params}
+                ok = (n_per > 0
+                      and all(len(lp) == n_per for lp in per_layer)
+                      and all(not p.stop_gradient for p in flat_group)
+                      and len({id(p) for p in flat_group}) == len(flat_group)
+                      and all(id(p) in own for p in flat_group))
+                if ok:
+                    gid = {id(p) for p in flat_group}
+                    self._outer_params = [p for p in self._params
+                                          if id(p) not in gid]
+                    self._group_cols = [[lp[j] for lp in per_layer]
+                                        for j in range(n_per)]
+                    self.scan_layers = True
+        self._trainable = ([not p.stop_gradient for p in self._outer_params]
+                           + [True] * len(self._group_cols))
         self.zero_stage = zero_stage
         # offload needs the mesh-based shardings to stream states H2D in-step
         self._offload = (offload_optimizer and host_memory_supported()
@@ -238,24 +306,42 @@ class CompiledTrainStep:
                                        "sep" if sep_on else None)
         self.batch_spec = batch_spec or PartitionSpec()
 
-        self._param_specs = [_param_pspec(p, self.mesh) for p in self._params]
+        # packed layout: [outer params..., one stacked array per group column]
+        packed_vals = [p._value for p in self._outer_params]
+        packed_specs = [_param_pspec(p, self.mesh) for p in self._outer_params]
+        if self._group_cols:
+            from paddle_tpu.parallel.scan_layers import stack_layer_vals
+
+            n_layers = len(self._group_cols[0])
+            packed_vals.extend(stack_layer_vals(
+                [[col[l]._value for col in self._group_cols]
+                 for l in range(n_layers)]))
+            packed_specs.extend(
+                PartitionSpec(None, *_param_pspec(col[0], self.mesh))
+                for col in self._group_cols)
         if zero_stage >= 3:
-            self._param_specs = [
-                _zero3_param_spec(s, p._value, zero_axis, self.mesh)
-                for s, p in zip(self._param_specs, self._params)
+            packed_specs = [
+                _zero3_param_spec(s, v, zero_axis, self.mesh)
+                for s, v in zip(packed_specs, packed_vals)
             ]
+        self._param_specs = packed_specs
         self._key = jax.random.key(seed)
         # resume from a loaded optimizer's step count: Adam-style bias
         # correction must continue at t, not restart at 1 with warm moments
         self._step_i = int(getattr(optimizer, "_step_count", 0) or 0)
 
-        # materialize params (sharded) + optimizer state
+        # materialize params (sharded) + optimizer state. Outer params are
+        # re-pointed at the placed arrays (shared buffers, as before); the
+        # per-layer split of stacked group columns is DEFERRED to explicit
+        # sync_params_to_model() calls — slicing here would keep a second
+        # full copy of every layer's weights resident for the whole run
         self._param_vals = []
-        for p, spec in zip(self._params, self._param_specs):
-            v = p._value
+        for v, spec in zip(packed_vals, self._param_specs):
             if self.mesh is not None:
                 v = jax.device_put(v, NamedSharding(self.mesh, spec))
             self._param_vals.append(v)
+        for p, v in zip(self._outer_params,
+                        self._param_vals[:len(self._outer_params)]):
             p._set_value(v)
 
         self._opt_states = None
@@ -263,12 +349,8 @@ class CompiledTrainStep:
         if optimizer is not None:
             self._opt_states = []
             self._state_shardings = []
-            for p, pv, spec in zip(self._params, self._param_vals, self._param_specs):
-                p._set_value(pv)
-                # resume from existing optimizer state (a loaded checkpoint)
-                # instead of zeroing the moments
-                st = getattr(optimizer, "_state", {}).get(id(p)) or optimizer._init_state(p)
-                st = dict(st)
+            for pv, spec, st in zip(self._param_vals, self._param_specs,
+                                    self._resume_states(optimizer)):
                 st_sh = {}
                 for k, v in st.items():
                     sp = _state_pspec(spec, v, zero_axis, self.mesh)
@@ -287,6 +369,35 @@ class CompiledTrainStep:
         self._jitted = None
         self._donate = donate
 
+    def _resume_states(self, optimizer):
+        """Fresh per-packed-entry optimizer-state dicts: resumed from
+        optimizer._state when a loaded checkpoint provides them (per-layer
+        states are stacked for group columns; layers without a saved state
+        get fresh moments individually, matching the unrolled path's
+        per-param granularity), else freshly initialized."""
+        existing = getattr(optimizer, "_state", {})
+        n_outer = len(self._outer_params)
+        for p, pv in zip(self._outer_params, self._param_vals[:n_outer]):
+            p._set_value(pv)
+            yield dict(existing.get(id(p)) or optimizer._init_state(p))
+        for col, sv in zip(self._group_cols, self._param_vals[n_outer:]):
+            sts = [existing.get(id(p)) for p in col]
+            if any(s is not None for s in sts):
+                filled = [dict(s) if s is not None
+                          else dict(optimizer._init_state(Tensor(sv[l])))
+                          for l, s in enumerate(sts)]
+                if len({frozenset(f) for f in filled}) == 1:
+                    yield {k: jnp.stack([f[k] for f in filled])
+                           for k in filled[0]}
+                    continue
+                import warnings
+
+                warnings.warn(
+                    "scan packing: per-layer optimizer states have "
+                    "mismatched keys; reinitializing the stacked entry's "
+                    "moments from zero")
+            yield dict(optimizer._init_state(Tensor(sv)))
+
     # -- the pure step -------------------------------------------------------
     def _loss_of(self, param_vals, batch, key):
         counter = [0]
@@ -295,10 +406,20 @@ class CompiledTrainStep:
             counter[0] += 1
             return jax.random.fold_in(key, counter[0])
 
+        from paddle_tpu.parallel.scan_layers import layer_execution
+
+        n_outer = len(self._outer_params)
+        stacked = list(param_vals[n_outer:]) if self._group_cols else None
+        # cooperating models apply the policy per layer (embed/head/CE stay
+        # outside every remat region); for others the context carries 'none'
+        # and _step_fn wraps the whole loss in the legacy checkpoint region
+        policy = self.remat_policy if self._layer_capable else "none"
         prev = fleet_rng._tls.active_key_fn
         fleet_rng._tls.active_key_fn = next_key
         try:
-            out = functional_call(self.model, param_vals, batch[:-1])
+            with layer_execution(policy, stacked):
+                out = functional_call(self.model, param_vals[:n_outer],
+                                      batch[:-1], params=self._outer_params)
             label = Tensor(batch[-1])
             loss = self.loss_fn(out, label)
             return loss._value
@@ -307,8 +428,13 @@ class CompiledTrainStep:
 
     def _step_fn(self, param_vals, opt_states, batch, key, lr, step_i):
         loss_of = self._loss_of
-        if self.remat:
-            loss_of = jax.checkpoint(loss_of, static_argnums=())
+        if self.remat and not self._layer_capable:
+            from paddle_tpu.parallel.scan_layers import remat_wrap
+
+            # legacy whole-loss region for models that cannot scope remat
+            # per layer themselves (the policy still applies, e.g. tagged
+            # residuals offload under 'offload_residuals')
+            loss_of = remat_wrap(loss_of, self.remat_policy)
 
         trainable_idx = [i for i, t in enumerate(self._trainable) if t]
 
@@ -397,20 +523,30 @@ class CompiledTrainStep:
 
     def sync_params_to_model(self):
         """Write the current device arrays back into the model's Tensors
-        (checkpointing / eval interop)."""
-        for p, v in zip(self._params, self._param_vals):
+        (checkpointing / eval interop). Scan-packed group columns are split
+        back per layer, so state_dict layout is identical with scan on/off."""
+        n_outer = len(self._outer_params)
+        for p, v in zip(self._outer_params, self._param_vals[:n_outer]):
             p._set_value(v)
+        for col, sv in zip(self._group_cols, self._param_vals[n_outer:]):
+            for l, p in enumerate(col):
+                p._set_value(sv[l])
 
     def sync_states_to_optimizer(self):
         """Write the in-program optimizer state back into optimizer._state so
         optimizer.state_dict() reflects trained moments (checkpoint parity).
         Targets the INNERMOST optimizer: wrappers delegate state_dict() there,
-        and attribute assignment on a wrapper would only shadow it."""
+        and attribute assignment on a wrapper would only shadow it. Stacked
+        group-column states are split back into per-layer entries."""
         if self.optimizer is None or self._opt_states is None:
             return
         opt = _innermost_opt(self.optimizer)
-        for p, st in zip(self._params, self._opt_states):
+        n_outer = len(self._outer_params)
+        for p, st in zip(self._outer_params, self._opt_states[:n_outer]):
             opt._state[id(p)] = dict(st)
+        for col, st in zip(self._group_cols, self._opt_states[n_outer:]):
+            for l, p in enumerate(col):
+                opt._state[id(p)] = {k: v[l] for k, v in st.items()}
         opt._step_count = self._step_i
 
     @property

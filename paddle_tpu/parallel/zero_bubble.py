@@ -185,7 +185,22 @@ class ZBH1PipelinedStep:
     def __init__(self, embed_layer, blocks: Sequence, head_layer,
                  loss_fn: Callable, mesh: Mesh | None = None,
                  num_micro: int = 2, seed: int = 0, optimizer=None,
-                 debug: bool = False):
+                 debug: bool = False, remat: bool | str = False):
+        from paddle_tpu.parallel.scan_layers import normalize_remat
+
+        # ZB-H1 is ZERO-recompute by construction: every residual the
+        # backward needs is stashed at the F tick and replayed by the B/W
+        # jaxpr slices, and the B/W cut requires the UNROLLED, uncheckpointed
+        # block loop (a jax.checkpoint'd or scanned block is one atomic
+        # equation to the slicer, collapsing W into B — i.e. back to 1F1B).
+        # The knob exists for API uniformity with PipelinedTrainStep; any
+        # recomputing policy is rejected rather than silently ignored.
+        self.remat_policy = normalize_remat(remat)
+        if self.remat_policy != "none":
+            raise ValueError(
+                f"ZBH1PipelinedStep is zero-recompute by design; remat "
+                f"policy {self.remat_policy!r} is not applicable (use "
+                f"PipelinedTrainStep for selective rematerialization)")
         # debug=True additionally returns every tick's sent activation /
         # cotangent (per rank) from run(), in self._dbg_out — the parity
         # debugging view used by tests

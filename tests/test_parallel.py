@@ -182,6 +182,23 @@ class TestPipelinedTrainStep:
         set_mesh(None)
         np.testing.assert_allclose(losses, ref, rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.parametrize("policy", ["save_dots", "offload_residuals"])
+    def test_pipeline_remat_policy_matches_dense(self, policy):
+        """Selective-remat policies applied per scanned layer inside each
+        stage change memory, never math (ISSUE 2)."""
+        ref = dense_losses()
+        mesh = build_mesh({"pp": 2, "dp": 2, "mp": 2})
+        cfg, embed, blocks, head, crit, params = _make_pipeline_modules()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=params)
+        step = PipelinedTrainStep(embed, blocks, head, lambda lg, lb: crit(lg, lb),
+                                  optimizer=opt, mesh=mesh, num_micro=2,
+                                  remat=policy)
+        assert step.remat_policy == policy
+        ids, labels = _data(cfg)
+        losses = [float(step(ids, labels)) for _ in range(3)]
+        set_mesh(None)
+        np.testing.assert_allclose(losses, ref, rtol=2e-3, atol=2e-3)
+
     def test_sync_params_back(self):
         mesh = build_mesh({"pp": 2, "dp": 2, "mp": 2})
         cfg, embed, blocks, head, crit, params = _make_pipeline_modules()
