@@ -106,6 +106,18 @@ define_flag("fused_ce_variant", "auto", "fused-CE strategy: auto|tokens|vocab|pa
 define_flag("scan_layers", False,
             "run homogeneous decoder stacks as ONE lax.scan over layer-stacked "
             "params (O(1)-in-depth HLO size and compile time)")
+define_flag("prefetch_to_device_depth", 2,
+            "double-buffered device prefetch depth for DeviceFeeder/"
+            "Model.fit: batches collated + sharded-device_put on a "
+            "background thread, this many in flight (0 disables the feeder; "
+            "each unit costs one batch of HBM)", type=int)
+define_flag("async_dispatch_window", 2,
+            "max un-fetched compiled steps in flight before the dispatcher "
+            "blocks on the oldest loss (bounds run-ahead HBM)", type=int)
+define_flag("metrics_sync_every", 1,
+            "read the loss to host every k steps (1 = every step, the "
+            "synchronous default; larger k keeps JAX async dispatch "
+            "unbroken between reads)", type=int)
 define_flag("remat_policy", "none",
             "default selective-rematerialization policy, consulted when a "
             "step is constructed with remat=None (the CompiledTrainStep "

@@ -225,7 +225,10 @@ class Model:
             self._dist_dirty = False
 
     # -- steps ---------------------------------------------------------------
-    def train_batch(self, inputs, labels=None, update=True):
+    def train_batch(self, inputs, labels=None, update=True, fetch=True):
+        """fetch=False (compiled path, no user metrics): return the loss as
+        an un-read LossFuture instead of float()ing it — the device->host
+        sync that would otherwise break JAX's async dispatch every step."""
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
@@ -233,6 +236,10 @@ class Model:
             self._dist_model.train()
             loss = self._dist_model(*inputs, labels[0])
             self._dist_dirty = True
+            if not fetch and not self._metrics:
+                from paddle_tpu.io.device_feed import LossFuture
+
+                return {"loss": LossFuture(loss)}
             metrics = {"loss": float(loss)}
             if self._metrics:
                 # user-configured metrics need logits: sync + eager forward
@@ -282,11 +289,34 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
-        """reference: hapi/model.py:1750."""
+            accumulate_grad_batches=1, num_iters=None,
+            prefetch_to_device=None, metrics_sync_every=None):
+        """reference: hapi/model.py:1750.
+
+        Async input/dispatch pipeline (compiled/mesh path only, and only when
+        no user metrics force a per-step eager forward): batches are
+        collated + sharded-device_put on a DeviceFeeder background thread
+        (`prefetch_to_device` batches deep, None reads
+        FLAGS_prefetch_to_device_depth, 0 disables) and the loss is read to
+        host only every `metrics_sync_every` steps (None reads the flag;
+        between reads callbacks see the most recent synced value, so a
+        larger k trades metric freshness for an unbroken dispatch stream).
+        Per-step losses are unchanged by either knob — only WHEN they are
+        read moves."""
+        from paddle_tpu.core.flags import flag as _flag
+
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
             num_workers=num_workers)
+        k_sync = int(metrics_sync_every if metrics_sync_every is not None
+                     else _flag("metrics_sync_every")) or 1
+        feed_depth = int(prefetch_to_device if prefetch_to_device is not None
+                         else _flag("prefetch_to_device_depth")) or 0
+        # deferred reads + device prefetch need the compiled train step (the
+        # eager fallback syncs in backward anyway) and no per-step eager
+        # metrics (those need host logits, defeating the overlap)
+        use_async = self._dist_model is not None and not self._metrics
+        use_feed = use_async and feed_depth > 0
         cbs = list(callbacks or [])
         if verbose:
             cbs.append(ProgBarLogger(log_freq, verbose))
@@ -310,14 +340,47 @@ class Model:
             for cb in cbs:
                 cb.on_epoch_begin(epoch)
             logs = {}
-            for step, batch in enumerate(loader):
-                data, label = (batch[:-1], batch[-1]) if isinstance(batch, (tuple, list)) else (batch, None)
-                logs = self.train_batch(list(data), label)
-                for cb in cbs:
-                    cb.on_train_batch_end(step, logs)
-                it += 1
-                if num_iters and it >= num_iters:
-                    break
+            source = iter(loader)
+            feeder = None
+            if use_feed:
+                from paddle_tpu.io.device_feed import DeviceFeeder
+
+                feeder = DeviceFeeder(source, mesh=self._dist_model._mesh,
+                                      depth=feed_depth)
+                source = feeder
+            pending = None  # newest un-read LossFuture
+            last_loss = None
+            try:
+                for step, batch in enumerate(source):
+                    data, label = (batch[:-1], batch[-1]) if isinstance(batch, (tuple, list)) else (batch, None)
+                    sync = (k_sync <= 1) or ((step + 1) % k_sync == 0)
+                    logs = self.train_batch(list(data), label,
+                                            fetch=not use_async or sync)
+                    if use_async:
+                        lval = logs.get("loss")
+                        if isinstance(lval, (int, float)):
+                            last_loss = float(lval)
+                            pending = None
+                        else:  # deferred: report the last synced value
+                            pending = lval
+                            logs = dict(logs)
+                            if last_loss is None:
+                                del logs["loss"]
+                            else:
+                                logs["loss"] = last_loss
+                    for cb in cbs:
+                        cb.on_train_batch_end(step, logs)
+                    it += 1
+                    if num_iters and it >= num_iters:
+                        break
+            finally:
+                if feeder is not None:
+                    feeder.close()
+            if pending is not None:
+                # settle the epoch's true final loss before epoch-end logs
+                logs = dict(logs)
+                logs["loss"] = last_loss = float(pending)
+                pending = None
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_data, batch_size=batch_size, verbose=0)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})

@@ -20,6 +20,9 @@ import numpy as np
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.io.device_feed import (BatchSpecCache, DeviceFeeder,
+                                       DispatchWindow, LossFuture,
+                                       prefetch_to_device)
 from paddle_tpu.ops.random_state import default_generator
 
 __all__ = [
@@ -28,7 +31,28 @@ __all__ = [
     "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "SubsetRandomSampler", "BatchSampler", "DistributedBatchSampler",
     "DataLoader", "default_collate_fn", "get_worker_info",
+    "DeviceFeeder", "prefetch_to_device", "BatchSpecCache", "DispatchWindow",
+    "LossFuture",
 ]
+
+
+def _as_rng(generator):
+    """Thread a reproducibility handle through samplers/splits: None -> the
+    global numpy RNG (legacy behavior), an int -> a fresh seeded Generator,
+    a numpy Generator/RandomState passes through (its state advances across
+    uses, the torch generator semantics)."""
+    if generator is None:
+        return np.random
+    if isinstance(generator, (int, np.integer)):
+        return np.random.default_rng(int(generator))
+    return generator
+
+
+def _rand_ints(rng, n, size):
+    # Generator spells it `integers`, RandomState/module spell it `randint`
+    if hasattr(rng, "integers"):
+        return rng.integers(0, n, size)
+    return rng.randint(0, n, size)
 
 
 class Dataset:
@@ -103,7 +127,7 @@ def random_split(dataset, lengths, generator=None):
             lengths[-1] = n - sum(lengths[:-1])
         else:
             raise ValueError("sum of lengths must equal dataset size")
-    perm = np.random.permutation(n)
+    perm = _as_rng(generator).permutation(n)
     out, off = [], 0
     for l in lengths:
         out.append(Subset(dataset, perm[off : off + l].tolist()))
@@ -132,12 +156,14 @@ class RandomSampler(Sampler):
         super().__init__(data_source)
         self.replacement = replacement
         self.num_samples = num_samples or len(data_source)
+        self.generator = generator
 
     def __iter__(self):
         n = len(self.data_source)
+        rng = _as_rng(self.generator)
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[: self.num_samples].tolist())
+            return iter(_rand_ints(rng, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
@@ -299,32 +325,53 @@ def default_collate_fn(batch):
 
 class _PrefetchIter:
     def __init__(self, it, num_prefetch):
+        from paddle_tpu.io.device_feed import THREAD_PREFIX, interruptible_put
+
         self.q: queue.Queue = queue.Queue(maxsize=num_prefetch)
         self._sentinel = object()
         self._err = None
+        self._stop = threading.Event()
 
         def worker():
             try:
                 for item in it:
-                    self.q.put(item)
+                    if not interruptible_put(self.q, item, self._stop):
+                        return
             except BaseException as e:  # propagate to consumer
                 self._err = e
             finally:
-                self.q.put(self._sentinel)
+                interruptible_put(self.q, self._sentinel, self._stop)
 
-        self._t = threading.Thread(target=worker, daemon=True)
+        self._t = threading.Thread(target=worker, daemon=True,
+                                   name=f"{THREAD_PREFIX}.prefetch")
         self._t.start()
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
         item = self.q.get()
         if item is self._sentinel:
-            if self._err is not None:
-                raise self._err
+            err = self._err
+            self.close()
+            if err is not None:
+                self._err = None
+                raise err
             raise StopIteration
         return item
+
+    def close(self):
+        from paddle_tpu.io.device_feed import stop_and_join
+
+        stop_and_join(self.q, self._stop, self._t)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def _collate_np(batch):

@@ -189,6 +189,13 @@ class PipelinedTrainStep:
         self._dp_axes = tuple(a for a in ("dp", "sharding") if a in mesh.shape and mesh.shape[a] > 1)
         self._dp_axes0 = self._dp_axes
         self._jit_cache = {}
+        # async feed/dispatch: pre-placed batches skip device_put; the
+        # window bounds un-fetched steps in flight (train_step contract)
+        from paddle_tpu.io.device_feed import DispatchWindow
+
+        self._window = DispatchWindow()
+        self._bshard_cache = {}
+        self.h2d_transfers = 0
 
         # ---- parameter pytrees ------------------------------------------------
         self._embed_params = embed_layer.parameters()
@@ -472,9 +479,19 @@ class PipelinedTrainStep:
                 self._jitted = jax.jit(self._step_fn, donate_argnums=(0, 1, 2, 3))
                 self._jit_cache[eff_dp] = self._jitted
         dp = self._dp_axes
-        bspec = PartitionSpec(dp if dp else None)
-        iv = jax.device_put(iv, NamedSharding(self.mesh, bspec))
-        lv = jax.device_put(lv, NamedSharding(self.mesh, bspec))
+        bshard = self._bshard_cache.get(dp)
+        if bshard is None:
+            bshard = NamedSharding(self.mesh, PartitionSpec(dp if dp else None))
+            self._bshard_cache[dp] = bshard
+        placed = []
+        for v in (iv, lv):
+            if (isinstance(v, jax.Array) and getattr(v, "committed", False)
+                    and v.sharding == bshard):
+                placed.append(v)  # pre-placed (DeviceFeeder) fast path
+            else:
+                placed.append(jax.device_put(v, bshard))
+                self.h2d_transfers += 1
+        iv, lv = placed
         self._step_i += 1
         self._key, sub = jax.random.split(self._key)
         lr = jnp.asarray(self.optimizer.get_lr() if self.optimizer else 0.0, jnp.float32)
@@ -482,7 +499,22 @@ class PipelinedTrainStep:
                            self._opt_states, iv, lv, sub, lr,
                            jnp.asarray(self._step_i, jnp.int32))
         loss, self._embed_vals, self._stacked_blocks, self._head_vals, self._opt_states = out
+        self._window.admit(loss)  # bound async run-ahead (~2 steps in flight)
         return Tensor(loss)
+
+    @property
+    def batch_spec(self):
+        """Input layout for DeviceFeeder: batch dim over the data axes."""
+        return PartitionSpec(self._dp_axes0 if self._dp_axes0 else None)
+
+    def step_async(self, ids, labels):
+        """Dispatch one step, return a deferred-read LossFuture."""
+        from paddle_tpu.io.device_feed import LossFuture
+
+        return LossFuture(self(ids, labels))
+
+    def drain(self):
+        self._window.drain()
 
     def _unstack(self, arr):
         """[S, bps, ...] (or [S, V, bpc, ...]) -> [n_layers, ...] in layer

@@ -213,6 +213,14 @@ class CompiledTrainStep:
     offload_optimizer: place optimizer state in pinned host memory
       (reference sharding offload variants); requires backend host-memory
       support (TPU), silently stays in HBM otherwise.
+    metrics_every: pacing for `step_async` — every k-th returned LossFuture
+      comes pre-blocked (already finished, so reading it is free). k=1 (the
+      `metrics_sync_every` flag default) keeps fully synchronous pacing;
+      0 never blocks, leaving run-ahead bounded only by dispatch_window.
+      None reads the flag. `__call__` itself never blocks on the loss.
+    dispatch_window: max un-fetched steps in flight before dispatch blocks
+      on the oldest loss (None reads the `async_dispatch_window` flag).
+      Bounds async run-ahead so queued steps' batches can't OOM HBM.
     remat: selective-rematerialization policy — a string from
       paddle_tpu.parallel.scan_layers.REMAT_POLICIES
       (none|full|save_dots|save_nothing|offload_residuals), a bool
@@ -233,8 +241,11 @@ class CompiledTrainStep:
                  batch_spec: PartitionSpec | None = None, zero_axis: str | None = None,
                  zero_stage: int = 1, offload_optimizer: bool = False,
                  donate: bool = True, remat: bool | str | None = None,
-                 scan_layers: bool | None = None, seed: int = 0):
+                 scan_layers: bool | None = None, seed: int = 0,
+                 metrics_every: int | None = None,
+                 dispatch_window: int | None = None):
         from paddle_tpu.core.flags import flag
+        from paddle_tpu.io.device_feed import DispatchWindow
         from paddle_tpu.parallel.scan_layers import normalize_remat
 
         self.model = model
@@ -297,14 +308,23 @@ class CompiledTrainStep:
                          and (mesh is not None or get_mesh() is not None))
 
         if batch_spec is None and self.mesh is not None:
-            data_axes = tuple(a for a in ("dp", "sharding") if
-                              a in self.mesh.shape and self.mesh.shape[a] > 1)
-            # TRUE sequence parallelism: 'sep' shards dim 1 (the sequence),
-            # not the batch — GSPMD inserts the K/V gathers attention needs
-            sep_on = "sep" in self.mesh.shape and self.mesh.shape["sep"] > 1
-            batch_spec = PartitionSpec(data_axes if data_axes else None,
-                                       "sep" if sep_on else None)
+            # batch dim 0 over the data axes, the SEQUENCE dim over 'sep'
+            # (context parallelism) — shared with DeviceFeeder via
+            # device_feed.default_batch_spec
+            from paddle_tpu.io.device_feed import default_batch_spec
+
+            batch_spec = default_batch_spec(self.mesh)
         self.batch_spec = batch_spec or PartitionSpec()
+        # per-input trimmed shardings are computed ONCE per batch signature
+        # (shapes+dtypes) and cached — not per step on the critical path
+        from paddle_tpu.io.device_feed import BatchSpecCache
+
+        self._spec_cache = BatchSpecCache(self.mesh, self.batch_spec)
+        self.h2d_transfers = 0  # input leaves actually moved host->device
+        self.metrics_every = int(flag("metrics_sync_every")
+                                 if metrics_every is None else metrics_every)
+        self._async_count = 0
+        self._window = DispatchWindow(dispatch_window)
 
         # packed layout: [outer params..., one stacked array per group column]
         packed_vals = [p._value for p in self._outer_params]
@@ -486,40 +506,55 @@ class CompiledTrainStep:
 
     # -- public --------------------------------------------------------------
     def __call__(self, *batch):
-        """batch: (*inputs, label) as Tensors/arrays. Returns loss Tensor."""
+        """batch: (*inputs, label) as Tensors/arrays. Returns the loss as an
+        UN-FETCHED Tensor: reading it (float()) is the device->host sync, so
+        callers control how often dispatch is broken (`metrics_every`).
+        Pre-placed inputs (a DeviceFeeder batch) whose sharding already
+        matches skip the device_put entirely."""
+        from paddle_tpu.profiler import RecordEvent
+
         if self._jitted is None:
             self._build()
-        vals = tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
-        if self.mesh is not None:
-            placed = []
-            for v in vals:
-                # per-dim: trim the spec to this input's rank and drop any
-                # dim whose size doesn't divide its axes (replicate it)
-                dims = list(tuple(self.batch_spec))[: v.ndim]
-                eff = []
-                for d, entry in enumerate(dims):
-                    axes = [a for a in (entry if isinstance(entry, tuple)
-                                        else (entry,)) if a]
-                    div = int(np.prod([self.mesh.shape[a] for a in axes])) if axes else 1
-                    eff.append(entry if (div > 1 and v.shape[d] % div == 0)
-                               or div == 1 else None)
-                spec = PartitionSpec(*eff) if v.ndim else PartitionSpec()
-                placed.append(jax.device_put(v, NamedSharding(self.mesh, spec)))
-            vals = tuple(placed)
+        with RecordEvent("CompiledTrainStep::place"):
+            vals, moved = self._spec_cache.place(batch)
+            self.h2d_transfers += moved
         self._step_i += 1
         self._key, sub = jax.random.split(self._key)
         lr = jnp.asarray(
             self.optimizer.get_lr() if self.optimizer is not None else 0.0, jnp.float32
         )
-        loss, self._param_vals, self._opt_states = self._jitted(
-            self._param_vals, self._opt_states, vals, sub, lr,
-            jnp.asarray(self._step_i, jnp.int32),
-        )
+        with RecordEvent("CompiledTrainStep::dispatch"):
+            loss, self._param_vals, self._opt_states = self._jitted(
+                self._param_vals, self._opt_states, vals, sub, lr,
+                jnp.asarray(self._step_i, jnp.int32),
+            )
+        # bounded run-ahead: block on the loss of step N-window before
+        # returning, so at most `window` compiled steps are queued on-device
+        self._window.admit(loss)
         if self.optimizer is not None:
             _innermost_opt(self.optimizer)._step_count = self._step_i
             if hasattr(self.optimizer._lr, "step") and not isinstance(self.optimizer._lr, float):
                 pass  # schedulers stepped by caller, matching eager semantics
         return Tensor(loss)
+
+    def step_async(self, *batch):
+        """Dispatch one step and return a LossFuture — the deferred-read
+        handle for run-ahead training loops. Every `metrics_every`-th call
+        blocks until its step finishes before returning (so the caller's
+        periodic float() is free); with metrics_every=0 nothing ever blocks
+        here and run-ahead is bounded only by the dispatch window.
+        `drain()` before checkpointing/timing."""
+        from paddle_tpu.io.device_feed import LossFuture
+
+        f = LossFuture(self(*batch))
+        self._async_count += 1
+        if self.metrics_every and self._async_count % self.metrics_every == 0:
+            f.block()
+        return f
+
+    def drain(self):
+        """Block until every dispatched step has executed."""
+        self._window.drain()
 
     def sync_params_to_model(self):
         """Write the current device arrays back into the model's Tensors

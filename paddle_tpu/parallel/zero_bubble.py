@@ -260,6 +260,12 @@ class ZBH1PipelinedStep:
         self.optimizer = optimizer
         self._opt_states = None
         self._update_jit = None
+        # async feed/dispatch: bound un-fetched steps in flight, accept
+        # pre-placed device batches without a host round-trip
+        from paddle_tpu.io.device_feed import DispatchWindow
+
+        self._window = DispatchWindow()
+        self.h2d_transfers = 0  # input leaves actually moved host->device
         # resume parity: continue from a restored optimizer's step count
         from paddle_tpu.parallel.train_step import _innermost_opt
 
@@ -699,13 +705,29 @@ class ZBH1PipelinedStep:
         self._jitted = jax.jit(smapped)
 
     def run(self, ids, labels):
-        """ids/labels: [M*mb, seq] numpy/jnp arrays."""
-        ids = np.asarray(ids)
-        labels = np.asarray(labels)
-        mbs = ids.shape[0] // self.M
-        ids_mb = jnp.asarray(ids.reshape((self.M, mbs) + ids.shape[1:]))
-        labels_mb = jnp.asarray(
-            labels.reshape((self.M, mbs) + labels.shape[1:]))
+        """ids/labels: [M*mb, seq] numpy/jnp arrays. Inputs are placed
+        replicated over the mesh (ZB-H1 replicates the batch); an input
+        already committed to that sharding — a DeviceFeeder batch — skips
+        the device_put, and device-resident inputs never round-trip through
+        numpy (the microbatch reshape stays on device)."""
+        iv = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
+        lv = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+        repl = getattr(self, "_batch_sharding", None)
+        if repl is None:
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            self._batch_sharding = repl
+        placed = []
+        for v in (iv, lv):
+            if (isinstance(v, jax.Array) and getattr(v, "committed", False)
+                    and v.sharding == repl):
+                placed.append(v)  # pre-placed (DeviceFeeder) fast path
+            else:
+                placed.append(jax.device_put(v, repl))
+                self.h2d_transfers += 1
+        iv, lv = placed
+        mbs = iv.shape[0] // self.M
+        ids_mb = iv.reshape((self.M, mbs) + iv.shape[1:])
+        labels_mb = lv.reshape((self.M, mbs) + lv.shape[1:])
         if self._jitted is None:
             emb_probe = self._embed_fwd(self._embed_vals, ids_mb[0])
             self._build(tuple(emb_probe.shape), ids_mb.dtype)
@@ -720,11 +742,9 @@ class ZBH1PipelinedStep:
     def __call__(self, ids, labels):
         """Train step: ZB-H1 forward/backward + optimizer update (the Fleet
         train_batch contract, like PipelinedTrainStep)."""
-        ids = ids._value if isinstance(ids, Tensor) else ids
-        labels = labels._value if isinstance(labels, Tensor) else labels
-        loss, (g_embed, g_stage, g_head) = self.run(np.asarray(ids),
-                                                    np.asarray(labels))
+        loss, (g_embed, g_stage, g_head) = self.run(ids, labels)
         if self.optimizer is None:
+            self._window.admit(loss)
             return Tensor(loss)
         flat_p = list(self._embed_vals) + list(self._stacked_blocks) \
             + list(self._head_vals)
@@ -754,7 +774,17 @@ class ZBH1PipelinedStep:
         from paddle_tpu.parallel.train_step import _innermost_opt
 
         _innermost_opt(self.optimizer)._step_count = self._step_i
+        self._window.admit(loss)  # bound async run-ahead
         return Tensor(loss)
+
+    def step_async(self, ids, labels):
+        """Dispatch one step, return a deferred-read LossFuture."""
+        from paddle_tpu.io.device_feed import LossFuture
+
+        return LossFuture(self(ids, labels))
+
+    def drain(self):
+        self._window.drain()
 
     def sync_params_to_model(self):
         for p, v in zip(self._embed_params, self._embed_vals):

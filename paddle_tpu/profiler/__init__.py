@@ -38,10 +38,16 @@ class SummaryView(Enum):
     MemoryView = 6
 
 
-class _Collector(threading.local):
+class _Collector:
+    """Process-wide event sink (NOT thread-local): background workers —
+    DeviceFeeder placement, DataLoader prefetchers — must land in the same
+    trace as the main loop; events carry tid, so the chrome timeline still
+    separates threads."""
+
     def __init__(self):
         self.events = []
         self.active = False
+        self.lock = threading.Lock()
 
 
 _collector = _Collector()
@@ -61,11 +67,12 @@ class RecordEvent:
         if self._begin is None:
             return
         if _collector.active:
-            _collector.events.append(
-                {"name": self.name, "ts": self._begin / 1000.0,
-                 "dur": (time.perf_counter_ns() - self._begin) / 1000.0,
-                 "ph": "X", "pid": os.getpid(), "tid": threading.get_ident()}
-            )
+            ev = {"name": self.name, "ts": self._begin / 1000.0,
+                  "dur": (time.perf_counter_ns() - self._begin) / 1000.0,
+                  "ph": "X", "pid": os.getpid(),
+                  "tid": threading.get_ident()}
+            with _collector.lock:
+                _collector.events.append(ev)
         self._begin = None
 
     def __enter__(self):
@@ -130,12 +137,14 @@ class Profiler:
         self._jax_trace_dir = None
 
     def start(self):
+        with _collector.lock:
+            _collector.events = []
         _collector.active = True
-        _collector.events = []
 
     def stop(self):
         _collector.active = False
-        self._events = list(_collector.events)
+        with _collector.lock:
+            self._events = list(_collector.events)
         if self.on_trace_ready:
             self.on_trace_ready(self)
 

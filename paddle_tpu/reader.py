@@ -9,7 +9,12 @@ import random as _random
 import threading
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
-           "cache"]
+           "cache", "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    """compose(check_alignment=True): the composed readers ended at
+    different positions (zip would silently truncate to the shortest)."""
 
 
 def map_readers(func, *readers):
@@ -44,9 +49,19 @@ def chain(*readers):
 
 
 def compose(*readers, check_alignment=True):
+    _end = object()
+
     def composed():
         its = [r() for r in readers]
-        for items in zip(*its):
+        while True:
+            items = [next(it, _end) for it in its]
+            ended = sum(1 for i in items if i is _end)
+            if ended:
+                if check_alignment and ended != len(items):
+                    raise ComposeNotAligned(
+                        f"compose: {ended}/{len(items)} readers ended early "
+                        "(streams are misaligned)")
+                return
             out = []
             for it in items:
                 if isinstance(it, tuple):
@@ -59,28 +74,48 @@ def compose(*readers, check_alignment=True):
 
 
 def buffered(reader, size):
-    """Prefetch up to `size` items on a background thread."""
+    """Prefetch up to `size` items on a background thread. A producer
+    exception is captured and RE-RAISED in the consumer (the DeviceFeeder
+    contract) — it must not masquerade as a short stream."""
 
     class _End:
         pass
 
     def buffered_reader():
+        from paddle_tpu.io.device_feed import (THREAD_PREFIX,
+                                               interruptible_put,
+                                               stop_and_join)
+
         q: _queue.Queue = _queue.Queue(maxsize=size)
+        stop = threading.Event()
+        err: list = []
 
         def fill():
             try:
                 for item in reader():
-                    q.put(item)
+                    # interruptible: an abandoned consumer sets `stop` from
+                    # its generator-close finally, unblocking a producer
+                    # parked on a full queue
+                    if not interruptible_put(q, item, stop):
+                        return
+            except BaseException as e:
+                err.append(e)
             finally:
-                q.put(_End)
+                interruptible_put(q, _End, stop)
 
-        t = threading.Thread(target=fill, daemon=True)
+        t = threading.Thread(target=fill, daemon=True,
+                             name=f"{THREAD_PREFIX}.buffered")
         t.start()
-        while True:
-            item = q.get()
-            if item is _End:
-                break
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is _End:
+                    if err:
+                        raise err[0]
+                    break
+                yield item
+        finally:
+            stop_and_join(q, stop, t)
 
     return buffered_reader
 

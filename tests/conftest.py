@@ -55,6 +55,33 @@ def _seed():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _thread_hygiene():
+    """Tier-1 guard: DataLoader/DeviceFeeder prefetch threads must not leak
+    across tests. Every paddle_tpu.io background thread carries the
+    "paddle_tpu.io" name prefix and is joined on close/exhaustion; a test
+    that strands one fails here instead of poisoning the rest of the
+    suite."""
+    import threading
+    import time
+
+    # compare Thread OBJECTS, not idents: CPython recycles idents, so a
+    # leaked thread could inherit a baseline thread's ident and hide
+    before = set(threading.enumerate())
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("paddle_tpu.io")
+                and t not in before and t.is_alive()]
+
+    yield
+    deadline = time.time() + 3.0
+    while leaked() and time.time() < deadline:
+        time.sleep(0.02)  # grace: exhausted workers exit right after _End
+    assert not leaked(), (
+        f"leaked prefetch threads: {[t.name for t in leaked()]}")
+
+
 @pytest.fixture
 def mesh8():
     """A pp2 x dp2 x mp2 mesh over the 8 virtual devices."""
