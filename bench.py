@@ -100,6 +100,7 @@ loss_fn._fused_ce_spec = {"ignore_index": -100, "reduction": "mean"}
 
 build_mesh({"pp": S})
 paddle.seed(0)
+MB, SEQ = 8, 32  # microbatch rows / sequence length (also the ids shape)
 times = {}
 zb_times = {}
 for M in (4, 16):
@@ -112,8 +113,7 @@ for M in (4, 16):
     opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=params)
     step = PipelinedTrainStep(emb, blocks, head, loss_fn, optimizer=opt,
                               num_micro=M, remat=False)
-    mb = 8
-    ids = np.random.RandomState(0).randint(0, V, (M * mb, 32)).astype(np.int64)
+    ids = np.random.RandomState(0).randint(0, V, (M * MB, SEQ)).astype(np.int64)
     step(ids, ids)  # compile
     ts = []
     for _ in range(3):
@@ -159,17 +159,22 @@ def bubble(t):
 
 ratio = times[16] / times[4]
 theory = (16 + S - 1) / (4 + S - 1)
+tok = {M: M * MB * SEQ for M in (4, 16)}  # M microbatches x mb rows x seq
 out = {
     "S": S, "t_m4_ms": round(times[4] * 1e3, 2), "t_m16_ms": round(times[16] * 1e3, 2),
     "tick_ratio_measured": round(ratio, 3), "tick_ratio_theory": round(theory, 3),
     "overhead_vs_theory": round(ratio / theory - 1, 3),
     "bubble_frac_m4": round((S - 1) / (4 + S - 1), 3),
-    "measured_bubble_1f1b": round(bubble(times), 3)}
+    "measured_bubble_1f1b": round(bubble(times), 3),
+    "tokens_per_sec_m4": round(tok[4] / times[4], 1),
+    "tokens_per_sec_m16": round(tok[16] / times[16], 1)}
 if zb_times and 16 in zb_times:
     out.update({
         "measured_bubble_zbh1": round(bubble(zb_times), 3),
         "zbh1_t_m4_ms": round(zb_times[4] * 1e3, 2),
-        "zbh1_t_m16_ms": round(zb_times[16] * 1e3, 2)})
+        "zbh1_t_m16_ms": round(zb_times[16] * 1e3, 2),
+        "zbh1_tokens_per_sec_m4": round(tok[4] / zb_times[4], 1),
+        "zbh1_tokens_per_sec_m16": round(tok[16] / zb_times[16], 1)})
 print("PIPE_JSON " + json.dumps(out))
 """
 
@@ -289,6 +294,8 @@ out = {
     "t_async_zero_host_ms": round(float(np.median(seg["async0"])) * 1e3, 2),
     "recovered_host_frac": round(recovered, 3),
     "recovers_80pct": bool(recovered >= 0.8),
+    "tokens_per_sec_sync": round(B * S / float(np.median(seg["sync"])), 1),
+    "tokens_per_sec_async": round(B * S / float(np.median(seg["async"])), 1),
     "zero_host_ratio_async_vs_sync": round(float(np.median(ratio0)), 3),
     "losses_bit_identical": bool(l_sync == l_async and l_sync0 == l_async0),
     "h2d_per_step_sync": round(arms["sync"].step.h2d_transfers
@@ -298,6 +305,153 @@ out = {
 }
 print("FEED_JSON " + json.dumps(out))
 """
+
+
+PACKING_PROBE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import json, time
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.io.packing import pack_examples, pad_examples, packing_stats
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.ops.pallas.flash_attention import segment_block_visit_counts
+from paddle_tpu.parallel import CompiledTrainStep
+
+# skewed-length corpus (lognormal doc lengths): the padded arm burns the pad
+# fraction of every step; the packed arm fuses documents into full rows, so
+# the SAME real (loss-bearing) tokens take ~row_compression fewer steps.
+S, B, H = 128, 4, 64
+cfg = LlamaConfig(vocab_size=512, hidden_size=H, intermediate_size=2 * H,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=S,
+                  use_parallel_cross_entropy=True)
+build_mesh({"dp": 1})
+rng = np.random.RandomState(0)
+lengths = np.clip(np.exp(rng.normal(4.0, 0.6, 160)).astype(int), 8, S)
+docs = [rng.randint(1, cfg.vocab_size, n).astype(np.int32) for n in lengths]
+stats = packing_stats([len(d) for d in docs], S, B)
+real_tokens = int(sum(len(d) - 1 for d in docs))
+
+packed = list(pack_examples(iter(docs), S, B))
+# the padded baseline trains WITHOUT segment metadata (classic padded rows)
+padded = [{"input_ids": b["input_ids"], "labels": b["labels"]}
+          for b in pad_examples(iter(docs), S, B)]
+
+
+def run(batches):
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.train()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = CompiledTrainStep(model, lambda out, lab: out, opt,
+                             metrics_every=0)
+    step(batches[0])  # compile + settle
+    step.drain()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for b in batches:
+            step(b)
+        step.drain()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+t_packed = run(packed)
+t_padded = run(padded)
+
+# attention-only timing (the XLA fallback path on CPU; same math the
+# segment kernel computes), per corpus pass
+qkv = [jnp.asarray(rng.randn(B, S, 4, H // 4), jnp.float32) for _ in range(3)]
+seg0 = jnp.asarray(packed[0]["segment_ids"], jnp.int32)
+attn_seg = jax.jit(lambda q, k, v, s: F.scaled_dot_product_attention(
+    q, k, v, is_causal=True, segment_ids=s)._value)
+attn_plain = jax.jit(lambda q, k, v: F.scaled_dot_product_attention(
+    q, k, v, is_causal=True)._value)
+attn_seg(*qkv, seg0).block_until_ready()
+attn_plain(*qkv).block_until_ready()
+
+
+def t_attn(fn, *a):
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        fn(*a).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+attn_ms_packed = t_attn(attn_seg, *qkv, seg0) * 1e3 * len(packed)
+attn_ms_padded = t_attn(attn_plain, *qkv) * 1e3 * len(padded)
+
+# block-skip counter: the forward kernel's exact skip predicate run as its
+# own Pallas kernel (interpret mode here; Mosaic on TPU) over every packed
+# row. Causal-dense would visit nq*(nq+1)/2 K blocks per row.
+bq = bk = 32
+seg_all = np.concatenate([b["segment_ids"] for b in packed])
+cnt = np.asarray(segment_block_visit_counts(seg_all, bq, bk, causal=True))
+nq = S // bq
+dense_visits = seg_all.shape[0] * nq * (nq + 1) // 2
+visited = int(cnt.sum())
+# expected fraction ~ sum_i len_i^2 / S^2 per row (block granularity rounds
+# up); compute from the actual per-row segment runs incl. the pad tail
+sum_len2 = 0
+for row in seg_all:
+    _, runs = np.unique(row, return_counts=True)
+    sum_len2 += int((runs.astype(np.int64) ** 2).sum())
+expected_frac = sum_len2 / (seg_all.shape[0] * S * S)
+
+speedup = t_padded / t_packed
+out = {
+    "documents": len(docs), "seq_len": S, "batch_rows": B,
+    "real_tokens": real_tokens,
+    "padding_frac_padded": round(stats["padding_frac_padded"], 3),
+    "padding_frac_packed": round(stats["padding_frac_packed"], 3),
+    "row_compression": round(stats["row_compression"], 3),
+    "steps_packed": len(packed), "steps_padded": len(padded),
+    "tokens_per_sec_packed": round(real_tokens / t_packed, 1),
+    "tokens_per_sec_padded": round(real_tokens / t_padded, 1),
+    "speedup_packed_vs_padded": round(speedup, 3),
+    # the acceptance bar: recover at least the padding fraction
+    "speedup_ok": bool(speedup >= 1.0 + stats["padding_frac_padded"]),
+    "attention_ms_packed_corpus": round(attn_ms_packed, 1),
+    "attention_ms_padded_corpus": round(attn_ms_padded, 1),
+    "block_q": bq, "block_k": bk,
+    "kblocks_visited": visited, "kblocks_causal_dense": int(dense_visits),
+    "block_visit_frac_vs_causal_dense": round(visited / dense_visits, 3),
+    "block_visit_frac_expected_sum_len2": round(expected_frac, 3),
+    "blocks_skipped_under_packing": bool(visited < dense_visits),
+}
+print("PACK_JSON " + json.dumps(out))
+"""
+
+
+def _packing_probe():
+    """Sequence-packing probe on CPU: real-tokens/sec packed vs padded on a
+    skewed corpus (the padded arm burns its padding fraction), plus the
+    segment kernel's block-visit counter proving whole K blocks are skipped
+    under packing."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        res = subprocess.run([sys.executable, "-c", PACKING_PROBE],
+                             capture_output=True, text=True, timeout=420, env=env)
+        for line in res.stdout.splitlines():
+            if line.startswith("PACK_JSON "):
+                return json.loads(line[len("PACK_JSON "):])
+        print(f"packing probe produced no result; stderr tail:\n"
+              f"{res.stderr[-800:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"packing probe failed: {e!r}", file=sys.stderr)
+    return None
 
 
 def _input_pipeline_probe():
@@ -669,15 +823,19 @@ def main():
 
     pipe = _pipeline_overhead()
     input_pipe = _input_pipeline_probe()
+    packing = _packing_probe()
     # fixed-geometry 8-layer probe: compile-time O(1)-in-depth + remat-policy
     # memory lever, comparable across rounds on any platform. The measured
     # bench arms are attached UNCONDITIONALLY: a probe failure must not
     # discard minutes of real TPU measurements.
-    arms = {"main": main_m, "remat_full": remat_m, "scan": scan_m}
+    arms = {"main": main_m, "remat_full": remat_m, "scan": scan_m,
+            "embed_head": head_m, "embed_head_unfused": head_m_unfused}
     scan_remat = _scan_remat_probe() or {}
+    # every measured arm records its normalized throughput: the BENCH_*
+    # trajectory needs a tokens_per_sec series per arm to compare PRs
     scan_remat["bench_arms"] = {
         name: {k: m[k] for k in ("compile_ms", "peak_hbm_bytes",
-                                 "hlo_bytes", "step_s")}
+                                 "hlo_bytes", "step_s", "tokens_per_sec")}
         for name, m in arms.items() if m is not None}
 
     print(json.dumps({
@@ -694,10 +852,12 @@ def main():
                    "full_logits_live": main_m["full_logits_live"],
                    "compile_ms": main_m["compile_ms"],
                    "peak_hbm_bytes": main_m["peak_hbm_bytes"],
+                   "tokens_per_sec": round(main_m["tokens_per_sec"], 2),
                    "projection_7b": projection,
                    "scan_remat": scan_remat,
                    "pipeline": pipe,
-                   "input_pipeline": input_pipe},
+                   "input_pipeline": input_pipe,
+                   "packing": packing},
     }))
 
 

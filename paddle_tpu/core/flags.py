@@ -96,6 +96,10 @@ define_flag("flash_block_q", 0, "flash-attention Q tile override (0 = auto-tuned
 define_flag("flash_block_k", 0, "flash-attention K tile override (0 = auto-tuned default)", type=int)
 define_flag("flash_bwd_block_q", 0, "flash-attention BACKWARD Q tile override (0 = same as forward)", type=int)
 define_flag("flash_bwd_block_k", 0, "flash-attention BACKWARD K tile override (0 = same as forward)", type=int)
+define_flag("flash_segment_block_skip", True,
+            "segment-aware flash attention: skip whole K blocks whose "
+            "segment-id range cannot intersect the Q block's (packed "
+            "sequences; escape hatch: set False to mask in-block only)")
 define_flag("use_fused_cross_entropy", True,
             "chunked fused softmax-CE fast path in F.cross_entropy (escape hatch: set False)")
 define_flag("use_fused_head_loss", True,

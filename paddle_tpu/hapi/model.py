@@ -187,6 +187,22 @@ class LRScheduler(Callback):
             s.step()
 
 
+def _split_batch(batch):
+    """(inputs, label) from a loader batch. Dict (packed-loader) batches are
+    rejected explicitly: hapi's positional train_batch cannot route named
+    leaves — feed packed batches to CompiledTrainStep directly, which has
+    the named-batch protocol (docs/sequence_packing.md)."""
+    if isinstance(batch, dict):
+        raise ValueError(
+            "Model.fit/evaluate does not consume dict batches (e.g. the "
+            "packed format pack_examples emits: "
+            f"{sorted(batch)}); pass packed batches to CompiledTrainStep "
+            "directly — see docs/sequence_packing.md")
+    if isinstance(batch, (tuple, list)):
+        return batch[:-1], batch[-1]
+    return batch, None
+
+
 class Model:
     """reference: hapi/model.py:1052."""
 
@@ -352,7 +368,7 @@ class Model:
             last_loss = None
             try:
                 for step, batch in enumerate(source):
-                    data, label = (batch[:-1], batch[-1]) if isinstance(batch, (tuple, list)) else (batch, None)
+                    data, label = _split_batch(batch)
                     sync = (k_sync <= 1) or ((step + 1) % k_sync == 0)
                     logs = self.train_batch(list(data), label,
                                             fetch=not use_async or sync)
@@ -405,7 +421,7 @@ class Model:
             m.reset()
         losses = []
         for batch in loader:
-            data, label = (batch[:-1], batch[-1]) if isinstance(batch, (tuple, list)) else (batch, None)
+            data, label = _split_batch(batch)
             logs = self.eval_batch(list(data), label)
             losses.append(logs["loss"])
         out = {"loss": float(np.mean(losses)) if losses else 0.0}

@@ -23,6 +23,8 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.io.device_feed import (BatchSpecCache, DeviceFeeder,
                                        DispatchWindow, LossFuture,
                                        prefetch_to_device)
+from paddle_tpu.io.packing import (SequencePacker, pack_examples,
+                                   packing_stats, pad_examples, unpack_batch)
 from paddle_tpu.ops.random_state import default_generator
 
 __all__ = [
@@ -32,7 +34,8 @@ __all__ = [
     "SubsetRandomSampler", "BatchSampler", "DistributedBatchSampler",
     "DataLoader", "default_collate_fn", "get_worker_info",
     "DeviceFeeder", "prefetch_to_device", "BatchSpecCache", "DispatchWindow",
-    "LossFuture",
+    "LossFuture", "SequencePacker", "pack_examples", "pad_examples",
+    "packing_stats", "unpack_batch",
 ]
 
 

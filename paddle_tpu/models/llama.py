@@ -94,12 +94,14 @@ def _tag_residual(x):
 
 
 def apply_rotary(q, k, cos, sin):
-    """q,k: [B,S,H,D] arrays; cos/sin: [S, D/2]. Interleaved-pair rotation."""
+    """q,k: [B,S,H,D] arrays; cos/sin: [S, D/2] (shared row positions) or
+    [B, S, D/2] (per-row positions, e.g. gathered by a packed batch's
+    position ids). Interleaved-pair rotation."""
+    c = cos[None, :, None, :] if cos.ndim == 2 else cos[:, :, None, :]
+    s = sin[None, :, None, :] if sin.ndim == 2 else sin[:, :, None, :]
 
     def rot(x):
         x1, x2 = jnp.split(x, 2, axis=-1)
-        c = cos[None, :, None, :]
-        s = sin[None, :, None, :]
         return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
     return rot(q), rot(k)
@@ -121,11 +123,26 @@ class LlamaAttention(nn.Layer):
         self._rope_geom = (self.head_dim, config.max_position_embeddings,
                           config.rope_theta)
 
-    def forward(self, x, attn_mask=None, rope=None):
+    def forward(self, x, attn_mask=None, rope=None, segment_ids=None,
+                position_ids=None):
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, -1, self.head_dim])
         k = self.k_proj(x).reshape([b, s, -1, self.head_dim])
         v = self.v_proj(x).reshape([b, s, -1, self.head_dim])
+
+        # packed-sequence metadata: explicit kwargs win; otherwise the
+        # pipelined runtimes publish the current microbatch's ids in the
+        # segment context (paddle_tpu.parallel.segments)
+        if segment_ids is None and position_ids is None:
+            from paddle_tpu.parallel.segments import current_segment_ctx
+
+            ctx = current_segment_ctx()
+            if ctx is not None:
+                segment_ids, position_ids = ctx.segment_ids, ctx.position_ids
+        segment_ids = (segment_ids._value if isinstance(segment_ids, Tensor)
+                       else segment_ids)
+        position_ids = (position_ids._value if isinstance(position_ids, Tensor)
+                        else position_ids)
 
         # rope: (cos, sin) handed down by LlamaModel (one shared buffer pair
         # for the whole stack); standalone use falls back to the process-wide
@@ -135,8 +152,14 @@ class LlamaAttention(nn.Layer):
         cos, sin = (r._value if isinstance(r, Tensor) else r for r in rope)
 
         def rope_fn(qv, kv_, c, sn):
-            c = c[:s].astype(qv.dtype)
-            sn = sn[:s].astype(qv.dtype)
+            if position_ids is not None:
+                # per-row positions (restarting at 0 per packed document):
+                # index the shared tables by position id, [B, S, D/2]
+                c = c[position_ids].astype(qv.dtype)
+                sn = sn[position_ids].astype(qv.dtype)
+            else:
+                c = c[:s].astype(qv.dtype)
+                sn = sn[:s].astype(qv.dtype)
             return apply_rotary(qv, kv_, c, sn)
 
         q, k = apply_op(rope_fn, q, k, cos, sin, name="rope", n_outputs=2)
@@ -144,7 +167,8 @@ class LlamaAttention(nn.Layer):
         # GQA goes through natively: both the Pallas kernel and the XLA
         # fallback consume [B,S,Hkv,D] K/V without materializing repeats
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True,
-                                             training=self.training)
+                                             training=self.training,
+                                             segment_ids=segment_ids)
         out = out.reshape([b, s, -1])
         return self.o_proj(out)
 
@@ -169,9 +193,12 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, attn_mask=None, rope=None):
+    def forward(self, x, attn_mask=None, rope=None, segment_ids=None,
+                position_ids=None):
         x = _tag_residual(x + self.self_attn(self.input_layernorm(x),
-                                             attn_mask, rope=rope))
+                                             attn_mask, rope=rope,
+                                             segment_ids=segment_ids,
+                                             position_ids=position_ids))
         x = _tag_residual(x + self.mlp(self.post_attention_layernorm(x)))
         return x
 
@@ -201,12 +228,13 @@ class LlamaModel(nn.Layer):
         """The homogeneous decoder stack, for scan-over-layers packing."""
         return list(self.layers)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, segment_ids=None,
+                position_ids=None):
         x = self.embed_tokens(input_ids)
-        x = self._run_layers(x, attn_mask)
+        x = self._run_layers(x, attn_mask, segment_ids, position_ids)
         return self.norm(x)
 
-    def _run_layers(self, x, attn_mask):
+    def _run_layers(self, x, attn_mask, segment_ids=None, position_ids=None):
         """Apply the decoder stack: unrolled python loop, or ONE lax.scan
         over layer-stacked params, with the active selective-remat policy
         applied PER LAYER (embed/norm/head never sit in a remat region)."""
@@ -220,14 +248,22 @@ class LlamaModel(nn.Layer):
         ctx = current_layer_ctx()
         policy = ctx.policy if ctx is not None else flag("remat_policy")
         stacked = ctx.stacked if ctx is not None else None
-        kwargs = {"attn_mask": attn_mask, "rope": rope}
+        # packed-batch metadata rides the layer kwargs (layer-invariant, so
+        # the scan path broadcasts ONE copy to every scanned layer)
+        seg = (segment_ids._value if isinstance(segment_ids, Tensor)
+               else segment_ids)
+        pos = (position_ids._value if isinstance(position_ids, Tensor)
+               else position_ids)
+        kwargs = {"attn_mask": attn_mask, "rope": rope,
+                  "segment_ids": seg, "position_ids": pos}
         use_scan = stacked is not None or (
             len(layers) > 1 and (self.config.scan_layers
                                  or flag("scan_layers")))
         if not use_scan:
             if policy == "none":
                 for layer in layers:
-                    x = layer(x, attn_mask, rope=rope)
+                    x = layer(x, attn_mask, rope=rope, segment_ids=seg,
+                              position_ids=pos)
                 return x
             for layer in layers:
                 x = unrolled_layer_call(layer, x, kwargs=kwargs,
@@ -300,8 +336,10 @@ class LlamaForCausalLM(nn.Layer):
     def scan_group(self):
         return self.llama.scan_group()
 
-    def forward(self, input_ids, labels=None, attn_mask=None):
-        hidden = self.llama(input_ids, attn_mask)
+    def forward(self, input_ids, labels=None, attn_mask=None,
+                segment_ids=None, position_ids=None):
+        hidden = self.llama(input_ids, attn_mask, segment_ids=segment_ids,
+                            position_ids=position_ids)
         if labels is not None:
             from paddle_tpu.core.flags import flag
 

@@ -1207,30 +1207,87 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
 # attention
 # ---------------------------------------------------------------------------
 
+_NEG_BIAS = -1e30  # additive mask floor: composes (sums) without fp32
+                   # overflow, unlike finfo.min whose sum is -inf -> NaN
+
+_warned_pallas_blocks: set = set()
+
+
+def _warn_pallas_blocks_once(reason: str):
+    if reason not in _warned_pallas_blocks:
+        import warnings
+
+        _warned_pallas_blocks.add(reason)
+        warnings.warn(
+            f"Pallas flash attention disabled for this shape, using the XLA "
+            f"fallback: {reason}", stacklevel=3)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, training=True, name=None):
+                                 is_causal=False, training=True, name=None,
+                                 segment_ids=None):
     """reference: nn/functional/flash_attention.py:722 scaled_dot_product_attention.
 
     Layout: [batch, seq, heads, head_dim] (paddle flash-attention convention).
     Uses the Pallas flash-attention kernel on TPU when enabled+applicable,
     else an XLA fallback (fused by the compiler; memory O(S^2) only at trace).
+
+    segment_ids ([batch, seq] int32, sequence packing): attention becomes
+    block-diagonal per packed document — position i attends to j only when
+    segment_ids[b, i] == segment_ids[b, j] (composed with the causal and
+    explicit masks). The Pallas kernel additionally SKIPS whole K blocks no
+    segment of the Q block touches; the XLA fallback applies the equivalent
+    dense mask so both paths compute the same math.
+
+    Masks COMPOSE: an explicit `attn_mask` together with `is_causal=True`
+    (and/or `segment_ids`) applies all of them — boolean masks and the
+    causal/segment constraints become additive -1e30 biases, float masks add
+    through unchanged, so no combination overflows to -inf/NaN.
     """
     if flag("use_pallas_attention") and dropout_p == 0.0 and attn_mask is None:
         try:
-            from paddle_tpu.ops.pallas.flash_attention import _on_tpu, flash_attention_bshd
-
-            if _on_tpu():
-                q, k, v = _t(query), _t(key), _t(value)
-                return apply_op(
-                    lambda a, b, c: flash_attention_bshd(a, b, c, causal=is_causal),
-                    q, k, v, name="flash_attention",
-                )
+            # guarded: a jax install without a working pallas import must
+            # degrade to the XLA path, not break every attention call
+            from paddle_tpu.ops.pallas.flash_attention import (
+                _on_tpu, flash_attention_bshd, interpret_forced,
+                pallas_blocks_ok)
+            pallas_route = _on_tpu() or interpret_forced()
         except Exception:
-            pass  # fall back to XLA path below
+            pallas_route = False
+        if pallas_route:
+            ok, reason = pallas_blocks_ok(int(_t(query).shape[1]))
+            if not ok:
+                # a bad FLAGS_flash_block_q/k override must not fail inside
+                # the kernel launch: warn once, run the XLA path below
+                _warn_pallas_blocks_once(reason)
+            else:
+                try:
+                    q, k, v = _t(query), _t(key), _t(value)
+                    args = [q, k, v]
+                    if segment_ids is not None:
+                        args.append(_t(segment_ids))
 
-    def f(q, k, v, *m):
+                    def fa(a, b, c, *s):
+                        return flash_attention_bshd(
+                            a, b, c, causal=is_causal,
+                            segment_ids=s[0] if s else None)
+
+                    return apply_op(fa, *args, name="flash_attention")
+                except Exception:
+                    if interpret_forced():
+                        # the tests' force_interpret() route exists to
+                        # exercise the kernel: swallowing a kernel failure
+                        # here would silently downgrade the parity tests
+                        # to XLA-vs-XLA
+                        raise
+                    pass  # fall back to XLA path below
+
+    def f(q, k, v, *extra):
         # [B,S,H,D] -> [B,H,S,D]; GQA (fewer kv heads) via grouped einsum —
         # the shared K/V heads are never materialized per query head
+        it = iter(extra)
+        m = next(it) if attn_mask is not None else None
+        seg = next(it) if segment_ids is not None else None
         qh = jnp.swapaxes(q, 1, 2)
         kh = jnp.swapaxes(k, 1, 2)
         vh = jnp.swapaxes(v, 1, 2)
@@ -1241,25 +1298,40 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                 f"q heads must be a multiple of kv heads, got {hq} and {hkv}")
         g = hq // hkv
         qg = qh.reshape(b, hkv, g, s_len, d)
-        scores = jnp.einsum("bhgsd,bhtd->bhgst", qg, kh) / math.sqrt(q.shape[-1])
-        if is_causal:
-            s, t = scores.shape[-2], scores.shape[-1]
-            causal = jnp.tril(jnp.ones((s, t), bool))
-            scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
-        if m:
-            mask = jnp.broadcast_to(m[0], (b, hq, s_len, scores.shape[-1]))
-            mask = mask.reshape(b, hkv, g, s_len, -1)
+        scores = jnp.einsum("bhgsd,bhtd->bhgst", qg, kh).astype(
+            jnp.float32) / math.sqrt(q.shape[-1])
+        t_len = scores.shape[-1]
+        # masks COMPOSE in two tiers: HARD masks (bool attn_mask, causal,
+        # segment) combine into one validity boolean; a SOFT (float)
+        # attn_mask adds through, clamped to -1e30 so a finfo.min-style
+        # user mask neither overflows to -inf/NaN nor outranks a hard mask
+        # (hard-masked scores sit strictly below every soft-masked one).
+        valid = None
+        if m is not None:
+            mask = jnp.broadcast_to(m, (b, hq, s_len, t_len))
+            mask = mask.reshape(b, hkv, g, s_len, t_len)
             if mask.dtype == jnp.bool_:
-                scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+                valid = mask
             else:
-                scores = scores + mask
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+                scores = scores + jnp.maximum(
+                    mask.astype(jnp.float32), _NEG_BIAS)
+        if is_causal:
+            causal = jnp.tril(jnp.ones((s_len, t_len), bool))
+            valid = causal if valid is None else valid & causal
+        if seg is not None:
+            same = seg[:, None, None, :, None] == seg[:, None, None, None, :]
+            valid = same if valid is None else valid & same
+        if valid is not None:
+            scores = jnp.where(valid, scores, 2.0 * _NEG_BIAS)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bhgst,bhtd->bhgsd", probs, vh).reshape(b, hq, s_len, d)
         return jnp.swapaxes(out, 1, 2)
 
     args = [_t(query), _t(key), _t(value)]
     if attn_mask is not None:
         args.append(_t(attn_mask))
+    if segment_ids is not None:
+        args.append(_t(segment_ids))
     out = apply_op(f, *args, name="sdpa")
     if dropout_p > 0.0 and training:
         out = dropout(out, dropout_p, training=training)

@@ -11,3 +11,6 @@ from paddle_tpu.parallel.zero_bubble import ZBH1PipelinedStep  # noqa: F401
 from paddle_tpu.parallel.scan_layers import (  # noqa: F401
     REMAT_POLICIES, normalize_remat, remat_wrap, scan_layer_stack,
 )
+from paddle_tpu.parallel.segments import (  # noqa: F401
+    current_segment_ctx, segment_execution,
+)

@@ -275,13 +275,21 @@ class PipelinedTrainStep:
         h, _ = jax.lax.scan(block_fn, x, stage_params_local)
         return h
 
-    def _pipeline_loss(self, stacked_blocks_local, embed_out_mb, key):
+    def _pipeline_loss(self, stacked_blocks_local, embed_out_mb, key,
+                       extras_mb=None):
         """Runs per-rank inside shard_map. embed_out_mb: [M, mb, S_seq, H] local.
 
         The tick loop runs ONLY decoder blocks; finished microbatches are
         collected into a buffer and returned ([1, M, mb, ...] per rank, stacked
         over 'pp' outside) — the vocab head+loss run in a separate pp-sharded
-        region (_head_loss_pp), so no rank ever computes a head it discards."""
+        region (_head_loss_pp), so no rank ever computes a head it discards.
+
+        extras_mb: optional dict of per-microbatch [M, mb, ...] batch
+        metadata (segment_ids/position_ids of a packed batch). Each tick
+        publishes the PROCESSED microbatch's slice (index t - rank, the mb
+        this rank's stage is computing) through the segment context so
+        segment-aware blocks pick it up — the activation wire format never
+        changes, and blocks that ignore the context are untouched."""
         S = self.S
         M = self.M
         idx = jax.lax.axis_index("pp")
@@ -291,12 +299,25 @@ class PipelinedTrainStep:
         perm = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(carry, t):
+            from contextlib import nullcontext
+
+            from paddle_tpu.parallel.segments import segment_execution
+
             state, outbuf = carry
             mb_idx = t - idx
             inp = jnp.where(idx == 0,
                             embed_out_mb[jnp.clip(t, 0, M - 1)],
                             state)
-            out = self._stage_fn(stage_params, inp, jax.random.fold_in(key, t))
+            ctx = nullcontext()
+            if extras_mb:
+                j = jnp.clip(mb_idx, 0, M - 1)
+                cur = {k: jax.lax.dynamic_index_in_dim(v, j, 0, keepdims=False)
+                       for k, v in extras_mb.items()}
+                ctx = segment_execution(cur.get("segment_ids"),
+                                        cur.get("position_ids"))
+            with ctx:
+                out = self._stage_fn(stage_params, inp,
+                                     jax.random.fold_in(key, t))
             # collect the microbatch exiting the last stage this tick
             valid = (mb_idx >= 0) & (mb_idx < M) & (idx == S - 1)
             j = jnp.clip(mb_idx, 0, M - 1)
@@ -416,7 +437,8 @@ class PipelinedTrainStep:
         return outbuf[None]
 
     # -- whole step -----------------------------------------------------------
-    def _loss_of(self, embed_vals, stacked_blocks, head_vals, ids, labels, key):
+    def _loss_of(self, embed_vals, stacked_blocks, head_vals, ids, labels, key,
+                 extras=None):
         mesh = self.mesh
         # embedding outside the pipeline region (GSPMD-sharded over dp/mp)
         emb_out = functional_call(self.embed, embed_vals, (ids,))
@@ -435,16 +457,29 @@ class PipelinedTrainStep:
         # per-rank outbuf slices stacked over 'pp' -> [S, M, mb, ...] global
         out_spec = PartitionSpec("pp", None, dp if dp else None,
                                  *([None] * (x.ndim - 1)))
-        body = self._pipeline_loss if self.V == 1 else self._pipeline_loss_vpp
-        fn = _shard_map(body, mesh, in_specs, out_spec)
-        stacked_out = fn(tuple(stacked_blocks), x_mb, key)
+        if extras:
+            # packed-batch metadata, microbatched like labels and replicated
+            # over 'pp' (every stage needs the mb it currently processes)
+            ex_mb = {k: v.reshape((self.M, mb) + v.shape[1:])
+                     for k, v in extras.items()}
+            in_specs = in_specs + (
+                PartitionSpec(None, dp if dp else None, None), )
+            fn = _shard_map(
+                lambda sb, xm, k, ex: self._pipeline_loss(sb, xm, k, ex),
+                mesh, in_specs, out_spec)
+            stacked_out = fn(tuple(stacked_blocks), x_mb, key, ex_mb)
+        else:
+            body = (self._pipeline_loss if self.V == 1
+                    else self._pipeline_loss_vpp)
+            fn = _shard_map(body, mesh, in_specs, out_spec)
+            stacked_out = fn(tuple(stacked_blocks), x_mb, key)
         # only the last stage's buffer is real; head+loss run pp-sharded
         return self._head_loss_pp(stacked_out[self.S - 1], lab_mb, head_vals)
 
     def _step_fn(self, embed_vals, stacked_blocks, head_vals, opt_states, ids, labels,
-                 key, lr, step_i):
+                 key, lr, step_i, extras=None):
         def loss_fn(ev, sb, hv):
-            return self._loss_of(ev, sb, hv, ids, labels, key)
+            return self._loss_of(ev, sb, hv, ids, labels, key, extras)
 
         loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
             embed_vals, stacked_blocks, head_vals
@@ -462,9 +497,25 @@ class PipelinedTrainStep:
         nb = len(stacked_blocks)
         return (loss, new_p[:ne], new_p[ne:ne + nb], new_p[ne + nb:], new_s)
 
-    def __call__(self, ids, labels):
+    def __call__(self, ids, labels, *, segment_ids=None, position_ids=None):
+        """ids/labels (+ optional KEYWORD-ONLY packed-batch
+        segment_ids/position_ids, all
+        [M*mb, seq]-leading): the extra leaves are microbatched alongside
+        labels and delivered to each stage's blocks through the segment
+        context — same jit cache, no per-step retracing (the cache key is
+        the dp layout; the extras' presence is part of the traced structure
+        and stable across a run)."""
+        extras = {k: v for k, v in (("segment_ids", segment_ids),
+                                    ("position_ids", position_ids))
+                  if v is not None}
+        if extras and self.V > 1:
+            raise ValueError(
+                "interleaved virtual-pp does not support packed-batch "
+                "segment/position ids yet; use virtual_pp=1 (1F1B)")
         iv = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
         lv = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+        extras = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                  for k, v in extras.items()}
         # per-batch: replicate data when microbatch rows don't divide the data
         # axes (e.g. a trailing partial batch) without disabling dp for good
         eff_dp = self._dp_axes0
@@ -472,32 +523,33 @@ class PipelinedTrainStep:
             div = int(np.prod([self.mesh.shape[a] for a in eff_dp]))
             if iv.shape[0] % self.M or (iv.shape[0] // self.M) % div:
                 eff_dp = ()
-        if eff_dp != self._dp_axes or self._jitted is None:
-            self._dp_axes = eff_dp
-            self._jitted = self._jit_cache.get(eff_dp)
-            if self._jitted is None:
-                self._jitted = jax.jit(self._step_fn, donate_argnums=(0, 1, 2, 3))
-                self._jit_cache[eff_dp] = self._jitted
+        cache_key = (eff_dp, tuple(sorted(extras)))
+        self._dp_axes = eff_dp
+        self._jitted = self._jit_cache.get(cache_key)
+        if self._jitted is None:
+            self._jitted = jax.jit(self._step_fn, donate_argnums=(0, 1, 2, 3))
+            self._jit_cache[cache_key] = self._jitted
         dp = self._dp_axes
         bshard = self._bshard_cache.get(dp)
         if bshard is None:
             bshard = NamedSharding(self.mesh, PartitionSpec(dp if dp else None))
             self._bshard_cache[dp] = bshard
-        placed = []
-        for v in (iv, lv):
+
+        def place(v):
             if (isinstance(v, jax.Array) and getattr(v, "committed", False)
                     and v.sharding == bshard):
-                placed.append(v)  # pre-placed (DeviceFeeder) fast path
-            else:
-                placed.append(jax.device_put(v, bshard))
-                self.h2d_transfers += 1
-        iv, lv = placed
+                return v  # pre-placed (DeviceFeeder) fast path
+            self.h2d_transfers += 1
+            return jax.device_put(v, bshard)
+
+        iv, lv = place(iv), place(lv)
+        extras = {k: place(v) for k, v in extras.items()} or None
         self._step_i += 1
         self._key, sub = jax.random.split(self._key)
         lr = jnp.asarray(self.optimizer.get_lr() if self.optimizer else 0.0, jnp.float32)
         out = self._jitted(self._embed_vals, self._stacked_blocks, self._head_vals,
                            self._opt_states, iv, lv, sub, lr,
-                           jnp.asarray(self._step_i, jnp.int32))
+                           jnp.asarray(self._step_i, jnp.int32), extras)
         loss, self._embed_vals, self._stacked_blocks, self._head_vals, self._opt_states = out
         self._window.admit(loss)  # bound async run-ahead (~2 steps in flight)
         return Tensor(loss)
@@ -507,11 +559,12 @@ class PipelinedTrainStep:
         """Input layout for DeviceFeeder: batch dim over the data axes."""
         return PartitionSpec(self._dp_axes0 if self._dp_axes0 else None)
 
-    def step_async(self, ids, labels):
+    def step_async(self, ids, labels, *, segment_ids=None, position_ids=None):
         """Dispatch one step, return a deferred-read LossFuture."""
         from paddle_tpu.io.device_feed import LossFuture
 
-        return LossFuture(self(ids, labels))
+        return LossFuture(self(ids, labels, segment_ids=segment_ids,
+                               position_ids=position_ids))
 
     def drain(self):
         self._window.drain()

@@ -1,0 +1,60 @@
+"""Batch-context delivery of packed-sequence metadata to layers.
+
+The pipelined runtimes (1F1B `PipelinedTrainStep`, ZB-H1
+`ZBH1PipelinedStep`) move only the hidden-state activation between stages;
+per-token batch metadata — the `segment_ids`/`position_ids` a packed batch
+carries — cannot ride the activation without changing every stage's wire
+format. Instead the runtimes publish the CURRENT microbatch's metadata in a
+thread-local context for the duration of each stage call, and segment-aware
+layers (e.g. `LlamaAttention`) read it when their explicit
+`segment_ids`/`position_ids` kwargs are None.
+
+This mirrors the scan/remat cooperation protocol
+(`paddle_tpu.parallel.scan_layers.layer_execution`): tracing is ordinary
+Python execution, so a context set around a `functional_call` is visible to
+every layer the call traces, and the traced values are captured into the
+program like any other closure tracer. Layers that ignore the context are
+untouched — publishing metadata to an MLP block is a no-op.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+__all__ = ["segment_execution", "current_segment_ctx", "SegmentContext"]
+
+
+class SegmentContext:
+    """segment_ids / position_ids of the microbatch currently being traced
+    ([mb, S] arrays, or None for the unpacked case)."""
+
+    __slots__ = ("segment_ids", "position_ids")
+
+    def __init__(self, segment_ids=None, position_ids=None):
+        self.segment_ids = segment_ids
+        self.position_ids = position_ids
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.ctx = None
+
+
+_tls = _TLS()
+
+
+def current_segment_ctx() -> SegmentContext | None:
+    return _tls.ctx
+
+
+@contextmanager
+def segment_execution(segment_ids=None, position_ids=None):
+    """Publish packed-batch metadata to the layers traced inside the block.
+    A no-op context (both None) still masks any outer one, so nested stages
+    never leak another microbatch's ids."""
+    prev = _tls.ctx
+    _tls.ctx = SegmentContext(segment_ids, position_ids)
+    try:
+        yield _tls.ctx
+    finally:
+        _tls.ctx = prev

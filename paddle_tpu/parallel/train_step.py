@@ -192,9 +192,11 @@ def functional_call(model, params_vals: Sequence, args, kwargs=None, training=Tr
         for p, v in zip(params, params_vals):
             p._set_value(v)
         t_args = [Tensor(a) if isinstance(a, jax.Array) else a for a in args]
+        t_kwargs = {k: Tensor(v) if isinstance(v, jax.Array) else v
+                    for k, v in kwargs.items()}
         fn = getattr(model, method) if method else model
         with _tape.no_grad():
-            out = fn(*t_args, **kwargs)
+            out = fn(*t_args, **t_kwargs)
         return out
     finally:
         for p, v in zip(params, old):
@@ -438,9 +440,21 @@ class CompiledTrainStep:
         fleet_rng._tls.active_key_fn = next_key
         try:
             with layer_execution(policy, stacked):
-                out = functional_call(self.model, param_vals[:n_outer],
-                                      batch[:-1], params=self._outer_params)
-            label = Tensor(batch[-1])
+                if isinstance(batch, dict):
+                    # named-batch protocol (packed batches: input_ids /
+                    # labels / segment_ids / position_ids / ...): EVERY leaf
+                    # is a model kwarg — labels included, so fused-head
+                    # models compute the loss in-model — and `labels` also
+                    # feeds loss_fn, preserving the (out, label) contract
+                    out = functional_call(self.model, param_vals[:n_outer],
+                                          (), kwargs=dict(batch),
+                                          params=self._outer_params)
+                    label = Tensor(batch["labels"])
+                else:
+                    out = functional_call(self.model, param_vals[:n_outer],
+                                          batch[:-1],
+                                          params=self._outer_params)
+                    label = Tensor(batch[-1])
             loss = self.loss_fn(out, label)
             return loss._value
         finally:
@@ -506,7 +520,13 @@ class CompiledTrainStep:
 
     # -- public --------------------------------------------------------------
     def __call__(self, *batch):
-        """batch: (*inputs, label) as Tensors/arrays. Returns the loss as an
+        """batch: (*inputs, label) as Tensors/arrays, OR one dict (the
+        named-batch protocol a packed loader emits: every entry becomes a
+        model kwarg — `labels` is required and also feeds loss_fn). Extra
+        leaves like segment_ids/position_ids therefore ride along without
+        positional-order coupling, get the same cached trimmed shardings as
+        input_ids, and never retrace the step (the jit key is the batch
+        pytree structure, stable across steps). Returns the loss as an
         UN-FETCHED Tensor: reading it (float()) is the device->host sync, so
         callers control how often dispatch is broken (`metrics_every`).
         Pre-placed inputs (a DeviceFeeder batch) whose sharding already
@@ -515,8 +535,19 @@ class CompiledTrainStep:
 
         if self._jitted is None:
             self._build()
+        named = len(batch) == 1 and isinstance(batch[0], dict)
+        if named and "labels" not in batch[0]:
+            raise ValueError(
+                "a dict batch must carry a 'labels' entry (it feeds both "
+                f"the model and loss_fn); got keys {sorted(batch[0])}")
         with RecordEvent("CompiledTrainStep::place"):
-            vals, moved = self._spec_cache.place(batch)
+            if named:
+                keys = sorted(batch[0])
+                flat, moved = self._spec_cache.place(
+                    [batch[0][k] for k in keys])
+                vals = dict(zip(keys, flat))
+            else:
+                vals, moved = self._spec_cache.place(batch)
             self.h2d_transfers += moved
         self._step_i += 1
         self._key, sub = jax.random.split(self._key)

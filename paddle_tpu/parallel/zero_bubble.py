@@ -321,13 +321,34 @@ class ZBH1PipelinedStep:
         bwd_perm = [(i, (i - 1) % S) for i in range(S)]
         f32 = jnp.float32
 
-        def body(stacked_local, embed_vals, head_vals, ids_mb, labels_mb):
+        from contextlib import nullcontext
+
+        from paddle_tpu.parallel.segments import segment_execution
+
+        def body(stacked_local, embed_vals, head_vals, ids_mb, labels_mb,
+                 extras):
             rank = jax.lax.axis_index("pp")
             stage_params = [a[0] for a in stacked_local]
             n_sp = len(stage_params)
             n_hv = len(head_vals)
             zero_act = jnp.zeros(mb_shape, f32)
             inv_m = jnp.asarray(1.0 / M, f32)
+            # packed-batch metadata ([M, mb, S] per leaf), delivered to the
+            # blocks through the segment context. Microbatch indices are
+            # STATIC schedule-table entries, so per-mb slices are static
+            # selects at F/embed/last-chain construction; the mid-stage
+            # residual stash carries the captured values to B/W replay.
+            seg_mb = extras.get("segment_ids") if extras else None
+            pos_mb = extras.get("position_ids") if extras else None
+            has_ex = seg_mb is not None or pos_mb is not None
+
+            def ex_ctx(seg, pos):
+                return (segment_execution(seg, pos) if has_ex
+                        else nullcontext())
+
+            def ex_of(m):
+                return (seg_mb[m] if seg_mb is not None else None,
+                        pos_mb[m] if pos_mb is not None else None)
 
             # ---- one-time backward construction (probe traces; ops that
             # feed only the probe residuals are DCE'd by XLA) -------------
@@ -358,7 +379,11 @@ class ZBH1PipelinedStep:
                         di += 1
                 return out
 
-            _, vjp_m = jax.vjp(mid_fn, stage_params, zero_act)
+            # the probe trace runs under microbatch-0's context so the
+            # captured-residual STRUCTURE (shapes incl. the int32 ids)
+            # matches every per-tick trace
+            with ex_ctx(*ex_of(0)):
+                _, vjp_m = jax.vjp(mid_fn, stage_params, zero_act)
             pure_m, cm_ex = jax.closure_convert(vjp_m, zero_act)
             cm_dyn_ex, cm_pmap = split_consts(cm_ex)
             cm_total = len(cm_ex)
@@ -368,8 +393,11 @@ class ZBH1PipelinedStep:
             bwd_m_b, bwd_m_w, cutm_avals = _split_bwd(closed_m, n_sp)
 
             def last_closed(m):
-                return lambda sp, hv, xx: self._last_chain(
-                    sp, hv, xx, labels_mb[m])
+                def fn(sp, hv, xx):
+                    with ex_ctx(*ex_of(m)):
+                        return self._last_chain(sp, hv, xx, labels_mb[m])
+
+                return fn
 
             zero_scalar = jnp.zeros((), f32)
             # built PER MICROBATCH at BODY level: closure_convert bakes
@@ -397,9 +425,13 @@ class ZBH1PipelinedStep:
                                 (a.shape, a.dtype) for a in cutl_avals]), \
                         "per-microbatch last-chain backward structure diverges"
 
-            def fwd_mid(x):
-                """Forward once; residuals extracted, zero recompute later."""
-                y, vjp = jax.vjp(mid_fn, stage_params, x)
+            def fwd_mid(x, ex=None):
+                """Forward once; residuals extracted, zero recompute later.
+                `ex`: this tick's (segment_ids, position_ids) selection —
+                captured into the stashed residuals, so B/W replay the
+                right microbatch's masks without retracing the blocks."""
+                with (ex_ctx(*ex) if ex is not None else nullcontext()):
+                    y, vjp = jax.vjp(mid_fn, stage_params, x)
                 _, consts = jax.closure_convert(vjp, zero_act)
                 dyn, pmap = split_consts(consts)
                 assert ([(c.shape, c.dtype) for c in dyn] == cm_shapes
@@ -460,7 +492,8 @@ class ZBH1PipelinedStep:
                     def x_of(r):
                         m = mb[t, r]
                         if r == 0:
-                            return self._embed_fwd(embed_vals, ids_mb[m])
+                            with ex_ctx(*ex_of(m)):
+                                return self._embed_fwd(embed_vals, ids_mb[m])
                         return fwd_recv[f_tick[r - 1][m]]
 
                     x_f = chain(F_rs, x_of)
@@ -523,7 +556,18 @@ class ZBH1PipelinedStep:
                         out.extend(ge if ge is not None else acc_ge)
                     return tuple(out)
 
-                def f_branch(t=t, x_f=x_f, mids_f=mids_f, last_f=last_f):
+                # this tick's extras for the MID ranks running F: the same
+                # where-chain the activation selection uses, so the context
+                # value at each rank belongs to the microbatch it processes
+                ex_sel = None
+                if has_ex and mids_f:
+                    ex_sel = tuple(
+                        (chain(mids_f, lambda r, tab=tab: tab[mb[t, r]])
+                         if tab is not None else None)
+                        for tab in (seg_mb, pos_mb))
+
+                def f_branch(t=t, x_f=x_f, mids_f=mids_f, last_f=last_f,
+                             ex_sel=ex_sel):
                     m_last = mb[t, S - 1]
                     if mids_f and last_f:
                         def arm_last(xx):
@@ -531,7 +575,7 @@ class ZBH1PipelinedStep:
                             return (zero_act, zeros_cm, cl, lossv)
 
                         def arm_mid(xx):
-                            y, cm = fwd_mid(xx)
+                            y, cm = fwd_mid(xx, ex_sel)
                             return (y, cm, zeros_cl, jnp.zeros((), f32))
 
                         y, cm, cl, lossv = jax.lax.cond(
@@ -540,7 +584,7 @@ class ZBH1PipelinedStep:
                     if last_f:
                         lossv, cl = fwd_last(x_f, m_last)
                         return ret(cl=cl, lossv=lossv)
-                    y, cm = fwd_mid(x_f)
+                    y, cm = fwd_mid(x_f, ex_sel)
                     return ret(y=y, cm=cm)
 
                 def b_branch(t=t, dy_sel=dy_sel, cm_sel=cm_sel, cl_sel=cl_sel,
@@ -571,9 +615,10 @@ class ZBH1PipelinedStep:
                         m0 = mb[t, 0]
 
                         def egrad(dxv):
-                            _, evjp = jax.vjp(
-                                lambda ev: self._embed_fwd(ev, ids_mb[m0]),
-                                embed_vals)
+                            with ex_ctx(*ex_of(m0)):
+                                _, evjp = jax.vjp(
+                                    lambda ev: self._embed_fwd(ev, ids_mb[m0]),
+                                    embed_vals)
                             (g,) = evjp(dxv)
                             return [a + b for a, b in zip(acc_ge, g)]
 
@@ -689,6 +734,7 @@ class ZBH1PipelinedStep:
             tuple(PartitionSpec() for _ in self._head_vals),
             PartitionSpec(),
             PartitionSpec(),
+            PartitionSpec(),  # packed-batch extras dict (replicated leaves)
         )
         out_specs = (
             PartitionSpec(),
@@ -700,49 +746,60 @@ class ZBH1PipelinedStep:
             # single prefix spec covers every debug leaf (leading dim -> pp)
             out_specs = out_specs + (PartitionSpec("pp"),)
         smapped = _shard_map(
-            lambda bl, ev, hv, i, l: body(bl, ev, hv, i, l),
+            lambda bl, ev, hv, i, l, ex: body(bl, ev, hv, i, l, ex),
             self.mesh, in_specs, out_specs)
         self._jitted = jax.jit(smapped)
 
-    def run(self, ids, labels):
-        """ids/labels: [M*mb, seq] numpy/jnp arrays. Inputs are placed
-        replicated over the mesh (ZB-H1 replicates the batch); an input
-        already committed to that sharding — a DeviceFeeder batch — skips
-        the device_put, and device-resident inputs never round-trip through
-        numpy (the microbatch reshape stays on device)."""
+    def run(self, ids, labels, *, segment_ids=None, position_ids=None):
+        """ids/labels (+ optional KEYWORD-ONLY packed-batch
+        segment_ids/position_ids):
+        [M*mb, seq] numpy/jnp arrays. Inputs are placed replicated over the
+        mesh (ZB-H1 replicates the batch); an input already committed to
+        that sharding — a DeviceFeeder batch — skips the device_put, and
+        device-resident inputs never round-trip through numpy (the
+        microbatch reshape stays on device). The extra leaves reach the
+        blocks through the segment context (see `_build`'s body): stashed
+        with the F-tick residuals, so B/W replay needs no recompute."""
         iv = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
         lv = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+        extras = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                  for k, v in (("segment_ids", segment_ids),
+                               ("position_ids", position_ids))
+                  if v is not None}
         repl = getattr(self, "_batch_sharding", None)
         if repl is None:
             repl = NamedSharding(self.mesh, PartitionSpec())
             self._batch_sharding = repl
-        placed = []
-        for v in (iv, lv):
+
+        def place(v):
             if (isinstance(v, jax.Array) and getattr(v, "committed", False)
                     and v.sharding == repl):
-                placed.append(v)  # pre-placed (DeviceFeeder) fast path
-            else:
-                placed.append(jax.device_put(v, repl))
-                self.h2d_transfers += 1
-        iv, lv = placed
+                return v  # pre-placed (DeviceFeeder) fast path
+            self.h2d_transfers += 1
+            return jax.device_put(v, repl)
+
+        iv, lv = place(iv), place(lv)
         mbs = iv.shape[0] // self.M
         ids_mb = iv.reshape((self.M, mbs) + iv.shape[1:])
         labels_mb = lv.reshape((self.M, mbs) + lv.shape[1:])
+        extras_mb = {k: place(v).reshape((self.M, mbs) + v.shape[1:])
+                     for k, v in extras.items()}
         if self._jitted is None:
             emb_probe = self._embed_fwd(self._embed_vals, ids_mb[0])
             self._build(tuple(emb_probe.shape), ids_mb.dtype)
         res = self._jitted(
             tuple(self._stacked_blocks), tuple(self._embed_vals),
-            tuple(self._head_vals), ids_mb, labels_mb)
+            tuple(self._head_vals), ids_mb, labels_mb, extras_mb)
         loss, g_stage, g_embed, g_head = res[:4]
         if getattr(self, "_debug", False):
             self._dbg_out = res[4]
         return loss, (list(g_embed), list(g_stage), list(g_head))
 
-    def __call__(self, ids, labels):
+    def __call__(self, ids, labels, *, segment_ids=None, position_ids=None):
         """Train step: ZB-H1 forward/backward + optimizer update (the Fleet
         train_batch contract, like PipelinedTrainStep)."""
-        loss, (g_embed, g_stage, g_head) = self.run(ids, labels)
+        loss, (g_embed, g_stage, g_head) = self.run(
+            ids, labels, segment_ids=segment_ids, position_ids=position_ids)
         if self.optimizer is None:
             self._window.admit(loss)
             return Tensor(loss)
@@ -777,11 +834,12 @@ class ZBH1PipelinedStep:
         self._window.admit(loss)  # bound async run-ahead
         return Tensor(loss)
 
-    def step_async(self, ids, labels):
+    def step_async(self, ids, labels, *, segment_ids=None, position_ids=None):
         """Dispatch one step, return a deferred-read LossFuture."""
         from paddle_tpu.io.device_feed import LossFuture
 
-        return LossFuture(self(ids, labels))
+        return LossFuture(self(ids, labels, segment_ids=segment_ids,
+                               position_ids=position_ids))
 
     def drain(self):
         self._window.drain()

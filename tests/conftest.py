@@ -83,6 +83,19 @@ def _thread_hygiene():
 
 
 @pytest.fixture
+def flash_interpret():
+    """Run the Pallas flash-attention kernels — including the segment-aware
+    forward/dq/dkv variants and the F.scaled_dot_product_attention fast
+    path — under interpret=True on CPU, so the tier-1 suite exercises the
+    SAME kernel code paths (online softmax, causal+segment masking, block
+    skipping) the TPU runs through Mosaic."""
+    from paddle_tpu.ops.pallas.flash_attention import force_interpret
+
+    with force_interpret():
+        yield
+
+
+@pytest.fixture
 def mesh8():
     """A pp2 x dp2 x mp2 mesh over the 8 virtual devices."""
     from paddle_tpu.distributed.mesh import build_mesh, set_mesh
