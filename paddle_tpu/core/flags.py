@@ -122,6 +122,12 @@ define_flag("metrics_sync_every", 1,
             "read the loss to host every k steps (1 = every step, the "
             "synchronous default; larger k keeps JAX async dispatch "
             "unbroken between reads)", type=int)
+define_flag("zero3_gather", "ahead",
+            "ZeRO-3 sharded-weights gather schedule in the scan layer loop: "
+            "'ahead' = double-buffered gather of layer k+1 while layer k "
+            "computes (comm/compute overlap, <=2 layers of full weights "
+            "live); 'start' = all-gather the whole stack up front (the "
+            "overlap-free baseline)")
 define_flag("remat_policy", "none",
             "default selective-rematerialization policy, consulted when a "
             "step is constructed with remat=None (the CompiledTrainStep "

@@ -1,0 +1,104 @@
+"""The `.pdmodel` deployment container: npz-style members + JSON metadata.
+
+Replaces the original pickle stream. A pickle artifact executes arbitrary
+code embedded in the file on `load` — the classic deserialization RCE — so
+serving it required trusting the file like source code. This container is
+data-only: a zip holding
+
+* ``meta.json``        — JSON metadata (format tag, exported class name,
+                         input shapes/dtypes, feed names, per-param
+                         shape/dtype table). Parsed with `json.loads`.
+* ``stablehlo.bin``    — the serialized `jax.export` program, raw bytes.
+                         Deserialization validates StableHLO; it is a
+                         program for the XLA runtime, not host Python.
+* ``param_NNNNN.bin``  — each parameter's raw little-endian array bytes,
+                         reshaped per the meta table. Never unpickled.
+
+Loaders REJECT legacy pickle artifacts with an error pointing at this
+format — re-export with `jit.save` / `save_inference_model`.
+"""
+from __future__ import annotations
+
+import json
+import zipfile
+
+import numpy as np
+
+__all__ = ["FORMAT_NAME", "write_artifact", "read_artifact", "np_dtype"]
+
+FORMAT_NAME = "paddle_tpu-npz1"
+
+_META = "meta.json"
+_PROGRAM = "stablehlo.bin"
+
+
+def np_dtype(s: str) -> np.dtype:
+    """Dtype-string -> numpy dtype, including the ml_dtypes smallfloats."""
+    if s in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, s))
+    return np.dtype(s)
+
+
+def _param_name(i: int) -> str:
+    return f"param_{i:05d}.bin"
+
+
+def write_artifact(path: str, blob: dict) -> None:
+    """Serialize a jit.save blob (stablehlo bytes + params + JSON-able
+    metadata) into the container. Metadata keys beyond 'stablehlo'/'params'
+    pass through meta.json verbatim (they must be JSON-serializable)."""
+    params = [np.asarray(p) for p in blob.get("params", [])]
+    meta = {k: v for k, v in blob.items() if k not in ("stablehlo", "params")}
+    meta["format"] = FORMAT_NAME
+    meta["param_table"] = [
+        {"shape": [int(d) for d in p.shape], "dtype": str(p.dtype)}
+        for p in params]
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as z:
+        z.writestr(_META, json.dumps(meta))
+        z.writestr(_PROGRAM, bytes(blob["stablehlo"]))
+        for i, p in enumerate(params):
+            z.writestr(_param_name(i), np.ascontiguousarray(p).tobytes())
+
+
+def _reject_legacy(path: str, head: bytes):
+    if head[:1] == b"\x80":  # pickle protocol-2+ opcode PROTO
+        raise ValueError(
+            f"{path!r} is a legacy pickle .pdmodel artifact; pickle loading "
+            f"was removed because unpickling executes arbitrary code from "
+            f"the file. Re-export the model with jit.save(...) (or "
+            f"static.save_inference_model) to produce the safe "
+            f"'{FORMAT_NAME}' container: a zip of meta.json + stablehlo.bin "
+            f"+ raw param_*.bin members.")
+
+
+def read_artifact(path: str) -> dict:
+    """Load a container written by `write_artifact`; returns the blob dict
+    ('stablehlo' bytes, 'params' numpy arrays, plus the metadata keys).
+    Legacy pickle artifacts raise with a re-export pointer; nothing in this
+    path ever unpickles."""
+    with open(path, "rb") as f:
+        head = f.read(8)
+    _reject_legacy(path, head)
+    if not zipfile.is_zipfile(path):
+        raise ValueError(
+            f"{path!r} is not a '{FORMAT_NAME}' artifact (not a zip "
+            f"container); re-export with jit.save")
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read(_META).decode("utf-8"))
+        if meta.get("format") != FORMAT_NAME:
+            raise ValueError(
+                f"{path!r}: unsupported artifact format "
+                f"{meta.get('format')!r}; expected '{FORMAT_NAME}'")
+        table = meta.pop("param_table", [])
+        meta.pop("format", None)
+        params = []
+        for i, entry in enumerate(table):
+            raw = z.read(_param_name(i))
+            arr = np.frombuffer(raw, dtype=np_dtype(entry["dtype"]))
+            params.append(arr.reshape([int(d) for d in entry["shape"]]))
+        blob = dict(meta)
+        blob["stablehlo"] = z.read(_PROGRAM)
+        blob["params"] = params
+    return blob

@@ -363,8 +363,6 @@ def save(layer, path, input_spec=None, **configs):
     input shapes+dtypes. Required for export; without it only the legacy
     params artifact is written.
     """
-    import pickle
-
     from paddle_tpu.framework.io_ import save as _save
 
     state = layer.state_dict() if hasattr(layer, "state_dict") else layer
@@ -417,16 +415,23 @@ def save(layer, path, input_spec=None, **configs):
     except Exception:
         abstract = _abstracts(dynamic=False)
         exported = _export(jit_pure, p_abs, abstract)
+    from paddle_tpu.inference.artifact import write_artifact
+
     blob = {
         "stablehlo": exported.serialize(),
         "params": param_vals,
         "class": cls,
-        # symbolic dims stringified: jax _DimExpr objects don't unpickle
+        # symbolic dims stringified: JSON metadata, not jax _DimExpr objects
         "in_shapes": [(tuple(d if isinstance(d, int) else str(d) for d in a.shape),
                        str(a.dtype)) for a in abstract],
     }
-    with open(path + ".pdmodel", "wb") as f:
-        pickle.dump(blob, f)
+    # data-only container (meta.json + stablehlo.bin + raw param members) —
+    # the .pdmodel load path never unpickles (paddle_tpu.inference.artifact).
+    # NOTE: the optional .pdparams state-dict sidecar above still uses the
+    # framework pickle format; `load` below only reads it for state_dict()
+    # metadata — treat .pdparams like code, or serve through
+    # paddle_tpu.inference.serve, which never touches it.
+    write_artifact(path + ".pdmodel", blob)
 
 
 class TranslatedLayer:
@@ -464,13 +469,11 @@ class TranslatedLayer:
 def load(path, **configs):
     """Load a jit.save artifact. Returns a runnable TranslatedLayer when the
     exported program exists; otherwise the legacy params dict."""
-    import pickle
-
     from paddle_tpu.framework.io_ import load as _load
+    from paddle_tpu.inference.artifact import read_artifact
 
     if os.path.exists(path + ".pdmodel"):
-        with open(path + ".pdmodel", "rb") as f:
-            blob = pickle.load(f)
+        blob = read_artifact(path + ".pdmodel")
         try:
             blob.setdefault("state_dict", _load(path + ".pdparams").get("state_dict"))
         except Exception:

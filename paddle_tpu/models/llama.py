@@ -272,9 +272,12 @@ class LlamaModel(nn.Layer):
         template = layers[0]
         if stacked is not None:
             # stacked [L, ...] arrays arrive from the compiled step's packing
-            # (jit inputs — the program never stacks or slices per layer)
-            return Tensor(scan_layer_stack(template, stacked, x._value,
-                                           kwargs=kwargs, policy=policy))
+            # (jit inputs — the program never stacks or slices per layer).
+            # shard_info: ZeRO-3 — they persist reduce-scattered and the
+            # scan gathers layer k+1's weights while layer k computes
+            return Tensor(scan_layer_stack(
+                template, stacked, x._value, kwargs=kwargs, policy=policy,
+                shard_info=getattr(ctx, "shard_info", None)))
         # stack the per-layer parameter values in-program (eager / unpacked
         # traced mode); the tape records ONE scan op with per-param grads
         n_per = len(template.parameters())

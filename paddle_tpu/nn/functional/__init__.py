@@ -1213,14 +1213,20 @@ _NEG_BIAS = -1e30  # additive mask floor: composes (sums) without fp32
 _warned_pallas_blocks: set = set()
 
 
-def _warn_pallas_blocks_once(reason: str):
-    if reason not in _warned_pallas_blocks:
+def _warn_pallas_blocks_once(reason: str, shape_sig=None):
+    """One-time XLA-fallback warning, deduplicated per (reason, shape
+    signature) — NOT per process: a second, DISTINCT fallback cause (a new
+    reason, or the same reason triggered by a different q/k/v geometry)
+    must still surface instead of being swallowed by the first one."""
+    key = (reason, shape_sig)
+    if key not in _warned_pallas_blocks:
         import warnings
 
-        _warned_pallas_blocks.add(reason)
+        _warned_pallas_blocks.add(key)
+        at = f" (shapes {shape_sig})" if shape_sig is not None else ""
         warnings.warn(
-            f"Pallas flash attention disabled for this shape, using the XLA "
-            f"fallback: {reason}", stacklevel=3)
+            f"Pallas flash attention disabled for this shape{at}, using the "
+            f"XLA fallback: {reason}", stacklevel=3)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
@@ -1258,8 +1264,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             ok, reason = pallas_blocks_ok(int(_t(query).shape[1]))
             if not ok:
                 # a bad FLAGS_flash_block_q/k override must not fail inside
-                # the kernel launch: warn once, run the XLA path below
-                _warn_pallas_blocks_once(reason)
+                # the kernel launch: warn once PER (cause, geometry), run
+                # the XLA path below
+                _warn_pallas_blocks_once(
+                    reason, shape_sig=tuple(_t(query).shape))
             else:
                 try:
                     q, k, v = _t(query), _t(key), _t(value)

@@ -151,7 +151,7 @@ class PipelinedTrainStep:
     def __init__(self, embed_layer, blocks: Sequence, head_layer, loss_fn: Callable,
                  optimizer=None, mesh: Mesh | None = None, num_micro: int = 1,
                  remat: bool | str | None = True, seed: int = 0,
-                 virtual_pp: int = 1):
+                 virtual_pp: int = 1, zero_axis: str | None = None):
         from paddle_tpu.core.flags import flag
         from paddle_tpu.parallel.scan_layers import normalize_remat
 
@@ -219,14 +219,71 @@ class PipelinedTrainStep:
                 arr = jnp.moveaxis(arr, 1, 0)  # -> [S, V, bpc, ...]
             stacked.append(arr)
 
-        # shardings: leading dim over 'pp', inner dims by the param's mp spec
-        def block_spec(p):
-            inner = _param_pspec(p, mesh)
-            if self.V == 1:
-                return PartitionSpec("pp", None, *inner)
-            return PartitionSpec("pp", None, None, *inner)
+        # ZeRO-3 per-stage sharding (composes with pp): each stage's block
+        # params ALSO persist reduce-scattered over `zero_axis`; the stage
+        # scan gathers block i+1's weights while block i computes and the
+        # all_gather transpose (psum_scatter) reduce-scatters the grads
+        self.zero_axis = None
+        if zero_axis is not None and zero_axis not in mesh.shape:
+            import warnings
 
-        self._block_specs = [block_spec(p) for p in self._block_params[0]]
+            warnings.warn(
+                f"zero_axis={zero_axis!r} is not a mesh axis "
+                f"({tuple(mesh.shape)}); per-stage ZeRO sharding is OFF")
+        if (zero_axis is not None and zero_axis in mesh.shape
+                and mesh.shape[zero_axis] > 1):
+            if self.V > 1:
+                raise ValueError(
+                    "zero_axis sharding is not supported with interleaved "
+                    "virtual_pp yet; use virtual_pp=1 (1F1B)")
+            if zero_axis not in self._dp_axes0:
+                # the psum_scatter grad reduction (the all_gather transpose)
+                # is only correct when the batch is sharded over the axis;
+                # a replicated batch would silently scale dW by the shard
+                # count (ZBH1 divides by it instead — its batch is always
+                # replicated)
+                raise ValueError(
+                    f"zero_axis={zero_axis!r} must be a data axis the batch "
+                    f"shards over ({self._dp_axes0 or 'none in this mesh'})")
+            self.zero_axis = zero_axis
+
+        # shardings: leading dim over 'pp', inner dims by the param's mp spec
+        # (+ the zero_axis on the first free divisible weight dim)
+        self._zero_dims = None
+
+        def block_spec(p, i):
+            inner = _param_pspec(p, mesh)
+            if self.V != 1:
+                return PartitionSpec("pp", None, None, *inner)
+            dims = ["pp", None] + list(inner)
+            dims += [None] * (2 + p.ndim - len(dims))
+            if self.zero_axis is not None:
+                flat = [a for e in dims if e for a in
+                        (e if isinstance(e, tuple) else (e,))]
+                if self.zero_axis not in flat:
+                    for d in range(2, 2 + p.ndim):
+                        if (dims[d] is None and p.shape[d - 2]
+                                % mesh.shape[self.zero_axis] == 0):
+                            dims[d] = self.zero_axis
+                            # gather axis in the PER-BLOCK slice (pp + stage
+                            # dims stripped before the stage scan runs)
+                            self._zero_dims[i] = d - 2
+                            break
+            return PartitionSpec(*dims)
+
+        if self.V == 1:
+            self._zero_dims = [None] * len(self._block_params[0])
+        self._block_specs = [block_spec(p, i)
+                             for i, p in enumerate(self._block_params[0])]
+        if self._zero_dims is None or all(d is None for d in self._zero_dims):
+            if self.zero_axis is not None:
+                import warnings
+
+                warnings.warn(
+                    f"zero_axis={self.zero_axis!r}: no block param dim "
+                    f"divides the axis; per-stage params persist REPLICATED")
+            self._zero_dims = None
+            self.zero_axis = None
         self._stacked_blocks = [
             jax.device_put(a, NamedSharding(mesh, s))
             for a, s in zip(stacked, self._block_specs)
@@ -272,7 +329,31 @@ class PipelinedTrainStep:
         # interior (the old remat=True), 'save_dots' keeps matmul outputs,
         # 'offload_residuals' parks tagged residuals in pinned host memory
         block_fn = remat_wrap(one_block, self.remat_policy, in_scan=True)
-        h, _ = jax.lax.scan(block_fn, x, stage_params_local)
+        if self.zero_axis is None:
+            h, _ = jax.lax.scan(block_fn, x, stage_params_local)
+            return h
+
+        # ZeRO-3 within the stage: block params arrive reduce-scattered over
+        # zero_axis; double-buffered gather-ahead reconstructs block i+1's
+        # weights while block i computes. Backward reduce-scatters the weight
+        # grads automatically (psum_scatter is the all_gather transpose).
+        def gather(vals):
+            return [v if d is None
+                    else jax.lax.all_gather(v, self.zero_axis, axis=d,
+                                            tiled=True)
+                    for v, d in zip(vals, self._zero_dims)]
+
+        first = gather([a[0] for a in stage_params_local])
+        # iteration i's xs slice carries block i+1's shards (tail wraps to 0)
+        rolled = [jnp.roll(a, -1, axis=0) for a in stage_params_local]
+
+        def body(carry, xs):
+            h, cur = carry
+            nxt = gather(list(xs))  # block i+1, overlaps block i's compute
+            h2, _ = block_fn(h, cur)
+            return (h2, nxt), None
+
+        (h, _), _ = jax.lax.scan(body, (x, first), tuple(rolled))
         return h
 
     def _pipeline_loss(self, stacked_blocks_local, embed_out_mb, key,
@@ -522,6 +603,14 @@ class PipelinedTrainStep:
         if eff_dp:
             div = int(np.prod([self.mesh.shape[a] for a in eff_dp]))
             if iv.shape[0] % self.M or (iv.shape[0] // self.M) % div:
+                if self.zero_axis is not None:
+                    # replicating the batch would double-count the
+                    # psum_scatter'd weight grads of the sharded blocks
+                    raise ValueError(
+                        f"zero_axis={self.zero_axis!r} requires microbatch "
+                        f"rows divisible by the data axes "
+                        f"{eff_dp} x num_micro={self.M}; got batch "
+                        f"{iv.shape[0]}")
                 eff_dp = ()
         cache_key = (eff_dp, tuple(sorted(extras)))
         self._dp_axes = eff_dp

@@ -131,11 +131,17 @@ def _param_pspec(p: Tensor, mesh: Mesh | None) -> PartitionSpec:
     return PartitionSpec(*dims)
 
 
-def _state_pspec(p_spec: PartitionSpec, state_val, axis: str | None, mesh: Mesh | None):
+def _state_pspec(p_spec: PartitionSpec, state_val, axis: str | None, mesh: Mesh | None,
+                 start_dim: int = 0):
     """ZeRO: shard optimizer state over `axis` on the FIRST dim that is not
     already mp-sharded and is divisible — an mp-sharded table (dim 0 over
     'mp') still gets its moments dp-sharded on dim 1, so per-device state is
-    1/(mp*dp) of the total (the PS-scale sparse-table layout)."""
+    1/(mp*dp) of the total (the PS-scale sparse-table layout).
+
+    start_dim: first dim eligible for the axis. Scan-stacked group columns
+    pass 1 — their dim 0 is the LAYER axis the scan slices per iteration,
+    and sharding it would make every iteration's state slice (and the grad
+    accumulator the partitioner propagates it onto) a cross-device gather."""
     if mesh is None or axis is None or axis not in mesh.shape or mesh.shape[axis] <= 1:
         return p_spec
     dims = list(p_spec) + [None] * (state_val.ndim - len(list(p_spec)))
@@ -144,7 +150,7 @@ def _state_pspec(p_spec: PartitionSpec, state_val, axis: str | None, mesh: Mesh 
     flat_axes = [a for entry in dims if entry
                  for a in (entry if isinstance(entry, tuple) else (entry,))]
     if axis not in flat_axes:  # zero-3 already shards params over `axis`
-        for d in range(state_val.ndim):
+        for d in range(start_dim, state_val.ndim):
             if dims[d] is None and state_val.shape[d] % mesh.shape[axis] == 0:
                 dims[d] = axis
                 break
@@ -163,6 +169,29 @@ def _zero3_param_spec(spec: PartitionSpec, val, axis: str | None, mesh: Mesh | N
         dims[0] = axis
         return PartitionSpec(*dims[: val.ndim])
     return spec
+
+
+def _zero3_stacked_spec(spec: PartitionSpec, val, axis: str | None,
+                        mesh: Mesh | None):
+    """ZeRO-3 layout for a scan-stacked [L, ...] group column: shard the
+    first free, divisible NON-layer dim over `axis` (dim 0 is the scan axis —
+    sharding it would make the per-iteration layer slice a cross-device
+    gather). Returns (spec, sharded?); the scan loop re-gathers per layer
+    (scan_layers gather-ahead), so unlike `_zero3_param_spec` this is NOT a
+    leave-it-to-GSPMD layout."""
+    if (mesh is None or axis is None or axis not in mesh.shape
+            or mesh.shape[axis] <= 1 or val.ndim <= 1):
+        return spec, False
+    dims = list(spec) + [None] * (val.ndim - len(list(spec)))
+    flat_axes = [a for entry in dims if entry
+                 for a in (entry if isinstance(entry, tuple) else (entry,))]
+    if axis in flat_axes:
+        return spec, False
+    for d in range(1, val.ndim):
+        if dims[d] is None and val.shape[d] % mesh.shape[axis] == 0:
+            dims[d] = axis
+            return PartitionSpec(*dims[: val.ndim]), True
+    return spec, False
 
 
 def host_memory_supported() -> bool:
@@ -211,7 +240,16 @@ class CompiledTrainStep:
     zero_axis: mesh axis for ZeRO sharding; None = off.
     zero_stage: 1/2 = optimizer state sharded over zero_axis (grad
       reduce-scatter is GSPMD's choice once the update is sharded); 3 = the
-      parameters themselves are ALSO persisted sharded (gather-on-use).
+      parameters themselves are ALSO persisted sharded. With scan_layers the
+      stacked decoder columns persist reduce-scattered on a non-layer dim
+      and the scan loop gathers them back per layer; without scan packing
+      (or for the embed/head outer params) GSPMD gathers on use.
+    zero3_gather: 'ahead' (default, the `zero3_gather` flag) = double-
+      buffered gather-ahead — layer k+1's weights all-gather while layer k
+      computes and backward re-gathers + reduce-scatters grads, so at most
+      2 layers of full weights are ever live; 'start' = all-gather the whole
+      stack before the loop (the overlap-free baseline bench.py compares
+      against).
     offload_optimizer: place optimizer state in pinned host memory
       (reference sharding offload variants); requires backend host-memory
       support (TPU), silently stays in HBM otherwise.
@@ -245,7 +283,8 @@ class CompiledTrainStep:
                  donate: bool = True, remat: bool | str | None = None,
                  scan_layers: bool | None = None, seed: int = 0,
                  metrics_every: int | None = None,
-                 dispatch_window: int | None = None):
+                 dispatch_window: int | None = None,
+                 zero3_gather: str | None = None):
         from paddle_tpu.core.flags import flag
         from paddle_tpu.io.device_feed import DispatchWindow
         from paddle_tpu.parallel.scan_layers import normalize_remat
@@ -341,11 +380,62 @@ class CompiledTrainStep:
             packed_specs.extend(
                 PartitionSpec(None, *_param_pspec(col[0], self.mesh))
                 for col in self._group_cols)
+        self._zero3_scan_info = None
+        if (zero_axis is not None and self.mesh is not None
+                and zero_axis not in self.mesh.shape):
+            import warnings
+
+            # a typo'd axis must not silently train replicated at Z x the
+            # provisioned parameter memory (axes of SIZE 1 stay silent —
+            # build_mesh keeps them so specs are uniform across configs)
+            warnings.warn(
+                f"zero_axis={zero_axis!r} is not a mesh axis "
+                f"({tuple(self.mesh.shape)}); ZeRO sharding is OFF")
         if zero_stage >= 3:
-            packed_specs = [
+            n_outer = len(self._outer_params)
+            packed_specs[:n_outer] = [
                 _zero3_param_spec(s, v, zero_axis, self.mesh)
-                for s, v in zip(packed_specs, packed_vals)
+                for s, v in zip(packed_specs[:n_outer], packed_vals[:n_outer])
             ]
+            if self._group_cols:
+                # stacked columns persist reduce-scattered; the scan loop
+                # re-gathers them per layer (gather-ahead by default) instead
+                # of leaving the layout to GSPMD — see scan_layers.ScanShardInfo
+                from paddle_tpu.parallel.scan_layers import ScanShardInfo
+
+                mode = (flag("zero3_gather") if zero3_gather is None
+                        else str(zero3_gather))
+                cols, any_sharded = [], False
+                for i, spec in enumerate(packed_specs[n_outer:]):
+                    sharded, did = _zero3_stacked_spec(
+                        spec, packed_vals[n_outer + i], zero_axis, self.mesh)
+                    any_sharded = any_sharded or did
+                    packed_specs[n_outer + i] = sharded
+                    cols.append((PartitionSpec(*tuple(sharded)[1:]),
+                                 PartitionSpec(*tuple(spec)[1:])))
+                if (not any_sharded and zero_axis is not None
+                        and zero_axis in self.mesh.shape
+                        and self.mesh.shape[zero_axis] > 1):
+                    import warnings
+
+                    warnings.warn(
+                        f"zero_stage=3: no stacked column has a free dim "
+                        f"divisible by {zero_axis!r} "
+                        f"(size {self.mesh.shape[zero_axis]}); the scan "
+                        f"stack persists REPLICATED")
+                if any_sharded:
+                    if self.remat_policy not in ("none", "full"):
+                        raise ValueError(
+                            f"zero_stage=3 sharded-weights scan re-gathers "
+                            f"and recomputes each layer in backward (its own "
+                            f"'full'-grade schedule); remat policy "
+                            f"{self.remat_policy!r} cannot apply to the "
+                            f"sharded stack — use remat='none'/'full', or "
+                            f"zero_stage<=2.")
+                    self._zero3_scan_info = ScanShardInfo(
+                        self.mesh, cols, mode=mode,
+                        axis=zero_axis or "sharding",
+                        act_spec=self.batch_spec)
         self._param_specs = packed_specs
         self._key = jax.random.key(seed)
         # resume from a loaded optimizer's step count: Adam-style bias
@@ -371,11 +461,14 @@ class CompiledTrainStep:
         if optimizer is not None:
             self._opt_states = []
             self._state_shardings = []
-            for pv, spec, st in zip(self._param_vals, self._param_specs,
-                                    self._resume_states(optimizer)):
+            n_outer_p = len(self._outer_params)
+            for i, (pv, spec, st) in enumerate(
+                    zip(self._param_vals, self._param_specs,
+                        self._resume_states(optimizer))):
                 st_sh = {}
                 for k, v in st.items():
-                    sp = _state_pspec(spec, v, zero_axis, self.mesh)
+                    sp = _state_pspec(spec, v, zero_axis, self.mesh,
+                                      start_dim=1 if i >= n_outer_p else 0)
                     sh = None
                     if self.mesh is not None:
                         if self._offload:
@@ -439,7 +532,8 @@ class CompiledTrainStep:
         prev = fleet_rng._tls.active_key_fn
         fleet_rng._tls.active_key_fn = next_key
         try:
-            with layer_execution(policy, stacked):
+            with layer_execution(policy, stacked,
+                                 shard_info=self._zero3_scan_info):
                 if isinstance(batch, dict):
                     # named-batch protocol (packed batches: input_ids /
                     # labels / segment_ids / position_ids / ...): EVERY leaf

@@ -185,7 +185,8 @@ class ZBH1PipelinedStep:
     def __init__(self, embed_layer, blocks: Sequence, head_layer,
                  loss_fn: Callable, mesh: Mesh | None = None,
                  num_micro: int = 2, seed: int = 0, optimizer=None,
-                 debug: bool = False, remat: bool | str = False):
+                 debug: bool = False, remat: bool | str = False,
+                 zero_axis: str | None = None):
         from paddle_tpu.parallel.scan_layers import normalize_remat
 
         # ZB-H1 is ZERO-recompute by construction: every residual the
@@ -245,8 +246,43 @@ class ZBH1PipelinedStep:
             vals = [bp[i]._value for bp in self._block_params]
             stacked.append(jnp.stack(vals).reshape(
                 (self.S, self.bps) + vals[0].shape))
-        self._block_specs = [
-            PartitionSpec("pp", *([None] * (a.ndim - 1))) for a in stacked]
+        # ZeRO-3 persistence composes with pp: each stage's block params ALSO
+        # live reduce-scattered over `zero_axis` and are all-gathered ONCE at
+        # stage entry (the unrolled-jaxpr B/W split needs the full stage
+        # weights as stable loop invariants, so there is no per-block
+        # gather-ahead here — persistence is 1/(pp*shard), in-step liveness
+        # stays one stage). Weight grads return reduce-scattered
+        # (psum_scatter / shard_size: the batch is replicated over the axis).
+        self.zero_axis = None
+        self._zero_dims = [None] * nb
+        if zero_axis is not None and zero_axis not in mesh.shape:
+            import warnings
+
+            warnings.warn(
+                f"zero_axis={zero_axis!r} is not a mesh axis "
+                f"({tuple(mesh.shape)}); per-stage ZeRO sharding is OFF")
+        if (zero_axis is not None and zero_axis in mesh.shape
+                and mesh.shape[zero_axis] > 1):
+            self.zero_axis = zero_axis
+        self._block_specs = []
+        for i, a in enumerate(stacked):
+            dims = ["pp"] + [None] * (a.ndim - 1)
+            if self.zero_axis is not None:
+                for d in range(2, a.ndim):
+                    if a.shape[d] % mesh.shape[self.zero_axis] == 0:
+                        dims[d] = self.zero_axis
+                        # gather axis after the leading pp dim is stripped
+                        self._zero_dims[i] = d - 1
+                        break
+            self._block_specs.append(PartitionSpec(*dims))
+        if all(d is None for d in self._zero_dims):
+            if self.zero_axis is not None:
+                import warnings
+
+                warnings.warn(
+                    f"zero_axis={self.zero_axis!r}: no block param dim "
+                    f"divides the axis; per-stage params persist REPLICATED")
+            self.zero_axis = None
         self._stacked_blocks = [
             jax.device_put(a, NamedSharding(mesh, s))
             for a, s in zip(stacked, self._block_specs)]
@@ -329,6 +365,16 @@ class ZBH1PipelinedStep:
                  extras):
             rank = jax.lax.axis_index("pp")
             stage_params = [a[0] for a in stacked_local]
+            zshard = (self.mesh.shape[self.zero_axis]
+                      if self.zero_axis is not None else 1)
+            if self.zero_axis is not None:
+                # reconstitute this stage's full weights ONCE (stable loop
+                # invariants for every F/B/W jaxpr below)
+                stage_params = [
+                    p if d is None
+                    else jax.lax.all_gather(p, self.zero_axis, axis=d,
+                                            tiled=True)
+                    for p, d in zip(stage_params, self._zero_dims)]
             n_sp = len(stage_params)
             n_hv = len(head_vals)
             zero_act = jnp.zeros(mb_shape, f32)
@@ -720,6 +766,15 @@ class ZBH1PipelinedStep:
                     i += len(g_e)
 
             loss = jax.lax.psum(loss, "pp")  # only last rank contributed
+            if self.zero_axis is not None:
+                # back to the reduce-scattered layout: every zero_axis rank
+                # computed the SAME full dW (the batch is replicated over the
+                # axis), so psum_scatter / shard_size is an exact shard of it
+                g_sp = [g if d is None
+                        else jax.lax.psum_scatter(
+                            g, self.zero_axis, scatter_dimension=d,
+                            tiled=True) / zshard
+                        for g, d in zip(g_sp, self._zero_dims)]
             g_stage = tuple(g[None] for g in g_sp)
             g_embed = tuple(jax.lax.psum(g, "pp") for g in g_e)
             g_head = tuple(jax.lax.psum(g, "pp") for g in g_hv)

@@ -13,8 +13,6 @@ XLA dead-code-eliminates the rest).
 """
 from __future__ import annotations
 
-import pickle
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -123,8 +121,10 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
                        for k, fid in enumerate(feed_ids)],
         "fetch_count": len(fetch_ids),
     }
-    with open(path_prefix + ".pdmodel", "wb") as f:
-        pickle.dump(blob, f)
+    from paddle_tpu.inference.artifact import write_artifact
+
+    # data-only container shared with jit.save (no pickle on either path)
+    write_artifact(path_prefix + ".pdmodel", blob)
     from paddle_tpu.framework.io_ import save as _save
 
     _save({"state_dict": {f"var_{i}": Tensor(jnp.asarray(v))
@@ -138,8 +138,9 @@ def load_inference_model(path_prefix: str, executor=None, **kwargs):
     a TranslatedLayer taking the feeds positionally."""
     from paddle_tpu.jit.api import load as _jit_load
 
+    from paddle_tpu.inference.artifact import read_artifact
+
     translated = _jit_load(path_prefix)
-    with open(path_prefix + ".pdmodel", "rb") as f:
-        blob = pickle.load(f)
+    blob = read_artifact(path_prefix + ".pdmodel")
     return [translated, blob.get("feed_names", []),
             list(range(blob.get("fetch_count", 1)))]
