@@ -612,6 +612,217 @@ print("ZERO3_JSON " + json.dumps(out))
 """
 
 
+LOWP_PROBE = r"""
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.parallel import CompiledTrainStep
+
+
+def wrap(model):
+    class W:
+        layer_remat_capable = True
+        def parameters(self): return model.parameters()
+        def scan_group(self): return model.scan_group()
+        def __call__(self, ids, labels): return model(ids, labels)
+    return W()
+
+
+on_tpu = jax.devices()[0].platform != "cpu"
+out = {"platform": jax.devices()[0].platform}
+
+# ---- arm 1: fp8 vs bf16 step time on a matmul-bound geometry ------------
+# (scaled-down 7B shape ratios: intermediate/hidden = 2.75, head_dim 64;
+# on CPU the f8 dots are EMULATED, so the measured ratio reflects program
+# structure, not MXU throughput — the projection below carries the
+# hardware constants explicitly)
+if on_tpu:
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                      intermediate_size=11008, num_hidden_layers=2,
+                      num_attention_heads=32, num_key_value_heads=32,
+                      max_position_embeddings=4096)
+    B, S, iters = 1, 4096, 10
+else:
+    cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
+                      intermediate_size=704, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=256)
+    B, S, iters = 4, 128, 8
+ids = jnp.asarray(np.random.RandomState(0).randint(
+    0, cfg.vocab_size, (B, S)).astype(np.int32))
+
+
+def measure(pol):
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg); m.train()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=m.parameters())
+    step = CompiledTrainStep(wrap(m), lambda o, l: o, optimizer=opt,
+                             fp8_policy=pol)
+    float(step(ids, ids, ids))  # compile + settle
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(step(ids, ids, ids))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    med = ts[len(ts) // 2]
+    args = [step._param_vals, step._opt_states, [ids, ids, ids],
+            jax.random.key(0), jnp.float32(1e-4), jnp.int32(1)]
+    if pol != "none":
+        args += [step._fp8_states, jnp.float32(1.0)]
+    txt = step._jitted.lower(*args).as_text()
+    f8 = sum(1 for ln in txt.splitlines()
+             if "dot_general" in ln and "f8E4M3" in ln)
+    del step, m, opt
+    return {"step_s": round(med, 5), "tokens_per_sec": round(B * S / med, 1),
+            "f8_dot_generals": f8, "e5m2_present": "f8E5M2" in txt}
+
+
+bf16 = measure("none")
+f8 = measure("matmuls")
+out["bf16"] = bf16
+out["fp8_matmuls"] = f8
+out["fp8_vs_bf16_step_ratio"] = round(f8["step_s"] / bf16["step_s"], 3)
+out["hlo_guard"] = bool(f8["f8_dot_generals"] > 0
+                        and bf16["f8_dot_generals"] == 0
+                        and f8["e5m2_present"])
+
+# ---- arm 2: loss-parity gate, fp8 vs bf16 over >=100 steps --------------
+# methodology: a FRESH batch every step (pretraining regime — the curves
+# settle into a comparable plateau instead of memorizing a few batches,
+# where late-stage near-zero losses make any gate degenerate); the final
+# score is the mean of the last 3 recorded points, gated at 5% of the
+# bf16 level (0.05 absolute floor)
+pcfg = LlamaConfig(vocab_size=256, hidden_size=128, intermediate_size=352,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   num_key_value_heads=4, max_position_embeddings=128)
+STEPS = 120
+pids_np = np.random.RandomState(1).randint(
+    0, 256, (STEPS, 4, 32)).astype(np.int32)
+
+
+def parity(pol):
+    paddle.seed(0)
+    m = LlamaForCausalLM(pcfg); m.train()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    step = CompiledTrainStep(wrap(m), lambda o, l: o, optimizer=opt,
+                             fp8_policy=pol)
+    curve = []
+    for i in range(STEPS):
+        b = jnp.asarray(pids_np[i])
+        loss = float(step(b, b, b))
+        if i % 10 == 0 or i == STEPS - 1:
+            curve.append(round(loss, 5))
+    return curve
+
+
+c_bf = parity("none")
+c_f8 = parity("matmuls")
+fin_bf = float(np.mean(c_bf[-3:]))
+fin_f8 = float(np.mean(c_f8[-3:]))
+delta = abs(fin_f8 - fin_bf)
+tol = max(0.05, 0.05 * abs(fin_bf))
+out["loss_parity"] = {
+    "steps": STEPS, "curve_every": 10,
+    "bf16_curve": c_bf, "fp8_curve": c_f8,
+    "final_bf16": round(fin_bf, 5), "final_fp8": round(fin_f8, 5),
+    "final_delta": round(delta, 5), "tolerance": round(tol, 5),
+    "parity_ok": bool(delta <= tol),
+}
+
+# ---- arm 3: wo_int8 serving artifact ------------------------------------
+import os, tempfile
+import paddle_tpu.jit as pjit
+from paddle_tpu.jit.api import InputSpec
+from paddle_tpu.inference.serve import Artifact
+
+qcfg = LlamaConfig(vocab_size=4096, hidden_size=256, intermediate_size=512,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   num_key_value_heads=4, max_position_embeddings=64,
+                   use_parallel_cross_entropy=False)
+paddle.seed(0)
+qm = LlamaForCausalLM(qcfg); qm.eval()
+for p in qm.parameters():
+    if jnp.issubdtype(p._value.dtype, jnp.floating):
+        p._set_value(p._value.astype(jnp.bfloat16))
+tmp = tempfile.mkdtemp()
+spec = [InputSpec((2, 32), "int32")]
+pjit.save(qm, os.path.join(tmp, "bf16"), input_spec=spec)
+pjit.save(qm, os.path.join(tmp, "int8"), input_spec=spec,
+          quantize="wo_int8")
+b_bf = os.path.getsize(os.path.join(tmp, "bf16.pdmodel"))
+b_q = os.path.getsize(os.path.join(tmp, "int8.pdmodel"))
+dec_ids = np.random.RandomState(0).randint(0, 4096, (2, 32)).astype(np.int32)
+ref = np.asarray(pjit.load(os.path.join(tmp, "bf16"))(dec_ids)._value,
+                 np.float32)
+art = Artifact(os.path.join(tmp, "int8"))
+got = art.run([dec_ids])[0].astype(np.float32)
+dec_diff = float(np.abs(ref - got).max() / (np.abs(ref).max() or 1.0))
+out["wo_int8"] = {
+    "artifact_bytes_bf16": b_bf, "artifact_bytes_wo_int8": b_q,
+    "bytes_ratio": round(b_q / b_bf, 4),
+    "bytes_ok": bool(b_q <= 0.55 * b_bf),
+    "decode_rel_maxdiff_vs_bf16": round(dec_diff, 5),
+    "decode_ok": bool(dec_diff < 0.08),
+    "served_via": "serve.Artifact",
+}
+
+# ---- refreshed 7B projection (constants explicit) -----------------------
+# flops/token at 7B, seq 4096: matmul share = 6*N / (6*N + attn term)
+N7 = 6.74e9
+H7, L7, SEQ7 = 4096, 32, 4096
+fpt = 6.0 * N7 + 12.0 * L7 * H7 * SEQ7
+matmul_frac = 6.0 * N7 / fpt
+LOWP_PEAK_RATIO = 2.0  # v5e int8 394 TOPS / 197 TFLOPs bf16; fp8-native
+                       # parts (v6e, H100) carry the same 2x matmul ratio
+speedup = 1.0 / ((1.0 - matmul_frac) + matmul_frac / LOWP_PEAK_RATIO)
+PREV_V5E, PREV_V5P, BAR = 3090.0, 7198.0, 4220.0  # BENCH_r05 projections
+out["projection_7b"] = {
+    "matmul_flop_fraction": round(matmul_frac, 4),
+    "low_precision_peak_ratio_assumed": LOWP_PEAK_RATIO,
+    "amdahl_matmul_speedup": round(speedup, 3),
+    "prev_round_tokens_per_sec_v5e_bf16": PREV_V5E,
+    "prev_round_tokens_per_sec_v5p_bf16": PREV_V5P,
+    "projected_tokens_per_sec_v5e_lowp": round(PREV_V5E * speedup, 1),
+    "projected_tokens_per_sec_v5p_lowp": round(PREV_V5P * speedup, 1),
+    "h100_50pct_bar_tokens_per_sec": BAR,
+    "clears_v5e_bar_with_lowp": bool(PREV_V5E * speedup >= BAR),
+    "note": "projection = prev-round bf16 tokens/sec x Amdahl speedup of "
+            "the matmul share at the assumed 2x low-precision peak; "
+            "measured fp8 step times on this host are "
+            + ("MXU-real" if on_tpu else "CPU-EMULATED (structure only)"),
+}
+
+print("LOWP_JSON " + json.dumps(out))
+"""
+
+
+def _low_precision_probe():
+    """fp8-vs-bf16 compiled-step arm + >=100-step loss-parity gate +
+    wo_int8 artifact bytes/decode-parity, with the refreshed 7B projection.
+    Runs on the DEFAULT platform (TPU when present; CPU emulates the f8
+    dots, so CPU step times only validate program structure)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        res = subprocess.run([sys.executable, "-c", LOWP_PROBE],
+                             capture_output=True, text=True, timeout=900,
+                             env=env)
+        for line in res.stdout.splitlines():
+            if line.startswith("LOWP_JSON "):
+                return json.loads(line[len("LOWP_JSON "):])
+        print(f"low-precision probe produced no result; stderr tail:\n"
+              f"{res.stderr[-800:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"low-precision probe failed: {e!r}", file=sys.stderr)
+    return None
+
+
 def _zero3_probe():
     """ZeRO-3 sharded-weights probe on the 8-device virtual CPU mesh:
     gather-ahead vs gather-at-start vs replicated step times (overlap
@@ -1028,6 +1239,7 @@ def main():
     input_pipe = _input_pipeline_probe()
     packing = _packing_probe()
     zero3 = _zero3_probe()
+    lowp = _low_precision_probe()
     # fixed-geometry 8-layer probe: compile-time O(1)-in-depth + remat-policy
     # memory lever, comparable across rounds on any platform. The measured
     # bench arms are attached UNCONDITIONALLY: a probe failure must not
@@ -1062,7 +1274,8 @@ def main():
                    "pipeline": pipe,
                    "input_pipeline": input_pipe,
                    "packing": packing,
-                   "zero3_sharding": zero3},
+                   "zero3_sharding": zero3,
+                   "low_precision": lowp},
     }))
 
 

@@ -22,7 +22,8 @@ from paddle_tpu.core.dtype import convert_dtype, to_jax_dtype
 from paddle_tpu.core.tensor import Tensor
 
 __all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "is_bfloat16_supported",
-           "is_float16_supported", "white_list", "black_list"]
+           "is_float16_supported", "white_list", "black_list", "fp8",
+           "fp8_autocast"]
 
 # O1 lists (reference: amp/amp_lists.py WHITE_LIST/BLACK_LIST)
 WHITE_LIST = {
@@ -238,3 +239,5 @@ class GradScaler:
 
 
 from paddle_tpu.amp import debugging  # noqa: E402,F401
+from paddle_tpu.amp import fp8  # noqa: E402,F401
+from paddle_tpu.amp.fp8 import fp8_autocast  # noqa: E402,F401

@@ -1,0 +1,520 @@
+"""FP8 matmul paths with delayed scaling (training) and current scaling
+(pipelines / eager).
+
+Reference analog: the reference framework's AMP subsystem extended to fp8
+the way production TPU/GPU stacks do it (TransformerEngine / Flax fp8_ops):
+matmul inputs are cast to ``float8_e4m3fn`` (activations/weights) and
+``float8_e5m2`` (gradients) around a higher-precision accumulation
+(`preferred_element_type=float32`), with per-tensor scales chosen so the
+tensor's absolute maximum maps near the fp8 dtype's max.
+
+Two scaling strategies:
+
+* **Delayed scaling** (`fp8_dot`): the scale comes from a rolling per-tensor
+  **amax history** observed on previous steps, so no extra reduction sits on
+  the critical path. The history is an explicit fp8-state pytree
+  (three ``[H]`` fp32 arrays per matmul callsite — x / w / grad) threaded
+  through `CompiledTrainStep` like optimizer state. The state update uses
+  the standard "state-as-gradient" trick: `fp8_dot` is a `jax.custom_vjp`
+  whose cotangent w.r.t. each history IS the updated history (rolled, with
+  the newly observed amax at index 0), so `jax.grad` of the loss w.r.t. the
+  fp8 state returns next step's state — it composes for free with
+  `lax.scan` over layers (stacked ``[L, H]`` histories ride the scan xs and
+  their per-layer cotangents re-stack), `jax.checkpoint` remat policies and
+  GSPMD sharding (a batch-sharded amax lowers to an all-reduce-max, i.e.
+  the global-batch amax).
+* **Current scaling** (`fp8_dot_current`): scales computed from the live
+  tensors. No state to carry — the pipelined runtimes (1F1B / ZB-H1), whose
+  schedules stash and replay per-microbatch vjps, and eager
+  `fp8_autocast` use this; it is the more accurate, slightly slower
+  variant (one extra amax reduction per matmul).
+
+The policy surface mirrors ``remat_policy``: a string
+``'none' | 'matmuls' | 'matmuls+head'`` (flag ``fp8_policy`` + kwarg on the
+step runtimes). ``'matmuls'`` quantizes the `F.linear` projections (QKV / O
+/ MLP in LLaMA) but leaves the LM-head matmul in bf16; ``'matmuls+head'``
+additionally quantizes the fused-CE head projection
+(`paddle_tpu.ops.pallas.fused_ce` — its softmax statistics stay fp32).
+
+The thread-local :class:`Fp8Session` is the dispatch seam: `F.linear`
+consults it (`linear_fp8_enabled`), the layer-scan threads stacked
+histories through it (`scan_enter` / `scan_body` / `scan_exit`), and model
+head sections mark themselves with `head_scope` so the policy can
+distinguish projection matmuls from the head.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "FP8_POLICIES", "E4M3_MAX", "E5M2_MAX", "normalize_fp8_policy",
+    "new_callsite_state", "delayed_scale", "update_history",
+    "fp8_dot", "fp8_dot_current", "fp8_matmul", "fp8_autocast",
+    "fp8_execution", "fp8_recording", "head_scope", "current_session",
+    "linear_fp8_enabled", "head_fp8_enabled", "fp8_linear",
+    "scan_enter", "scan_body", "scan_exit", "Fp8Session",
+]
+
+FP8_POLICIES = ("none", "matmuls", "matmuls+head")
+
+# finite-max of the fp8 dtypes (OCP FP8: E4M3 has no inf, max 448;
+# E5M2 max 57344)
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+STATE_KEYS = ("x", "w", "g")  # per-callsite amax histories
+
+
+def normalize_fp8_policy(policy) -> str:
+    """Map the policy knob onto the namespace (None/False -> 'none')."""
+    if policy is None or policy is False:
+        return "none"
+    if policy is True:
+        return "matmuls"
+    p = str(policy)
+    if p not in FP8_POLICIES:
+        raise ValueError(
+            f"unknown fp8 policy {p!r}; expected one of "
+            f"{'|'.join(FP8_POLICIES)}")
+    return p
+
+
+def new_callsite_state(hist_len: int = 16) -> dict:
+    """Fresh amax-history state for one matmul callsite: x / w / grad
+    histories, fp32 ``[hist_len]``, zeros (scale 1.0 until first observe)."""
+    return {k: jnp.zeros((int(hist_len),), jnp.float32) for k in STATE_KEYS}
+
+
+def delayed_scale(hist, fmax: float):
+    """fp8 scale from an amax history: ``fmax / max(history)`` so the
+    largest recently-seen magnitude maps to the dtype max; 1.0 while the
+    history is empty (all zeros). A non-finite history entry (e.g. a
+    restored corrupt checkpoint) degrades to scale 1.0 instead of 0 —
+    ``fmax/inf -> 0`` would turn the dequant into ``0 * inf = NaN``."""
+    amax = jnp.max(hist)
+    amax = jnp.where(jnp.isfinite(amax), amax, 0.0)
+    return jnp.where(amax > 0.0,
+                     fmax / jnp.maximum(amax, 1e-12), 1.0).astype(jnp.float32)
+
+
+def update_history(hist, amax):
+    """Roll the history and record the newly observed amax at index 0.
+
+    A non-finite amax (an overflowed activation or gradient — the forward
+    itself stays finite because the fp8 cast SATURATES, so no loss-scaler
+    skip fires) is replaced by the history's current max: one bad batch
+    must not poison the next `hist_len` steps' scales."""
+    amax = amax.astype(jnp.float32)
+    amax = jnp.where(jnp.isfinite(amax), amax, jnp.max(hist))
+    return jnp.roll(hist, 1).at[0].set(amax)
+
+
+def _amax(v):
+    return jnp.max(jnp.abs(v.astype(jnp.float32)))
+
+
+def _current_scale(v, fmax: float):
+    return delayed_scale(_amax(v)[None], fmax)
+
+
+def _quant(v, scale, fmax: float, dt):
+    """Scale-and-saturate cast to an fp8 dtype (values beyond the history's
+    amax clip to the dtype max — the standard delayed-scaling saturation)."""
+    return jnp.clip(v.astype(jnp.float32) * scale, -fmax, fmax).astype(dt)
+
+
+def _dtype_token(v):
+    """Zero-size carrier of a primal's dtype through custom_vjp residuals
+    (cotangents must match primal dtypes; the quantized residuals lose it)."""
+    return jnp.zeros((0,), v.dtype)
+
+
+def _f8_matmul(qa, qb, inv_scale):
+    """fp8 x fp8 matmul with fp32 accumulation, dequantized."""
+    out = jnp.matmul(qa, qb, preferred_element_type=jnp.float32)
+    return out * inv_scale
+
+
+def fp8_matmul(a, b, a_dtype=None, b_dtype=None):
+    """Raw current-scaled fp8 matmul (fp32 out, no custom vjp) — the
+    building block other custom-vjp kernels (fused CE) call inside their own
+    forward/backward passes. a_dtype/b_dtype default to e4m3."""
+    a_dtype = a_dtype or jnp.float8_e4m3fn
+    b_dtype = b_dtype or jnp.float8_e4m3fn
+    a_max = E5M2_MAX if a_dtype == jnp.float8_e5m2 else E4M3_MAX
+    b_max = E5M2_MAX if b_dtype == jnp.float8_e5m2 else E4M3_MAX
+    sa = _current_scale(a, a_max)
+    sb = _current_scale(b, b_max)
+    qa = _quant(a, sa, a_max, a_dtype)
+    qb = _quant(b, sb, b_max, b_dtype)
+    return _f8_matmul(qa, qb, (1.0 / sa) * (1.0 / sb))
+
+
+# ---------------------------------------------------------------------------
+# fp8_dot — delayed scaling, the fp8-state-as-gradient custom_vjp
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def fp8_dot(x, w, hx, hw, hg):
+    """``x @ w`` through float8_e4m3 with delayed scaling.
+
+    x: [..., K] activations; w: [K, N] weights; hx/hw/hg: fp32 amax
+    histories for x, w and the output gradient. Output is in x's dtype.
+    Differentiating returns the e5m2 gradient matmuls for dx/dw and — as the
+    cotangent of each history — its UPDATED value, so the caller's
+    ``jax.grad`` w.r.t. the state yields next step's state.
+    """
+    out, _ = _fp8_dot_fwd(x, w, hx, hw, hg)
+    return out
+
+
+def _fp8_dot_fwd(x, w, hx, hw, hg):
+    sx = delayed_scale(hx, E4M3_MAX)
+    sw = delayed_scale(hw, E4M3_MAX)
+    qx = _quant(x, sx, E4M3_MAX, jnp.float8_e4m3fn)
+    qw = _quant(w, sw, E4M3_MAX, jnp.float8_e4m3fn)
+    out = _f8_matmul(qx, qw, (1.0 / sx) * (1.0 / sw)).astype(x.dtype)
+    nhx = update_history(hx, _amax(x))
+    nhw = update_history(hw, _amax(w))
+    return out, (qx, qw, sx, sw, nhx, nhw, hg,
+                 _dtype_token(x), _dtype_token(w))
+
+
+def _fp8_dot_bwd(res, g):
+    qx, qw, sx, sw, nhx, nhw, hg, xtok, wtok = res
+    sg = delayed_scale(hg, E5M2_MAX)
+    qg = _quant(g, sg, E5M2_MAX, jnp.float8_e5m2)
+    # dx = g @ w.T ; dw = x.T @ g over all leading batch dims
+    dx = _f8_matmul(qg, qw.T, (1.0 / sg) * (1.0 / sw)).astype(xtok.dtype)
+    qg2 = qg.reshape(-1, qg.shape[-1])
+    qx2 = qx.reshape(-1, qx.shape[-1])
+    dw = _f8_matmul(qx2.T, qg2, (1.0 / sg) * (1.0 / sx)).astype(wtok.dtype)
+    nhg = update_history(hg, _amax(g))
+    return dx, dw, nhx, nhw, nhg
+
+
+fp8_dot.defvjp(lambda x, w, hx, hw, hg: _fp8_dot_fwd(x, w, hx, hw, hg),
+               _fp8_dot_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fp8_dot_current — stateless current scaling (pipelines / eager autocast)
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def fp8_dot_current(x, w):
+    """``x @ w`` through float8_e4m3 with scales from the live tensors
+    (gradients through e5m2). No state — safe inside schedule runtimes that
+    stash/replay per-microbatch vjps."""
+    sx = _current_scale(x, E4M3_MAX)
+    sw = _current_scale(w, E4M3_MAX)
+    qx = _quant(x, sx, E4M3_MAX, jnp.float8_e4m3fn)
+    qw = _quant(w, sw, E4M3_MAX, jnp.float8_e4m3fn)
+    return _f8_matmul(qx, qw, (1.0 / sx) * (1.0 / sw)).astype(x.dtype)
+
+
+def _fp8_cur_fwd(x, w):
+    sx = _current_scale(x, E4M3_MAX)
+    sw = _current_scale(w, E4M3_MAX)
+    qx = _quant(x, sx, E4M3_MAX, jnp.float8_e4m3fn)
+    qw = _quant(w, sw, E4M3_MAX, jnp.float8_e4m3fn)
+    out = _f8_matmul(qx, qw, (1.0 / sx) * (1.0 / sw)).astype(x.dtype)
+    return out, (qx, qw, sx, sw, _dtype_token(x), _dtype_token(w))
+
+
+def _fp8_cur_bwd(res, g):
+    qx, qw, sx, sw, xtok, wtok = res
+    sg = _current_scale(g, E5M2_MAX)
+    qg = _quant(g, sg, E5M2_MAX, jnp.float8_e5m2)
+    dx = _f8_matmul(qg, qw.T, (1.0 / sg) * (1.0 / sw)).astype(xtok.dtype)
+    qg2 = qg.reshape(-1, qg.shape[-1])
+    qx2 = qx.reshape(-1, qx.shape[-1])
+    dw = _f8_matmul(qx2.T, qg2, (1.0 / sg) * (1.0 / sx)).astype(wtok.dtype)
+    return dx, dw
+
+
+fp8_dot_current.defvjp(_fp8_cur_fwd, _fp8_cur_bwd)
+
+
+# ---------------------------------------------------------------------------
+# the thread-local session: policy + state handout + scan threading
+# ---------------------------------------------------------------------------
+
+
+class Fp8Session:
+    """One fp8-enabled trace: policy + the per-callsite state protocol.
+
+    modes:
+      * ``record``    — discovery trace (`jax.eval_shape`): counts matmul
+                        callsites in call order, noting which sit inside a
+                        scanned layer group, into ``layout`` entries
+                        ``("plain",)`` / ``("scan", n_layers, k)``.
+      * ``execute``   — compiled-step trace: hands the pre-allocated state
+                        arrays (tracers) out in the same order; stacked
+                        ``[L, H]`` states thread the layer scan as xs.
+      * ``stateless`` — no state; callsites use current scaling.
+    """
+
+    def __init__(self, policy: str, mode: str, hist_len: int = 16,
+                 states=None, layout=None):
+        self.policy = policy
+        self.mode = mode
+        self.hist_len = int(hist_len)
+        self.states = states
+        self.layout = list(layout) if layout is not None else []
+        self._flat = 0      # cursor over self.states
+        self._lay = 0       # cursor over self.layout
+        self._scan = None   # active scan-group bookkeeping
+        self.in_head = False
+
+    # -- per-callsite state handout -----------------------------------------
+    def next_state(self):
+        if self.mode == "stateless":
+            return None
+        if self._scan is not None:
+            if self.mode == "record":
+                self._scan["count_this"] += 1
+                return new_callsite_state(self.hist_len)
+            slices, cur = self._scan["slices"], self._scan["cursor"]
+            if cur[0] >= len(slices):
+                raise RuntimeError(
+                    "fp8: more matmul callsites inside the layer scan than "
+                    "discovery recorded — the traced program diverged from "
+                    "the discovery trace")
+            st = slices[cur[0]]
+            cur[0] += 1
+            return st
+        if self.mode == "record":
+            self.layout.append(("plain",))
+            return new_callsite_state(self.hist_len)
+        if (self._lay >= len(self.layout)
+                or self.layout[self._lay][0] != "plain"):
+            raise RuntimeError(
+                "fp8: matmul callsite order diverged from the discovery "
+                f"trace (layout cursor {self._lay} of {self.layout})")
+        self._lay += 1
+        st = self.states[self._flat]
+        self._flat += 1
+        return st
+
+    # -- scanned layer-group protocol (called by scan_layer_stack) ----------
+    def scan_enter(self, n_layers: int):
+        """Entering a lax.scan over `n_layers` stacked layers. Returns the
+        flat leaves (``[L, H]`` arrays) to thread through the scan xs."""
+        if self.mode == "stateless":
+            return ()
+        if self._scan is not None:
+            raise RuntimeError("fp8: nested scanned layer groups are not "
+                               "supported")
+        if self.mode == "record":
+            self._scan = {"n": int(n_layers), "count": 0, "count_this": 0}
+            return ()
+        entry = (self.layout[self._lay]
+                 if self._lay < len(self.layout) else None)
+        if (entry is None or entry[0] != "scan"
+                or int(entry[1]) != int(n_layers)):
+            raise RuntimeError(
+                f"fp8: scanned layer group (L={n_layers}) diverged from the "
+                f"discovery layout entry {entry!r}")
+        k = int(entry[2])
+        self._lay += 1
+        group = self.states[self._flat:self._flat + k]
+        self._flat += k
+        self._scan = {"group": group, "k": k}
+        return tuple(st[key] for st in group for key in STATE_KEYS)
+
+    @contextmanager
+    def scan_body(self, leaves):
+        """Inside one scan-body trace: install the per-iteration ``[H]``
+        slices the xs delivered (execute), or reset the per-trace callsite
+        counter (record — lax.scan may trace the body more than once)."""
+        if self.mode == "stateless" or self._scan is None:
+            yield
+            return
+        if self.mode == "record":
+            self._scan["count_this"] = 0
+            try:
+                yield
+            finally:
+                self._scan["count"] = max(self._scan["count"],
+                                          self._scan["count_this"])
+            return
+        nk = len(STATE_KEYS)
+        slices = [{key: leaves[i * nk + j]
+                   for j, key in enumerate(STATE_KEYS)}
+                  for i in range(self._scan["k"])]
+        prev = (self._scan.get("slices"), self._scan.get("cursor"))
+        self._scan["slices"] = slices
+        self._scan["cursor"] = [0]
+        try:
+            yield
+        finally:
+            self._scan["slices"], self._scan["cursor"] = prev
+
+    def scan_exit(self):
+        if self.mode == "stateless" or self._scan is None:
+            return
+        if self.mode == "record":
+            self.layout.append(("scan", self._scan["n"], self._scan["count"]))
+        self._scan = None
+
+    # -- discovery results ---------------------------------------------------
+    def init_states(self) -> list:
+        """Zero-initialized states matching the recorded layout (record
+        mode): ``[H]`` for plain callsites, ``[L, H]`` per scanned-group
+        callsite."""
+        out = []
+        for e in self.layout:
+            if e[0] == "plain":
+                out.append(new_callsite_state(self.hist_len))
+            else:
+                n_layers, k = int(e[1]), int(e[2])
+                out.extend(
+                    {key: jnp.zeros((n_layers, self.hist_len), jnp.float32)
+                     for key in STATE_KEYS}
+                    for _ in range(k))
+        return out
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.sess = None
+
+
+_tls = _TLS()
+
+
+def current_session() -> Fp8Session | None:
+    return _tls.sess
+
+
+@contextmanager
+def _install(sess):
+    prev = _tls.sess
+    _tls.sess = sess
+    try:
+        yield sess
+    finally:
+        _tls.sess = prev
+
+
+@contextmanager
+def fp8_execution(policy, states=None, layout=None, hist_len: int = 16):
+    """Activate fp8 for the ops traced inside: delayed scaling when a
+    discovered (states, layout) pair is given (`CompiledTrainStep`), else
+    stateless current scaling (pipelined runtimes, eager autocast)."""
+    policy = normalize_fp8_policy(policy)
+    if policy == "none":
+        yield None
+        return
+    mode = "execute" if states is not None else "stateless"
+    with _install(Fp8Session(policy, mode, hist_len, states, layout)) as s:
+        yield s
+
+
+def fp8_autocast(policy="matmuls"):
+    """Public eager-mode context: run `F.linear` matmuls (and, with
+    'matmuls+head', the fused-CE head projection) through fp8 with current
+    scaling. The compiled-step analog is `CompiledTrainStep(fp8_policy=...)`
+    / the ``fp8_policy`` flag, which additionally carries delayed-scaling
+    amax state."""
+    return fp8_execution(policy)
+
+
+@contextmanager
+def fp8_recording(policy, hist_len: int = 16):
+    """Discovery session for `jax.eval_shape`: records callsite layout."""
+    policy = normalize_fp8_policy(policy)
+    with _install(Fp8Session(policy, "record", hist_len)) as s:
+        yield s
+
+
+@contextmanager
+def head_scope():
+    """Mark the LM-head matmul region: under policy 'matmuls' the head
+    stays in bf16; 'matmuls+head' quantizes it too."""
+    s = _tls.sess
+    if s is None:
+        yield
+        return
+    prev = s.in_head
+    s.in_head = True
+    try:
+        yield
+    finally:
+        s.in_head = prev
+
+
+def linear_fp8_enabled(xv, wv) -> bool:
+    """Should this F.linear call run through fp8? (consulted on the eager
+    dispatch seam; False whenever no session is active)."""
+    s = _tls.sess
+    if s is None:
+        return False
+    if s.in_head and s.policy != "matmuls+head":
+        return False
+    if getattr(wv, "ndim", 0) != 2 or getattr(xv, "ndim", 0) < 2:
+        return False
+    try:
+        return (jnp.issubdtype(xv.dtype, jnp.floating)
+                and jnp.issubdtype(wv.dtype, jnp.floating))
+    except Exception:
+        return False
+
+
+def head_fp8_enabled() -> bool:
+    """Should the fused-CE head projection quantize? (softmax stats stay
+    fp32 regardless — only the matmuls change precision)."""
+    s = _tls.sess
+    return s is not None and s.policy == "matmuls+head"
+
+
+def fp8_linear(x, w, bias=None):
+    """The F.linear fp8 fast path (Tensor-level): pulls this callsite's
+    delayed-scaling state from the active session (None -> current
+    scaling) and dispatches through apply_op so the eager tape still
+    records a vjp."""
+    from paddle_tpu.core.tensor import apply_op
+
+    st = _tls.sess.next_state()
+    if st is None:
+        def f(xv, wv, *b):
+            out = fp8_dot_current(xv, wv)
+            return out + b[0] if b else out
+    else:
+        def f(xv, wv, *b):
+            out = fp8_dot(xv, wv, st["x"], st["w"], st["g"])
+            return out + b[0] if b else out
+
+    args = [x, w] + ([bias] if bias is not None else [])
+    return apply_op(f, *args, name="fp8_linear")
+
+
+# -- module-level scan protocol (None-session-safe) -------------------------
+
+
+def scan_enter(n_layers: int):
+    s = _tls.sess
+    return () if s is None else s.scan_enter(n_layers)
+
+
+@contextmanager
+def scan_body(leaves):
+    s = _tls.sess
+    if s is None:
+        yield
+        return
+    with s.scan_body(leaves):
+        yield
+
+
+def scan_exit():
+    s = _tls.sess
+    if s is not None:
+        s.scan_exit()

@@ -132,3 +132,13 @@ define_flag("remat_policy", "none",
             "default selective-rematerialization policy, consulted when a "
             "step is constructed with remat=None (the CompiledTrainStep "
             "default): none|full|save_dots|save_nothing|offload_residuals")
+define_flag("fp8_policy", "none",
+            "low-precision matmul policy for the step runtimes, consulted "
+            "when a step is constructed with fp8_policy=None: none|matmuls|"
+            "matmuls+head. 'matmuls' runs F.linear projections (QKV/O/MLP) "
+            "through float8_e4m3 (grads float8_e5m2); '+head' also "
+            "quantizes the fused-CE head projection (softmax stats stay "
+            "fp32)")
+define_flag("fp8_amax_history_len", 16,
+            "delayed-scaling amax history length per fp8 matmul callsite "
+            "(the scale maps max(history) to the fp8 dtype max)", type=int)

@@ -341,19 +341,26 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None, attn_mask=None,
                 segment_ids=None, position_ids=None):
+        from paddle_tpu.amp.fp8 import head_scope
+
         hidden = self.llama(input_ids, attn_mask, segment_ids=segment_ids,
                             position_ids=position_ids)
         if labels is not None:
             from paddle_tpu.core.flags import flag
 
-            if flag("use_fused_head_loss"):
-                # head projection + CE in one chunked custom-vjp: the
-                # [tokens, vocab] logits never exist (escape hatch:
-                # use_fused_head_loss=False restores the unfused path)
-                return self.criterion.forward_fused(hidden, self.lm_head,
-                                                    labels)
-            return self.criterion(self.lm_head(hidden), labels)
-        return self.lm_head(hidden)
+            with head_scope():
+                # head_scope: under fp8_policy='matmuls' the head matmul
+                # stays bf16; 'matmuls+head' quantizes it too (the fused-CE
+                # kernel keeps its softmax statistics fp32 either way)
+                if flag("use_fused_head_loss"):
+                    # head projection + CE in one chunked custom-vjp: the
+                    # [tokens, vocab] logits never exist (escape hatch:
+                    # use_fused_head_loss=False restores the unfused path)
+                    return self.criterion.forward_fused(hidden, self.lm_head,
+                                                        labels)
+                return self.criterion(self.lm_head(hidden), labels)
+        with head_scope():
+            return self.lm_head(hidden)
 
     # ---- pipeline-parallel factory ----------------------------------------
     @staticmethod
@@ -390,4 +397,7 @@ class _HeadStage(nn.Layer):
         return self.norm(x)
 
     def forward(self, x):
-        return self.lm_head(self.forward_features(x))
+        from paddle_tpu.amp.fp8 import head_scope
+
+        with head_scope():
+            return self.lm_head(self.forward_features(x))

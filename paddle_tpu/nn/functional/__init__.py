@@ -215,14 +215,24 @@ def maxout(x, groups, axis=1):
 # ---------------------------------------------------------------------------
 
 def linear(x, weight, bias=None, name=None):
-    """y = x @ W + b; W is [in, out] (paddle convention, nn/functional/common.py)."""
+    """y = x @ W + b; W is [in, out] (paddle convention, nn/functional/common.py).
+
+    Under an active fp8 session (`CompiledTrainStep(fp8_policy=...)`, the
+    pipelined runtimes, or `amp.fp8_autocast`) the matmul runs through
+    float8_e4m3 with e5m2 gradients — the hot-path seam the fp8 policy
+    hooks (paddle_tpu.amp.fp8)."""
     from paddle_tpu.ops.linalg import _prec
 
+    xt, wt = _t(x), _t(weight)
+    from paddle_tpu.amp import fp8 as _fp8
+
+    if _fp8.linear_fp8_enabled(xt._value, wt._value):
+        return _fp8.fp8_linear(xt, wt, None if bias is None else _t(bias))
     if bias is None:
-        return apply_op(lambda v, w: jnp.matmul(v, w, precision=_prec()), _t(x), _t(weight), name="linear")
+        return apply_op(lambda v, w: jnp.matmul(v, w, precision=_prec()), xt, wt, name="linear")
     return apply_op(
         lambda v, w, b: jnp.matmul(v, w, precision=_prec()) + b,
-        _t(x), _t(weight), _t(bias), name="linear",
+        xt, wt, _t(bias), name="linear",
     )
 
 

@@ -91,6 +91,10 @@ class _CECfg(NamedTuple):
     mp_axis: str | None   # bound shard_map axis name, or None
     has_w: bool
     has_bias: bool
+    # fp8_policy='matmuls+head': the head projection (and the backward
+    # dx/dW matmuls, with the d-logits tile in e5m2) run through float8 with
+    # current scaling; per-token softmax stats and accumulators stay fp32
+    fp8: bool = False
 
 
 def _check_labels(labels):
@@ -133,9 +137,22 @@ def _chunk_stats(logits, labels_c):
     return m, s, t, sl
 
 
-def _project(x_c, w, b):
-    out = jnp.dot(x_c.astype(jnp.float32), w.astype(jnp.float32),
-                  preferred_element_type=jnp.float32)
+def _fp8_mm(a, b, a_e5m2=False):
+    """Current-scaled fp8 matmul, fp32 out (no vjp of its own — the fused-CE
+    custom_vjp owns forward AND backward, so forward tiles, the backward's
+    recomputed tiles, and the dx/dW products all quantize consistently)."""
+    from paddle_tpu.amp.fp8 import fp8_matmul
+
+    return fp8_matmul(a, b,
+                      a_dtype=jnp.float8_e5m2 if a_e5m2 else None)
+
+
+def _project(x_c, w, b, fp8=False):
+    if fp8:
+        out = _fp8_mm(x_c, w)
+    else:
+        out = jnp.dot(x_c.astype(jnp.float32), w.astype(jnp.float32),
+                      preferred_element_type=jnp.float32)
     if b is not None:
         out = out + b.astype(jnp.float32)
     return out
@@ -161,7 +178,8 @@ def _stats_tokens(cfg: _CECfg, x, w, b, labels_loc):
 
     def step(_, args):
         xi, li = args
-        logits = _project(xi, w, b) if cfg.has_w else xi.astype(jnp.float32)
+        logits = (_project(xi, w, b, cfg.fp8) if cfg.has_w
+                  else xi.astype(jnp.float32))
         return None, _chunk_stats(logits, li)
 
     _, (m, s, t, sl) = jax.lax.scan(step, None, (xc, lc))
@@ -192,8 +210,11 @@ def _stats_vocab(cfg: _CECfg, x, w, b, labels_loc):
         m, s, t, sl = carry
         j = args[0]
         wi = args[1]
-        logits = jnp.dot(xf, wi.astype(jnp.float32),
-                         preferred_element_type=jnp.float32)
+        if cfg.fp8:
+            logits = _fp8_mm(xf, wi)
+        else:
+            logits = jnp.dot(xf, wi.astype(jnp.float32),
+                             preferred_element_type=jnp.float32)
         if bc is not None:
             logits = logits + args[2].astype(jnp.float32)
         col = j * cv + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
@@ -380,15 +401,23 @@ def _bwd_tokens(cfg: _CECfg, x, w, b, labels, lse, ct):
 
     def step(carry, args):
         xi, li, ai = args
-        logits = _project(xi, w, b) if cfg.has_w else xi.astype(jnp.float32)
+        logits = (_project(xi, w, b, cfg.fp8) if cfg.has_w
+                  else xi.astype(jnp.float32))
         d = _chunk_dlogits(cfg, logits, li, ai[:, 0], ai[:, 1], ai[:, 2],
                            v_total)
         if not cfg.has_w:
             return carry, d
-        dxi = jnp.dot(d, wf.T, preferred_element_type=jnp.float32)
         dw_acc, db_acc = carry
-        dw_acc = dw_acc + jnp.dot(xi.astype(jnp.float32).T, d,
-                                  preferred_element_type=jnp.float32)
+        if cfg.fp8:
+            # gradient tile in e5m2, x/w in e4m3; the dw accumulator stays
+            # fp32 (only the matmuls change precision)
+            dxi = _fp8_mm(d, wf.T, a_e5m2=True)
+            dw_acc = dw_acc + _fp8_mm(d.T, xi.astype(jnp.float32),
+                                      a_e5m2=True).T
+        else:
+            dxi = jnp.dot(d, wf.T, preferred_element_type=jnp.float32)
+            dw_acc = dw_acc + jnp.dot(xi.astype(jnp.float32).T, d,
+                                      preferred_element_type=jnp.float32)
         if db_acc is not None:
             db_acc = db_acc + jnp.sum(d, axis=0)
         return (dw_acc, db_acc), dxi
@@ -419,8 +448,11 @@ def _bwd_vocab(cfg: _CECfg, x, w, b, labels, lse, ct):
 
     def step(dx_acc, args):
         j, wi = args[0], args[1]
-        logits = jnp.dot(xf, wi.astype(jnp.float32),
-                         preferred_element_type=jnp.float32)
+        if cfg.fp8:
+            logits = _fp8_mm(xf, wi)
+        else:
+            logits = jnp.dot(xf, wi.astype(jnp.float32),
+                             preferred_element_type=jnp.float32)
         if bc is not None:
             logits = logits + args[2].astype(jnp.float32)
         # labels shifted into this chunk's [0, cv) frame, then padding
@@ -429,9 +461,14 @@ def _bwd_vocab(cfg: _CECfg, x, w, b, labels, lse, ct):
                            v_total)
         col = j * cv + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
         d = jnp.where(col < vloc, d, 0.0)
-        dx_acc = dx_acc + jnp.dot(d, wi.astype(jnp.float32).T,
-                                  preferred_element_type=jnp.float32)
-        dwi = jnp.dot(d.T, xf, preferred_element_type=jnp.float32)  # [cv, H]
+        if cfg.fp8:
+            dx_acc = dx_acc + _fp8_mm(d, wi.astype(jnp.float32).T,
+                                      a_e5m2=True)
+            dwi = _fp8_mm(d.T, xf, a_e5m2=True)  # [cv, H]
+        else:
+            dx_acc = dx_acc + jnp.dot(d, wi.astype(jnp.float32).T,
+                                      preferred_element_type=jnp.float32)
+            dwi = jnp.dot(d.T, xf, preferred_element_type=jnp.float32)
         return dx_acc, (dwi, jnp.sum(d, axis=0))
 
     xs = (jnp.arange(nc, dtype=jnp.int32), wc) + ((bc,) if bc is not None else ())
@@ -515,18 +552,27 @@ def _resolve_cfg(n, vloc, ignore_index, label_smoothing, z_loss, chunk_tokens,
     if chunk_vocab == 0:
         chunk_vocab = int(flag("fused_ce_chunk_vocab"))
     ct, cv = resolve_chunks(n, vloc, chunk_tokens, chunk_vocab)
+    # fp8_policy='matmuls+head': the projection matmuls quantize (stats stay
+    # fp32). The Pallas stats kernel is bf16/fp32-only, so fp8 resolves to
+    # the token-chunked scan variant instead.
+    from paddle_tpu.amp.fp8 import head_fp8_enabled
+
+    fp8 = bool(has_w and head_fp8_enabled())
     if variant in (None, "", "auto"):
         variant = flag("fused_ce_variant")
     if variant in (None, "", "auto"):
-        variant = ("pallas" if (has_w and not has_bias and _on_tpu())
+        variant = ("pallas" if (has_w and not has_bias and _on_tpu()
+                                and not fp8)
                    else "tokens")
+    if fp8 and variant == "pallas":
+        variant = "tokens"
     if mp_axis == "auto":
         from paddle_tpu.distributed.collective import _bound_axes
         from paddle_tpu.distributed.fleet.layers.mpu.mp_ops import MP_AXIS
 
         mp_axis = MP_AXIS if _bound_axes((MP_AXIS,)) else None
     return _CECfg(int(ignore_index), float(label_smoothing), float(z_loss),
-                  ct, cv, variant, mp_axis, has_w, has_bias)
+                  ct, cv, variant, mp_axis, has_w, has_bias, fp8)
 
 
 def fused_linear_cross_entropy_loss(x, w, labels, bias=None, *,

@@ -151,7 +151,9 @@ class PipelinedTrainStep:
     def __init__(self, embed_layer, blocks: Sequence, head_layer, loss_fn: Callable,
                  optimizer=None, mesh: Mesh | None = None, num_micro: int = 1,
                  remat: bool | str | None = True, seed: int = 0,
-                 virtual_pp: int = 1, zero_axis: str | None = None):
+                 virtual_pp: int = 1, zero_axis: str | None = None,
+                 fp8_policy: str | None = None):
+        from paddle_tpu.amp.fp8 import normalize_fp8_policy
         from paddle_tpu.core.flags import flag
         from paddle_tpu.parallel.scan_layers import normalize_remat
 
@@ -161,6 +163,13 @@ class PipelinedTrainStep:
         self.remat_policy = normalize_remat(
             flag("remat_policy") if remat is None else remat)
         self.remat = self.remat_policy != "none"
+        # fp8_policy (none|matmuls|matmuls+head): the schedule stashes and
+        # replays per-microbatch vjps, so the pipelined runtimes use the
+        # STATELESS current-scaling fp8 variant (scales from the live
+        # tensors each microbatch — no cross-step amax state to carry;
+        # CompiledTrainStep is the delayed-scaling path)
+        self.fp8_policy = normalize_fp8_policy(
+            flag("fp8_policy") if fp8_policy is None else fp8_policy)
         self.mesh = mesh if mesh is not None else get_mesh()
         if self.mesh is None or "pp" not in self.mesh.shape:
             raise ValueError("PipelinedTrainStep requires a mesh with a 'pp' axis")
@@ -436,12 +445,18 @@ class PipelinedTrainStep:
         fspec = fused_head_spec(self.head, self.loss_fn)
 
         def body(out_loc, lab_loc, hv):
+            from paddle_tpu.amp.fp8 import head_scope
+
             def per_mb(args):
                 out_m, lab_m = args
                 if fspec is not None:
+                    # fused path: the fused-CE kernel reads the fp8 policy
+                    # itself ('matmuls+head' quantizes the projection)
                     return fused_head_loss(self.head, hv, out_m, lab_m,
                                            fspec).astype(jnp.float32)
-                head_out = functional_call(self.head, hv, (Tensor(out_m),))
+                with head_scope():
+                    head_out = functional_call(self.head, hv,
+                                               (Tensor(out_m),))
                 o = head_out._value if isinstance(head_out, Tensor) else head_out
                 loss_t = self.loss_fn(Tensor(o), Tensor(lab_m))
                 lv = loss_t._value if isinstance(loss_t, Tensor) else loss_t
@@ -559,8 +574,13 @@ class PipelinedTrainStep:
 
     def _step_fn(self, embed_vals, stacked_blocks, head_vals, opt_states, ids, labels,
                  key, lr, step_i, extras=None):
+        from paddle_tpu.amp.fp8 import fp8_execution
+
         def loss_fn(ev, sb, hv):
-            return self._loss_of(ev, sb, hv, ids, labels, key, extras)
+            # stateless (current-scaling) fp8 session active for the whole
+            # pipeline trace; the head region gates itself via head_scope
+            with fp8_execution(self.fp8_policy):
+                return self._loss_of(ev, sb, hv, ids, labels, key, extras)
 
         loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
             embed_vals, stacked_blocks, head_vals

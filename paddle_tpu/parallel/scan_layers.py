@@ -238,26 +238,41 @@ def scan_layer_stack(template, stacked_vals: Sequence, x, args: tuple = (),
     weights are ever live). mode "start" all-gathers the whole stack before
     the loop (the overlap-free baseline).
     """
+    from paddle_tpu.amp import fp8 as _fp8
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.parallel.train_step import functional_call
 
     kwargs = kwargs or {}
     if shard_info is not None:
+        sess = _fp8.current_session()
+        if sess is not None and sess.mode != "stateless":
+            # the zero3 custom-vjp scan owns its residuals/cotangents and
+            # cannot thread the delayed-scaling amax state; CompiledTrainStep
+            # rejects the combination up front — this is the backstop
+            raise ValueError(
+                "fp8 delayed scaling cannot thread the zero_stage=3 "
+                "sharded-weights scan; use zero_stage<=2 with fp8_policy")
         return _zero3_scan(template, stacked_vals, x, args, kwargs,
                            shard_info)
     n_layers = stacked_vals[0].shape[0]
+    n_cols = len(stacked_vals)
+    # delayed-scaling fp8: stacked [L, H] amax histories for the callsites
+    # inside the layer body ride the scan xs; their per-layer cotangents
+    # (the updated histories) re-stack through the scan's vjp
+    fp8_leaves = _fp8.scan_enter(n_layers)
 
     def body(carry, xs):
         idx = xs[0]
-        layer_vals = list(xs[1:])
-        with _fold_rng(idx):
+        layer_vals = list(xs[1:1 + n_cols])
+        with _fold_rng(idx), _fp8.scan_body(list(xs[1 + n_cols:])):
             out = functional_call(template, layer_vals, (Tensor(carry),) + args,
                                   kwargs=kwargs)
         return (out._value if isinstance(out, Tensor) else out), None
 
     body = remat_wrap(body, policy, in_scan=True)
-    xs = (jnp.arange(n_layers),) + tuple(stacked_vals)
+    xs = (jnp.arange(n_layers),) + tuple(stacked_vals) + tuple(fp8_leaves)
     h, _ = jax.lax.scan(body, x, xs)
+    _fp8.scan_exit()
     return h
 
 

@@ -269,6 +269,26 @@ class CompiledTrainStep:
       policy applied PER LAYER, so the embed/fused-head/CE segment is never
       recomputed; other models fall back to the legacy whole-loss
       `jax.checkpoint` region (with the policy attached).
+    fp8_policy: low-precision matmul policy (mirrors remat_policy):
+      'none' | 'matmuls' | 'matmuls+head', or None to read the `fp8_policy`
+      flag. 'matmuls' runs the model's F.linear projections through
+      float8_e4m3 (gradients float8_e5m2) with DELAYED scaling: per-tensor
+      amax histories live as an explicit fp8-state pytree threaded through
+      the step exactly like optimizer state (discovered by one abstract
+      trace on the first call; stacked [L, H] for callsites inside the
+      lax.scan layer loop; checkpoint via fp8_state_dict/load_fp8_state).
+      '+head' additionally quantizes the fused-CE head projection (softmax
+      stats stay fp32). Composes with zero_axis ZeRO-1/2 (the amax state
+      rides replicated next to its stack column); the zero_stage=3
+      sharded-weights scan owns its vjp residuals and rejects fp8.
+    grad_scaler: an amp.GradScaler for float16 training: the loss is
+      scaled inside the program, gradients are unscaled in fp32, and a
+      non-finite gradient skips the whole optimizer update (params AND
+      moments keep their old values). The scaler's state machine is
+      advanced from the per-step found_inf scalar WITHOUT breaking async
+      dispatch: flags settle lazily as their device values become ready
+      (drain() settles all), so the scale a queued step uses may lag by the
+      in-flight window — the documented async-AMP semantics.
     scan_layers: stack the model's `scan_group()` layer parameters along a
       leading layer axis OUTSIDE the program and run the stack as one
       `lax.scan` — HLO size and compile time become O(1) in depth. None reads
@@ -284,7 +304,9 @@ class CompiledTrainStep:
                  scan_layers: bool | None = None, seed: int = 0,
                  metrics_every: int | None = None,
                  dispatch_window: int | None = None,
-                 zero3_gather: str | None = None):
+                 zero3_gather: str | None = None,
+                 fp8_policy: str | None = None, grad_scaler=None):
+        from paddle_tpu.amp.fp8 import normalize_fp8_policy
         from paddle_tpu.core.flags import flag
         from paddle_tpu.io.device_feed import DispatchWindow
         from paddle_tpu.parallel.scan_layers import normalize_remat
@@ -297,6 +319,14 @@ class CompiledTrainStep:
         self.remat_policy = normalize_remat(
             flag("remat_policy") if remat is None else remat)
         self.remat = self.remat_policy != "none"
+        self.fp8_policy = normalize_fp8_policy(
+            flag("fp8_policy") if fp8_policy is None else fp8_policy)
+        self._fp8_hist_len = int(flag("fp8_amax_history_len"))
+        self._fp8_states = None   # discovered on the first call
+        self._fp8_layout = None
+        self._scaler = (grad_scaler if grad_scaler is not None
+                        and grad_scaler.is_enable() else None)
+        self._pending_inf: list = []
         self._layer_capable = bool(getattr(model, "layer_remat_capable", False))
         if scan_layers is None:
             scan_layers = bool(flag("scan_layers"))
@@ -436,6 +466,13 @@ class CompiledTrainStep:
                         self.mesh, cols, mode=mode,
                         axis=zero_axis or "sharding",
                         act_spec=self.batch_spec)
+        if self.fp8_policy != "none" and self._zero3_scan_info is not None:
+            raise ValueError(
+                "fp8_policy cannot compose with the zero_stage=3 "
+                "sharded-weights scan: its custom vjp owns the scan "
+                "residuals/cotangents and cannot thread the delayed-scaling "
+                "amax state. Use zero_stage<=2 (optimizer-state sharding) "
+                "with fp8_policy, or fp8_policy='none' with zero_stage=3.")
         self._param_specs = packed_specs
         self._key = jax.random.key(seed)
         # resume from a loaded optimizer's step count: Adam-style bias
@@ -514,12 +551,14 @@ class CompiledTrainStep:
             yield dict(optimizer._init_state(Tensor(sv)))
 
     # -- the pure step -------------------------------------------------------
-    def _loss_of(self, param_vals, batch, key):
+    def _loss_of(self, param_vals, batch, key, fp8_states=None):
         counter = [0]
 
         def next_key():
             counter[0] += 1
             return jax.random.fold_in(key, counter[0])
+
+        from contextlib import nullcontext
 
         from paddle_tpu.parallel.scan_layers import layer_execution
 
@@ -529,51 +568,104 @@ class CompiledTrainStep:
         # outside every remat region); for others the context carries 'none'
         # and _step_fn wraps the whole loss in the legacy checkpoint region
         policy = self.remat_policy if self._layer_capable else "none"
+        # delayed-scaling fp8: install the execute-mode session handing the
+        # per-callsite amax states (tracers) out in discovery order. When
+        # fp8_states is None (discovery itself, or fp8 off) no session is
+        # installed here — discovery wraps this call in a record session.
+        fp8_ctx = nullcontext()
+        if self.fp8_policy != "none" and fp8_states is not None:
+            from paddle_tpu.amp.fp8 import fp8_execution
+
+            fp8_ctx = fp8_execution(self.fp8_policy, states=fp8_states,
+                                    layout=self._fp8_layout,
+                                    hist_len=self._fp8_hist_len)
         prev = fleet_rng._tls.active_key_fn
         fleet_rng._tls.active_key_fn = next_key
         try:
-            with layer_execution(policy, stacked,
-                                 shard_info=self._zero3_scan_info):
-                if isinstance(batch, dict):
-                    # named-batch protocol (packed batches: input_ids /
-                    # labels / segment_ids / position_ids / ...): EVERY leaf
-                    # is a model kwarg — labels included, so fused-head
-                    # models compute the loss in-model — and `labels` also
-                    # feeds loss_fn, preserving the (out, label) contract
-                    out = functional_call(self.model, param_vals[:n_outer],
-                                          (), kwargs=dict(batch),
-                                          params=self._outer_params)
-                    label = Tensor(batch["labels"])
-                else:
-                    out = functional_call(self.model, param_vals[:n_outer],
-                                          batch[:-1],
-                                          params=self._outer_params)
-                    label = Tensor(batch[-1])
-            loss = self.loss_fn(out, label)
+            with fp8_ctx:
+                with layer_execution(policy, stacked,
+                                     shard_info=self._zero3_scan_info):
+                    if isinstance(batch, dict):
+                        # named-batch protocol (packed batches: input_ids /
+                        # labels / segment_ids / position_ids / ...): EVERY
+                        # leaf is a model kwarg — labels included, so fused-
+                        # head models compute the loss in-model — and
+                        # `labels` also feeds loss_fn, preserving the
+                        # (out, label) contract
+                        out = functional_call(self.model,
+                                              param_vals[:n_outer],
+                                              (), kwargs=dict(batch),
+                                              params=self._outer_params)
+                        label = Tensor(batch["labels"])
+                    else:
+                        out = functional_call(self.model,
+                                              param_vals[:n_outer],
+                                              batch[:-1],
+                                              params=self._outer_params)
+                        label = Tensor(batch[-1])
+                loss = self.loss_fn(out, label)
             return loss._value
         finally:
             fleet_rng._tls.active_key_fn = prev
 
-    def _step_fn(self, param_vals, opt_states, batch, key, lr, step_i):
-        loss_of = self._loss_of
+    def _step_fn(self, param_vals, opt_states, batch, key, lr, step_i,
+                 fp8_states=None, scaler_scale=None):
+        fp8_on = self.fp8_policy != "none"
+        fp8_in = list(fp8_states) if fp8_states is not None else []
+        scaling = self._scaler is not None
+
+        def run_loss(full_vals, fp8_s):
+            return self._loss_of(full_vals, batch, key,
+                                 fp8_states=fp8_s if fp8_on else None)
+
         if self.remat and not self._layer_capable:
             from paddle_tpu.parallel.scan_layers import remat_wrap
 
             # legacy whole-loss region for models that cannot scope remat
             # per layer themselves (the policy still applies, e.g. tagged
             # residuals offload under 'offload_residuals')
-            loss_of = remat_wrap(loss_of, self.remat_policy)
+            run_loss = remat_wrap(run_loss, self.remat_policy)
 
         trainable_idx = [i for i, t in enumerate(self._trainable) if t]
 
-        def loss_wrt_trainable(train_vals):
+        def loss_all(train_vals, fp8_s):
             full = list(param_vals)
             for i, v in zip(trainable_idx, train_vals):
                 full[i] = v
-            return loss_of(full, batch, key)
+            loss = run_loss(full, fp8_s)
+            # float16 loss scaling happens INSIDE the differentiated fn so
+            # the whole backward benefits; the aux output reports the
+            # unscaled loss
+            if scaling:
+                return loss * scaler_scale.astype(loss.dtype), loss
+            return loss, loss
 
         train_vals = [param_vals[i] for i in trainable_idx]
-        loss, grads = jax.value_and_grad(loss_wrt_trainable)(train_vals)
+        # the gradient of the loss w.r.t. the fp8 amax histories IS their
+        # updated value (the fp8_dot custom-vjp's state-as-gradient
+        # contract), so new_fp8 below is next step's state pytree
+        (_, loss), (grads, new_fp8) = jax.value_and_grad(
+            loss_all, argnums=(0, 1), has_aux=True)(train_vals, fp8_in)
+
+        found_inf = None
+        if scaling:
+            inv = (1.0 / scaler_scale).astype(jnp.float32)
+            unscaled = []
+            bad = jnp.zeros((), jnp.bool_)
+            for g in grads:
+                g32 = g.astype(jnp.float32) * inv
+                bad = bad | ~jnp.isfinite(g32).all()
+                unscaled.append(g32.astype(g.dtype))
+            grads = unscaled
+            found_inf = bad
+            if fp8_on:
+                # an overflow step must not poison the amax histories: the
+                # backward observed inf/nan amaxes, and delayed_scale of an
+                # inf history is 0 -> NaN gradients on the NEXT step. Keep
+                # the previous state, mirroring the params/moments skip.
+                new_fp8 = jax.tree_util.tree_map(
+                    lambda old, new: jnp.where(found_inf, old, new),
+                    fp8_in, list(new_fp8))
 
         new_params = list(param_vals)
         new_states = list(opt_states) if opt_states is not None else None
@@ -591,26 +683,51 @@ class CompiledTrainStep:
                                             .with_memory_kind("device"))
                           for k, v in st.items()}
                 np_, ns_ = self.optimizer._update(param_vals[i], g, st, lr, step_i)
+                if found_inf is not None:
+                    # inf/nan grads skip the WHOLE update: params and
+                    # moments keep their previous values (GradScaler
+                    # inf-skip semantics under jit)
+                    np_ = jnp.where(found_inf, param_vals[i], np_)
+                    ns_ = {k: jnp.where(found_inf, st[k], v)
+                           for k, v in ns_.items()}
                 new_params[i] = np_
                 new_states[i] = ns_
+        if fp8_on or scaling:
+            flag_out = (found_inf.astype(jnp.float32) if found_inf is not None
+                        else jnp.zeros((), jnp.float32))
+            return loss, new_params, new_states, list(new_fp8), flag_out
         return loss, new_params, new_states
 
     def _build(self):
         mesh = self.mesh
+        extended = self.fp8_policy != "none" or self._scaler is not None
         if mesh is not None and self.optimizer is not None:
             pshard = [NamedSharding(mesh, s) for s in self._param_specs]
             sshard = self._state_shardings
             repl = NamedSharding(mesh, PartitionSpec())
-            self._jitted = jax.jit(
-                self._step_fn,
-                in_shardings=(pshard, sshard, None, None, None, None),
-                out_shardings=(repl, pshard, sshard),
-                donate_argnums=(0, 1) if self._donate else (),
-            )
+            if extended:
+                # amax histories are tiny ([H] / [L, H]) — they ride
+                # replicated next to their (possibly sharded) stack column
+                fshard = jax.tree_util.tree_map(
+                    lambda _: repl, self._fp8_states or [])
+                self._jitted = jax.jit(
+                    self._step_fn,
+                    in_shardings=(pshard, sshard, None, None, None, None,
+                                  fshard, None),
+                    out_shardings=(repl, pshard, sshard, fshard, repl),
+                    donate_argnums=(0, 1, 6) if self._donate else (),
+                )
+            else:
+                self._jitted = jax.jit(
+                    self._step_fn,
+                    in_shardings=(pshard, sshard, None, None, None, None),
+                    out_shardings=(repl, pshard, sshard),
+                    donate_argnums=(0, 1) if self._donate else (),
+                )
         else:
-            self._jitted = jax.jit(
-                self._step_fn, donate_argnums=(0, 1) if self._donate else ()
-            )
+            donate = (((0, 1, 6) if extended else (0, 1))
+                      if self._donate else ())
+            self._jitted = jax.jit(self._step_fn, donate_argnums=donate)
 
     # -- public --------------------------------------------------------------
     def __call__(self, *batch):
@@ -627,8 +744,6 @@ class CompiledTrainStep:
         matches skip the device_put entirely."""
         from paddle_tpu.profiler import RecordEvent
 
-        if self._jitted is None:
-            self._build()
         named = len(batch) == 1 and isinstance(batch[0], dict)
         if named and "labels" not in batch[0]:
             raise ValueError(
@@ -643,16 +758,41 @@ class CompiledTrainStep:
             else:
                 vals, moved = self._spec_cache.place(batch)
             self.h2d_transfers += moved
+        if self._jitted is None:
+            if self.fp8_policy != "none" and self._fp8_states is None:
+                self._discover_fp8(vals)
+            self._build()
         self._step_i += 1
         self._key, sub = jax.random.split(self._key)
         lr = jnp.asarray(
             self.optimizer.get_lr() if self.optimizer is not None else 0.0, jnp.float32
         )
+        extended = self.fp8_policy != "none" or self._scaler is not None
         with RecordEvent("CompiledTrainStep::dispatch"):
-            loss, self._param_vals, self._opt_states = self._jitted(
-                self._param_vals, self._opt_states, vals, sub, lr,
-                jnp.asarray(self._step_i, jnp.int32),
-            )
+            if extended:
+                scale_arr = jnp.asarray(
+                    self._scaler._scale if self._scaler is not None else 1.0,
+                    jnp.float32)
+                (loss, self._param_vals, self._opt_states, new_fp8,
+                 found) = self._jitted(
+                    self._param_vals, self._opt_states, vals, sub, lr,
+                    jnp.asarray(self._step_i, jnp.int32),
+                    self._fp8_states if self._fp8_states is not None else [],
+                    scale_arr,
+                )
+                if self.fp8_policy != "none":
+                    self._fp8_states = new_fp8
+                if self._scaler is not None:
+                    # settle the scaler state machine lazily: flags are read
+                    # only once their device value is ready, so async
+                    # dispatch never blocks here (drain() settles the rest)
+                    self._pending_inf.append(found)
+                    self._settle_scaler(block=False)
+            else:
+                loss, self._param_vals, self._opt_states = self._jitted(
+                    self._param_vals, self._opt_states, vals, sub, lr,
+                    jnp.asarray(self._step_i, jnp.int32),
+                )
         # bounded run-ahead: block on the loss of step N-window before
         # returning, so at most `window` compiled steps are queued on-device
         self._window.admit(loss)
@@ -678,8 +818,77 @@ class CompiledTrainStep:
         return f
 
     def drain(self):
-        """Block until every dispatched step has executed."""
+        """Block until every dispatched step has executed (and, with a
+        grad_scaler, fold every outstanding found_inf flag into it)."""
         self._window.drain()
+        if self._scaler is not None:
+            self._settle_scaler(block=True)
+
+    # -- fp8 delayed-scaling state -------------------------------------------
+    def _discover_fp8(self, vals):
+        """One abstract trace (jax.eval_shape — no compile, no FLOPs) of the
+        loss under a recording fp8 session: counts the matmul callsites in
+        call order, noting which sit inside the scanned layer group, and
+        allocates the amax-history pytree — [H] per plain callsite, [L, H]
+        per scanned one — placed replicated on the mesh."""
+        from paddle_tpu.amp import fp8 as _fp8
+
+        holder = {}
+
+        def probe(pv, batch, key):
+            with _fp8.fp8_recording(self.fp8_policy,
+                                    self._fp8_hist_len) as rec:
+                holder["rec"] = rec
+                return self._loss_of(pv, batch, key)
+
+        jax.eval_shape(probe, self._param_vals, vals, jax.random.key(0))
+        rec = holder["rec"]
+        self._fp8_layout = list(rec.layout)
+        states = rec.init_states()
+        if self.mesh is not None:
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            states = jax.tree_util.tree_map(
+                lambda v: jax.device_put(v, repl), states)
+        self._fp8_states = states
+
+    def fp8_state_dict(self):
+        """The delayed-scaling amax state for checkpointing: the callsite
+        layout plus the history arrays (host numpy). None before the first
+        step has discovered the layout (or with fp8 off)."""
+        if self._fp8_states is None:
+            return None
+        return {"layout": [tuple(e) for e in self._fp8_layout],
+                "states": jax.tree_util.tree_map(
+                    lambda v: np.asarray(v), self._fp8_states)}
+
+    def load_fp8_state(self, snap):
+        """Restore a fp8_state_dict() snapshot (before or after the first
+        step); resuming then continues the uninterrupted amax trajectory."""
+        if snap is None:
+            return
+        self._fp8_layout = [tuple(e) for e in snap["layout"]]
+        states = snap["states"]
+        if self.mesh is not None:
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            states = jax.tree_util.tree_map(
+                lambda v: jax.device_put(jnp.asarray(v), repl), states)
+        else:
+            states = jax.tree_util.tree_map(jnp.asarray, states)
+        self._fp8_states = states
+
+    def _settle_scaler(self, block: bool):
+        """Advance the GradScaler state machine from finished steps' device
+        found_inf flags, in dispatch order. block=False only consumes flags
+        whose value is already on host-reachable (ready) buffers."""
+        while self._pending_inf:
+            f = self._pending_inf[0]
+            if not block:
+                ready = getattr(f, "is_ready", None)
+                if ready is not None and not ready():
+                    break
+            self._pending_inf.pop(0)
+            self._scaler._found_inf = bool(float(f) > 0.0)
+            self._scaler.update()
 
     def sync_params_to_model(self):
         """Write the current device arrays back into the model's Tensors

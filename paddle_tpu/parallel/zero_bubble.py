@@ -186,8 +186,18 @@ class ZBH1PipelinedStep:
                  loss_fn: Callable, mesh: Mesh | None = None,
                  num_micro: int = 2, seed: int = 0, optimizer=None,
                  debug: bool = False, remat: bool | str = False,
-                 zero_axis: str | None = None):
+                 zero_axis: str | None = None,
+                 fp8_policy: str | None = None):
+        from paddle_tpu.amp.fp8 import normalize_fp8_policy
+        from paddle_tpu.core.flags import flag
         from paddle_tpu.parallel.scan_layers import normalize_remat
+
+        # fp8: stateless current scaling (like PipelinedTrainStep) — the
+        # fp8_dot_current custom_vjp slices cleanly through the B/W jaxpr
+        # split because its backward needs only the stashed quantized
+        # operands, no cross-step state
+        self.fp8_policy = normalize_fp8_policy(
+            flag("fp8_policy") if fp8_policy is None else fp8_policy)
 
         # ZB-H1 is ZERO-recompute by construction: every residual the
         # backward needs is stashed at the F tick and replayed by the B/W
@@ -342,7 +352,10 @@ class ZBH1PipelinedStep:
             # this module's docstring describes
             return fused_head_loss(self.head, head_vals, y, labels_mb,
                                    fspec).astype(jnp.float32)
-        h = functional_call(self.head, head_vals, (Tensor(y),))
+        from paddle_tpu.amp.fp8 import head_scope
+
+        with head_scope():
+            h = functional_call(self.head, head_vals, (Tensor(y),))
         hv = h._value if isinstance(h, Tensor) else h
         loss = self.loss_fn(Tensor(hv), Tensor(labels_mb))
         return (loss._value if isinstance(loss, Tensor) else loss).astype(jnp.float32)
@@ -839,12 +852,19 @@ class ZBH1PipelinedStep:
         labels_mb = lv.reshape((self.M, mbs) + lv.shape[1:])
         extras_mb = {k: place(v).reshape((self.M, mbs) + v.shape[1:])
                      for k, v in extras.items()}
-        if self._jitted is None:
-            emb_probe = self._embed_fwd(self._embed_vals, ids_mb[0])
-            self._build(tuple(emb_probe.shape), ids_mb.dtype)
-        res = self._jitted(
-            tuple(self._stacked_blocks), tuple(self._embed_vals),
-            tuple(self._head_vals), ids_mb, labels_mb, extras_mb)
+        from paddle_tpu.amp.fp8 import fp8_execution
+
+        # the fp8 session must be live whenever the schedule TRACES (the
+        # jaxpr construction in _build and the jitted fn's first call); it
+        # is a trace-time thread-local, so steady-state dispatch pays only
+        # the context enter/exit
+        with fp8_execution(self.fp8_policy):
+            if self._jitted is None:
+                emb_probe = self._embed_fwd(self._embed_vals, ids_mb[0])
+                self._build(tuple(emb_probe.shape), ids_mb.dtype)
+            res = self._jitted(
+                tuple(self._stacked_blocks), tuple(self._embed_vals),
+                tuple(self._head_vals), ids_mb, labels_mb, extras_mb)
         loss, g_stage, g_embed, g_head = res[:4]
         if getattr(self, "_debug", False):
             self._dbg_out = res[4]

@@ -17,7 +17,7 @@ from paddle_tpu.nn.layer.layers import Layer
 __all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
            "MovingAverageAbsmaxObserver", "HistObserver",
            "AbsmaxChannelWiseObserver", "FakeQuantLayer", "QuantedLinear",
-           "quanted_linear"]
+           "quanted_linear", "quantize_weight_int8"]
 
 
 @jax.custom_vjp
@@ -27,46 +27,79 @@ def _fake_quant(x, scale):
 
 
 def _fq_fwd(x, scale):
-    return _fake_quant(x, scale), None
+    q = jnp.round(x / scale)
+    # STE with clipping: values whose quantized code saturates contribute no
+    # gradient (reference fake_quantize_* ops mask |q| > 127; a plain
+    # pass-through would keep pushing weights further past the clip range)
+    return jnp.clip(q, -127, 127) * scale, jnp.abs(q) <= 127
 
 
-def _fq_bwd(_, g):  # straight-through estimator
-    return g, None
+def _fq_bwd(mask, g):
+    return jnp.where(mask, g, jnp.zeros((), g.dtype)), None
 
 
 _fake_quant.defvjp(_fq_fwd, _fq_bwd)
 
 
 class AbsmaxObserver:
-    """reference: quantization/observers/abs_max.py."""
+    """reference: quantization/observers/abs_max.py.
+
+    The running absmax stays a DEVICE array: `observe()` per step is one
+    fused max dispatch with no host sync; only `scale()` materializes a
+    Python float (calibration reads it once per quantize call)."""
 
     def __init__(self, quant_bits=8):
         self.quant_bits = quant_bits
-        self.absmax = 0.0
+        self._absmax = None
+
+    @property
+    def absmax(self) -> float:
+        return 0.0 if self._absmax is None else float(self._absmax)
 
     def observe(self, x: Tensor):
-        self.absmax = max(self.absmax, float(jnp.abs(x._value).max()))
+        cur = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
+        self._absmax = cur if self._absmax is None else jnp.maximum(
+            self._absmax, cur)
 
     def scale(self) -> float:
         return self.absmax / (2 ** (self.quant_bits - 1) - 1) or 1e-8
 
+    def device_scale(self):
+        """The scale as a device scalar — the QAT fake-quant path consumes
+        this, so training steps never block on a device->host read."""
+        denom = 2 ** (self.quant_bits - 1) - 1
+        if self._absmax is None:
+            return jnp.float32(1e-8)
+        return jnp.maximum(self._absmax / denom, 1e-8)
+
 
 class MovingAverageAbsmaxObserver:
     """EMA absmax (reference: observers/ema.py /
-    fake_quantize_moving_average_abs_max)."""
+    fake_quantize_moving_average_abs_max). Like AbsmaxObserver, the EMA is
+    carried as a device array — no per-observe host sync."""
 
     def __init__(self, quant_bits=8, moving_rate=0.9):
         self.quant_bits = quant_bits
         self.rate = moving_rate
-        self.absmax = None
+        self._absmax = None
+
+    @property
+    def absmax(self):
+        return None if self._absmax is None else float(self._absmax)
 
     def observe(self, x: Tensor):
-        cur = float(jnp.abs(x._value).max())
-        self.absmax = cur if self.absmax is None else (
-            self.rate * self.absmax + (1 - self.rate) * cur)
+        cur = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
+        self._absmax = cur if self._absmax is None else (
+            self.rate * self._absmax + (1 - self.rate) * cur)
 
     def scale(self) -> float:
         return (self.absmax or 0.0) / (2 ** (self.quant_bits - 1) - 1) or 1e-8
+
+    def device_scale(self):
+        denom = 2 ** (self.quant_bits - 1) - 1
+        if self._absmax is None:
+            return jnp.float32(1e-8)
+        return jnp.maximum(self._absmax / denom, 1e-8)
 
 
 class HistObserver:
@@ -124,6 +157,8 @@ class AbsmaxChannelWiseObserver:
         denom = 2 ** (self.quant_bits - 1) - 1
         return jnp.maximum(self._absmax / denom, 1e-8)
 
+    device_scale = scale  # already a device array
+
 
 class QuantConfig:
     """reference: quantization/config.py — global observer defaults with
@@ -177,9 +212,15 @@ class FakeQuantLayer(Layer):
 
     def forward(self, x):
         self.a_observer.observe(x)
-        xq = apply_op(lambda v: _fake_quant(v, self.a_observer.scale()), x, name="fake_quant")
+        # device_scale keeps the whole fake-quant step on device (observers
+        # without one — HistObserver — fall back to the host float)
+        a_scale = getattr(self.a_observer, "device_scale",
+                          self.a_observer.scale)()
+        w_scale = getattr(self.w_observer, "device_scale",
+                          self.w_observer.scale)()
+        xq = apply_op(lambda v: _fake_quant(v, a_scale), x, name="fake_quant")
         w = self.inner.weight
-        wq = apply_op(lambda v: _fake_quant(v, self.w_observer.scale()), w, name="fake_quant")
+        wq = apply_op(lambda v: _fake_quant(v, w_scale), w, name="fake_quant")
         old = self.inner.weight._value
         self.inner.weight._set_value(wq._value)
         try:
@@ -267,6 +308,23 @@ class PTQ:
 
             model = copy.deepcopy(model)
         return _convert(model)
+
+
+def quantize_weight_int8(w, quant_axis=-1):
+    """Per-channel symmetric int8 weight quantization (the wo_int8 export
+    path of `jit.save`): returns ``(q_int8, scale)`` with
+    ``w ~= q.astype(f32) * scale`` and scale per `quant_axis` channel —
+    computed through AbsmaxChannelWiseObserver so export calibration and
+    QAT/PTQ share one absmax rule."""
+    arr = jnp.asarray(np.asarray(w), jnp.float32)
+    obs = AbsmaxChannelWiseObserver(quant_bits=8, quant_axis=quant_axis)
+    obs.observe(Tensor(arr))
+    scale = obs.scale()  # [channels], >= 1e-8
+    shape = [1] * arr.ndim
+    shape[quant_axis % arr.ndim] = -1
+    sc = jnp.reshape(scale, shape)
+    q = jnp.clip(jnp.round(arr / sc), -127, 127).astype(jnp.int8)
+    return np.asarray(q), np.asarray(scale, np.float32)
 
 
 def quanted_linear(x, weight, w_scale, bias=None):

@@ -96,6 +96,21 @@ def flash_interpret():
 
 
 @pytest.fixture
+def fp8_smoke():
+    """Tier-1-safe fp8 smoke path: flip the `fp8_policy` flag to 'matmuls'
+    so flag-driven step construction builds the float8 dot_general path —
+    XLA CPU executes f8E4M3FN/f8E5M2 dots via emulation, so the tier-1
+    suite exercises the SAME lowered program structure the TPU runs
+    (the fp8 analog of `flash_interpret`)."""
+    from paddle_tpu.core.flags import get_flags, set_flags
+
+    prev = get_flags("fp8_policy")["fp8_policy"]
+    set_flags({"fp8_policy": "matmuls"})
+    yield
+    set_flags({"fp8_policy": prev})
+
+
+@pytest.fixture
 def mesh8():
     """A pp2 x dp2 x mp2 mesh over the 8 virtual devices."""
     from paddle_tpu.distributed.mesh import build_mesh, set_mesh
