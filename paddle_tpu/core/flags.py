@@ -142,3 +142,15 @@ define_flag("fp8_policy", "none",
 define_flag("fp8_amax_history_len", 16,
             "delayed-scaling amax history length per fp8 matmul callsite "
             "(the scale maps max(history) to the fp8 dtype max)", type=int)
+define_flag("ckpt_fault_injection", "",
+            "elastic-checkpoint fault injection: raise (simulating a kill) "
+            "at the named commit-protocol phase boundary — one of "
+            "after_snapshot|after_shard_write|after_metadata|before_rename|"
+            "before_commit|after_commit; empty = off. Driven by the "
+            "crash-consistency tests and the bench checkpointing arm")
+define_flag("ckpt_keep_last", 3,
+            "committed elastic snapshots retained per checkpoint root "
+            "(older ones are GC'd after each commit; 0 keeps all)", type=int)
+define_flag("ckpt_every_steps", 0,
+            "hapi Model.fit(auto_checkpoint=...) cadence: async-save every "
+            "k train batches (0 = epoch ends only)", type=int)

@@ -407,6 +407,13 @@ class DistModel:
             self._step = CompiledTrainStep(
                 self.network, lambda out, lab: self._loss(out, lab),
                 self._optimizer, mesh=self._mesh, zero_axis=self._zero_axis)
+            pending = getattr(self, "_pending_resume", None)
+            if pending is not None:
+                # an elastic checkpoint restored before this lazy build left
+                # its per-step extras (rng key / step counter / fp8 amax /
+                # scaler scalars) to be applied to the step we just built
+                self._step.load_resume_extras(*pending)
+                self._pending_resume = None
         return self._step(*batch)
 
     def _sync(self):

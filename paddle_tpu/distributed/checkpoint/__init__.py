@@ -1,5 +1,13 @@
 from paddle_tpu.distributed.checkpoint.save_state_dict import save_state_dict  # noqa: F401
-from paddle_tpu.distributed.checkpoint.load_state_dict import load_state_dict  # noqa: F401
+from paddle_tpu.distributed.checkpoint.load_state_dict import (  # noqa: F401
+    load_state_dict, read_global_state,
+)
 from paddle_tpu.distributed.checkpoint.metadata import (  # noqa: F401
     LocalTensorIndex, LocalTensorMetadata, Metadata,
+)
+from paddle_tpu.distributed.checkpoint import elastic  # noqa: F401
+from paddle_tpu.distributed.checkpoint.elastic import (  # noqa: F401
+    CheckpointFaultInjected, CheckpointManager, Snapshot, capture,
+    capture_model, capture_modules, install_hang_handler,
+    install_preemption_handler, rename_arrays, restore,
 )

@@ -1,0 +1,197 @@
+"""The `.distcp` checkpoint container: JSON metadata + raw array shards.
+
+Mirrors `inference/artifact.py`'s paddle_tpu-npz1 container (the PR-6
+`.pdmodel` replacement): a pickle checkpoint executes arbitrary code embedded
+in the file on load — the classic deserialization RCE — and a half-written
+pickle stream is undetectably corrupt. This format is data-only and
+self-describing:
+
+* each rank's ``<rank>_0.distcp`` is a zip holding
+
+  - ``meta.json``       — JSON shard table: for every saved shard its tensor
+                          key, global offset, local shape and dtype, plus the
+                          member file that holds its bytes.
+  - ``shard_NNNNN.bin`` — the shard's raw little-endian array bytes,
+                          reshaped per the table. Never unpickled.
+
+* the ``<id>.metadata`` file is plain JSON (the merged global
+  :class:`Metadata` view all ranks' shard tables roll up into).
+
+Loaders REJECT legacy pickle checkpoints with an error pointing here —
+re-save with the current `save_state_dict`.
+
+Durability helpers (`fsync_file` / `fsync_dir`) live here too: the elastic
+commit protocol (checkpoint/elastic.py) requires shard bytes to be on disk
+BEFORE the rename that publishes them.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zipfile
+
+import numpy as np
+
+from paddle_tpu.distributed.checkpoint.metadata import (
+    LocalTensorIndex, LocalTensorMetadata, Metadata,
+)
+from paddle_tpu.inference.artifact import np_dtype
+
+__all__ = [
+    "FORMAT_NAME", "write_shard_file", "read_shard_file", "shard_table",
+    "write_metadata", "read_metadata", "reject_legacy_pickle",
+    "fsync_file", "fsync_dir",
+]
+
+FORMAT_NAME = "paddle_tpu-dcp1"
+
+_META = "meta.json"
+
+
+def reject_legacy_pickle(path: str):
+    """Raise on a pre-dcp1 pickle checkpoint file, pointing at re-export.
+    (pickle protocol 2+ streams start with the PROTO opcode 0x80.)"""
+    with open(path, "rb") as f:
+        head = f.read(2)
+    if head[:1] == b"\x80":
+        raise ValueError(
+            f"{path!r} is a legacy pickle checkpoint; pickle loading was "
+            f"removed from distributed/checkpoint because unpickling "
+            f"executes arbitrary code from the file. Re-save the state dict "
+            f"with the current save_state_dict to produce the safe "
+            f"'{FORMAT_NAME}' container (zip of meta.json + raw "
+            f"shard_*.bin members).")
+
+
+def _member(i: int) -> str:
+    return f"shard_{i:05d}.bin"
+
+
+def write_shard_file(path: str, shards: dict) -> None:
+    """Serialize ``{(key, global_offset): np.ndarray}`` into one container.
+    Bytes are fully flushed + fsync'd before returning (the commit protocol
+    renames this file's directory afterwards)."""
+    table = []
+    arrays = []
+    for i, ((key, off), arr) in enumerate(sorted(shards.items())):
+        arr = np.ascontiguousarray(arr)
+        table.append({
+            "key": key, "offset": [int(o) for o in off],
+            "shape": [int(d) for d in arr.shape], "dtype": str(arr.dtype),
+            "member": _member(i),
+        })
+        arrays.append(arr)
+    with open(path, "wb") as f:
+        with zipfile.ZipFile(f, "w", zipfile.ZIP_STORED) as z:
+            z.writestr(_META, json.dumps({"format": FORMAT_NAME,
+                                          "shards": table}))
+            for entry, arr in zip(table, arrays):
+                z.writestr(entry["member"], arr.tobytes())
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _read_table(path: str, z: zipfile.ZipFile) -> list:
+    meta = json.loads(z.read(_META).decode("utf-8"))
+    if meta.get("format") != FORMAT_NAME:
+        raise ValueError(
+            f"{path!r}: unsupported checkpoint shard format "
+            f"{meta.get('format')!r}; expected '{FORMAT_NAME}'")
+    return meta["shards"]
+
+
+def shard_table(path: str) -> list:
+    """The shard table of one container WITHOUT reading array bytes —
+    the coordinator merges these into the global Metadata at commit."""
+    reject_legacy_pickle(path)
+    with zipfile.ZipFile(path) as z:
+        return _read_table(path, z)
+
+
+def read_shard_file(path: str) -> dict:
+    """Load a container back into ``{(key, global_offset): np.ndarray}``.
+    Legacy pickle files raise with a re-export pointer; nothing here ever
+    unpickles."""
+    reject_legacy_pickle(path)
+    if not zipfile.is_zipfile(path):
+        raise ValueError(
+            f"{path!r} is not a '{FORMAT_NAME}' checkpoint shard container")
+    out = {}
+    with zipfile.ZipFile(path) as z:
+        for entry in _read_table(path, z):
+            raw = z.read(entry["member"])
+            arr = np.frombuffer(raw, dtype=np_dtype(entry["dtype"]))
+            out[(entry["key"], tuple(int(o) for o in entry["offset"]))] = (
+                arr.reshape([int(d) for d in entry["shape"]]))
+    return out
+
+
+def write_metadata(path: str, meta: Metadata) -> None:
+    """The global Metadata view as plain JSON (+fsync)."""
+    doc = {
+        "format": FORMAT_NAME,
+        "state": {
+            key: [{"offset": [int(o) for o in m.global_offset],
+                   "shape": [int(d) for d in m.local_shape],
+                   "dtype": m.dtype} for m in metas]
+            for key, metas in meta.state_dict_metadata.items()
+        },
+        "storage": [
+            {"key": idx.tensor_key,
+             "offset": [int(o) for o in idx.global_offset], "file": fname}
+            for idx, fname in meta.storage_metadata.items()
+        ],
+        "flat_mapping": {k: list(v) for k, v in meta.flat_mapping.items()},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def read_metadata(path: str) -> Metadata:
+    reject_legacy_pickle(path)
+    with open(path, "r") as f:
+        doc = json.load(f)
+    if doc.get("format") != FORMAT_NAME:
+        raise ValueError(
+            f"{path!r}: unsupported checkpoint metadata format "
+            f"{doc.get('format')!r}; expected '{FORMAT_NAME}'")
+    meta = Metadata()
+    for key, metas in doc.get("state", {}).items():
+        meta.state_dict_metadata[key] = [
+            LocalTensorMetadata(tuple(int(o) for o in m["offset"]),
+                                tuple(int(d) for d in m["shape"]),
+                                str(m["dtype"]))
+            for m in metas]
+    for ent in doc.get("storage", []):
+        idx = LocalTensorIndex(ent["key"],
+                               tuple(int(o) for o in ent["offset"]))
+        meta.storage_metadata[idx] = ent["file"]
+    meta.flat_mapping = {k: tuple(v)
+                         for k, v in doc.get("flat_mapping", {}).items()}
+    return meta
+
+
+def fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """Flush a directory entry (the rename itself) to disk. Some platforms
+    refuse O_RDONLY on directories; the commit protocol treats that as
+    best-effort."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

@@ -2,12 +2,16 @@
 distributed/checkpoint/load_state_dict.py). Shards are reassembled into the
 global array from metadata, then device_put with the destination tensor's
 sharding — loading under a DIFFERENT parallelism layout than the save
-(resharded resume) falls out of the global-array reconstruction."""
+(resharded resume) falls out of the global-array reconstruction.
+
+`read_global_state` exposes the reconstruction directly (every key back as a
+full numpy array): the elastic resume path (checkpoint/elastic.py) uses it to
+rebuild a training state saved under any mesh (dp width, zero3 sharded,
+scan-stacked) before re-laying it out for the target mesh."""
 from __future__ import annotations
 
 import glob
 import os
-import pickle
 
 import numpy as np
 
@@ -15,8 +19,10 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.checkpoint import format as ckpt_format
+from paddle_tpu.inference.artifact import np_dtype
 
-__all__ = ["load_state_dict"]
+__all__ = ["load_state_dict", "read_global_state", "read_checkpoint"]
 
 
 def _flatten_tensors(sd, prefix=""):
@@ -32,35 +38,56 @@ def _flatten_tensors(sd, prefix=""):
     return out
 
 
-def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
-                    unique_id=None, offload=False):
-    meta_files = glob.glob(os.path.join(path, "*.metadata"))
+def read_checkpoint(path):
+    """(Metadata, shard_data) of a checkpoint directory. Legacy pickle files
+    raise with a re-export pointer (format.reject_legacy_pickle)."""
+    meta_files = sorted(glob.glob(os.path.join(path, "*.metadata")))
     if not meta_files:
         raise FileNotFoundError(f"no .metadata in {path}")
-    with open(meta_files[0], "rb") as f:
-        meta = pickle.load(f)
+    meta = ckpt_format.read_metadata(meta_files[0])
     shard_data = {}
-    for data_file in glob.glob(os.path.join(path, "*.distcp")):
-        with open(data_file, "rb") as f:
-            shard_data.update(pickle.load(f))
+    for data_file in sorted(glob.glob(os.path.join(path, "*.distcp"))):
+        shard_data.update(ckpt_format.read_shard_file(data_file))
+    return meta, shard_data
+
+
+def reconstruct_global(metas, shard_data, key):
+    """Reassemble one key's global array from its shards. Offsets/shapes come
+    from the metadata, so a save under ANY sharding (dp=8, zero3, mp columns)
+    reads back as the one logical array."""
+    if (len(metas) == 1
+            and metas[0].global_offset == (0,) * len(metas[0].local_shape)):
+        return shard_data[(key, metas[0].global_offset)]
+    gshape = [0] * len(metas[0].local_shape)
+    for m in metas:
+        for d in range(len(gshape)):
+            gshape[d] = max(gshape[d], m.global_offset[d] + m.local_shape[d])
+    arr = np.zeros(gshape, dtype=np_dtype(metas[0].dtype))
+    for m in metas:
+        sl = tuple(slice(o, o + s)
+                   for o, s in zip(m.global_offset, m.local_shape))
+        arr[sl] = shard_data[(key, m.global_offset)]
+    return arr
+
+
+def read_global_state(path) -> dict:
+    """Every saved key reconstructed to its full (unsharded) numpy array —
+    the mesh-agnostic view elastic resume re-shards for the target layout."""
+    meta, shard_data = read_checkpoint(path)
+    return {key: reconstruct_global(metas, shard_data, key)
+            for key, metas in meta.state_dict_metadata.items()}
+
+
+def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, offload=False):
+    meta, shard_data = read_checkpoint(path)
 
     flat = _flatten_tensors(state_dict)
     for key, (parent, leaf, target) in flat.items():
         if key not in meta.state_dict_metadata:
             continue
-        metas = meta.state_dict_metadata[key]
-        # reconstruct the global array
-        if len(metas) == 1 and metas[0].global_offset == (0,) * len(metas[0].local_shape):
-            arr = shard_data[(key, metas[0].global_offset)]
-        else:
-            gshape = [0] * len(metas[0].local_shape)
-            for m in metas:
-                for d in range(len(gshape)):
-                    gshape[d] = max(gshape[d], m.global_offset[d] + m.local_shape[d])
-            arr = np.zeros(gshape, dtype=metas[0].dtype)
-            for m in metas:
-                sl = tuple(slice(o, o + s) for o, s in zip(m.global_offset, m.local_shape))
-                arr[sl] = shard_data[(key, m.global_offset)]
+        arr = reconstruct_global(meta.state_dict_metadata[key], shard_data,
+                                 key)
         if isinstance(target, Tensor):
             val = jnp.asarray(arr, target._value.dtype)
             shard = getattr(target._value, "sharding", None)

@@ -5,24 +5,27 @@ TPU-native: each host writes the shards it owns (addressable_shards of each
 jax.Array) plus a global Metadata file mapping (key, global_offset) -> data
 file. Single-host = one data file + metadata; the format round-trips through
 load_state_dict under a different sharding (resharded resume).
+
+Data lands in the pickle-free `paddle_tpu-dcp1` container (format.py): a zip
+of meta.json + raw shard_*.bin members per rank, plus a JSON .metadata file.
 """
 from __future__ import annotations
 
 import os
-import pickle
 
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.checkpoint import format as ckpt_format
 from paddle_tpu.distributed.checkpoint.metadata import (
     LocalTensorIndex, LocalTensorMetadata, Metadata,
 )
 from paddle_tpu.distributed.env import get_rank, get_world_size
 
-__all__ = ["save_state_dict"]
+__all__ = ["save_state_dict", "collect_shards", "merge_metas"]
 
 
-def _merge_metas(metas):
+def merge_metas(metas):
     merged = Metadata()
     for m in metas:
         for key, lms in m.state_dict_metadata.items():
@@ -46,46 +49,53 @@ def _flatten(sd, prefix=""):
     return out
 
 
+def collect_shards(flat: dict, fname: str):
+    """(meta, data) for this process's addressable view of a flat
+    ``key -> value`` dict: sharded jax Arrays contribute one entry per
+    addressable shard (replicated shards at the same offset deduped),
+    everything else one full-array entry at offset zero. `data` maps
+    (key, global_offset) -> np.ndarray — exactly one container file's
+    content. Shared by save_state_dict and the elastic writer."""
+    meta = Metadata()
+    data: dict = {}
+    for key, val in flat.items():
+        arr_obj = val._value if isinstance(val, Tensor) else val
+        shards = getattr(arr_obj, "addressable_shards", None)
+        if shards is not None:
+            # per-shard even when this process holds exactly ONE shard: a
+            # one-device-per-process multi-host layout must write its shard
+            # at its TRUE global offset (np.asarray on the global array
+            # would fail — it spans non-addressable devices — and an
+            # offset-zero record would collide across ranks)
+            metas = []
+            for sh in shards:
+                off = (tuple(int(s.start or 0) for s in sh.index)
+                       if sh.index else (0,) * arr_obj.ndim)
+                if any(m.global_offset == off for m in metas):
+                    continue  # replicated shard at a covered offset
+                local = np.asarray(sh.data)
+                metas.append(LocalTensorMetadata(off, tuple(local.shape),
+                                                 str(local.dtype)))
+                meta.storage_metadata[LocalTensorIndex(key, off)] = fname
+                data[(key, off)] = local
+            meta.state_dict_metadata[key] = metas
+            continue
+        arr = np.asarray(arr_obj)
+        off = (0,) * arr.ndim
+        meta.state_dict_metadata[key] = [
+            LocalTensorMetadata(off, tuple(arr.shape), str(arr.dtype))]
+        meta.storage_metadata[LocalTensorIndex(key, off)] = fname
+        data[(key, off)] = arr
+    return meta, data
+
+
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, async_save=False):
     os.makedirs(path, exist_ok=True)
     rank = get_rank()
-    flat = _flatten(state_dict)
-    meta = Metadata()
-    data: dict = {}
     fname = f"{rank}_0.distcp"
-    for key, val in flat.items():
-        if isinstance(val, Tensor):
-            arr_obj = val._value
-            # save per-shard when the value is sharded across addressable devices
-            try:
-                shards = arr_obj.addressable_shards
-            except AttributeError:
-                shards = None
-            if shards and len(shards) > 1:
-                metas = []
-                for sh in shards:
-                    off = tuple(int(s.start or 0) for s in sh.index) if sh.index else (0,) * arr_obj.ndim
-                    local = np.asarray(sh.data)
-                    lm = LocalTensorMetadata(off, tuple(local.shape), str(local.dtype))
-                    # dedupe replicated shards at the same offset
-                    if any(m.global_offset == off for m in metas):
-                        continue
-                    metas.append(lm)
-                    idx = LocalTensorIndex(key, off)
-                    meta.storage_metadata[idx] = fname
-                    data[(key, off)] = local
-                meta.state_dict_metadata[key] = metas
-                continue
-            arr = np.asarray(arr_obj)
-        else:
-            arr = np.asarray(val)
-        off = (0,) * arr.ndim
-        meta.state_dict_metadata[key] = [LocalTensorMetadata(off, tuple(arr.shape), str(arr.dtype))]
-        meta.storage_metadata[LocalTensorIndex(key, off)] = fname
-        data[(key, off)] = arr
-    with open(os.path.join(path, fname), "wb") as f:
-        pickle.dump(data, f, protocol=4)
+    meta, data = collect_shards(_flatten(state_dict), fname)
+    ckpt_format.write_shard_file(os.path.join(path, fname), data)
     world = get_world_size(process_group)
     if world > 1:
         # multi-host: each process only sees its local shards, so gather every
@@ -96,7 +106,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         # barrier ensuring all .distcp files are written first
         from paddle_tpu.distributed import multiproc
 
-        meta = _merge_metas(multiproc.exchange_objects(meta, world))
+        meta = merge_metas(multiproc.exchange_objects(meta, world))
     if rank == coordinator_rank:
-        with open(os.path.join(path, f"{unique_id or 0}.metadata"), "wb") as f:
-            pickle.dump(meta, f, protocol=4)
+        ckpt_format.write_metadata(
+            os.path.join(path, f"{unique_id or 0}.metadata"), meta)

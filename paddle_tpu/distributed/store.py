@@ -194,16 +194,27 @@ class TCPStore:
         return st == 0
 
     def wait(self, keys, timeout: float | None = None):
-        tmo = int((timeout or self.timeout_ms / 1000.0) * 1000)
+        """Block until every key exists. ONE deadline is shared across all
+        keys (a dead peer costs `timeout` total, not timeout-per-key), and a
+        timeout names EXACTLY which keys never arrived (and which did) — on
+        a pod that's the difference between 'rendezvous timed out' and
+        knowing which host is dead."""
+        total_s = timeout or self.timeout_ms / 1000.0
+        deadline = time.time() + total_s
         if isinstance(keys, str):
             keys = [keys]
-        outs = []
+        outs, missing = [], []
         for key in keys:
+            # after the deadline each remaining key still gets a quick
+            # existence probe, so the error lists ALL missing keys
+            tmo = max(int((deadline - time.time()) * 1000), 1)
             if self._native is not None:
                 buf = (ctypes.c_uint8 * (1 << 20))()
                 n = self._native.pt_store_wait(self._client, key.encode(), tmo, buf, len(buf))
                 if n == -1:
-                    raise TimeoutError(f"TCPStore wait timed out on '{key}'")
+                    missing.append(key)
+                    outs.append(None)
+                    continue
                 if n == -3:
                     # value exceeded the buffer — the wait succeeded, so the
                     # key now exists; re-read through the growing-get path
@@ -215,15 +226,42 @@ class TCPStore:
             else:
                 st, out = self._client.request(3, key, struct.pack("<q", tmo))
                 if st != 0:
-                    raise TimeoutError(f"TCPStore wait timed out on '{key}'")
+                    missing.append(key)
+                    outs.append(None)
+                    continue
                 outs.append(out)
+        if missing:
+            arrived = [k for k, o in zip(keys, outs) if o is not None]
+            raise TimeoutError(
+                f"TCPStore wait timed out after {total_s:.1f}s: "
+                f"missing keys {missing}"
+                + (f" (arrived: {arrived})" if arrived else ""))
         return outs[0] if len(outs) == 1 else outs
 
-    def barrier(self, name: str, world_size: int, timeout: float = 300.0):
+    def barrier(self, name: str, world_size: int, timeout: float = 300.0,
+                rank: int | None = None):
+        """All-arrive barrier. With `rank` given, each participant also
+        marks a per-rank key, so a timeout reports WHICH ranks never showed
+        up instead of only how many."""
         n = self.add(f"__barrier__/{name}", 1)
+        if rank is not None:
+            self.set(f"__barrier_arrived__/{name}/{rank}", b"1")
         if n == world_size:
             self.set(f"__barrier_done__/{name}", b"1")
-        self.wait(f"__barrier_done__/{name}", timeout)
+        try:
+            self.wait(f"__barrier_done__/{name}", timeout)
+        except TimeoutError:
+            arrived_n = struct.unpack(
+                "<q", self.get(f"__barrier__/{name}", b"\0" * 8))[0]
+            detail = f"{arrived_n}/{world_size} ranks arrived"
+            if rank is not None:
+                present = [r for r in range(world_size) if self.get(
+                    f"__barrier_arrived__/{name}/{r}") is not None]
+                absent = [r for r in range(world_size) if r not in present]
+                detail += f"; missing ranks {absent} (arrived: {present})"
+            raise TimeoutError(
+                f"TCPStore barrier '{name}' timed out after {timeout:.1f}s: "
+                f"{detail}") from None
 
     def close(self):
         if self._native is not None:
@@ -238,19 +276,38 @@ class TCPStore:
 
 
 class _PyClient:
+    # connect backoff: first retry after INITIAL_BACKOFF_S, doubling to
+    # MAX_BACKOFF_S — a dead master fails fast-ish with few syscalls instead
+    # of a tight 20-attempts-per-second connect loop hammering the host,
+    # and each attempt's own timeout is bounded by the remaining deadline
+    INITIAL_BACKOFF_S = 0.05
+    MAX_BACKOFF_S = 2.0
+
     def __init__(self, host, port, timeout_ms):
         deadline = time.time() + timeout_ms / 1000.0
+        backoff = self.INITIAL_BACKOFF_S
+        attempts = 0
         last = None
-        while time.time() < deadline:
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0 and attempts > 0:
+                break
+            attempts += 1
             try:
-                self.sock = socket.create_connection((host, port), timeout=5)
+                self.sock = socket.create_connection(
+                    (host, port), timeout=max(min(remaining, 5.0), 0.05))
                 self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._lock = threading.Lock()
                 return
             except OSError as e:
                 last = e
-                time.sleep(0.05)
-        raise ConnectionError(f"TCPStore: cannot reach {host}:{port}: {last}")
+                time.sleep(min(backoff, max(deadline - time.time(), 0)))
+                backoff = min(backoff * 2, self.MAX_BACKOFF_S)
+        raise ConnectionError(
+            f"TCPStore: cannot reach {host}:{port} after {attempts} "
+            f"attempts over {timeout_ms / 1000.0:.1f}s "
+            f"(exponential backoff {self.INITIAL_BACKOFF_S}s->"
+            f"{self.MAX_BACKOFF_S}s): {last}")
 
     def request(self, op, key, val):
         kb = key.encode()

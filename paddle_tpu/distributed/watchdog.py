@@ -53,6 +53,9 @@ class CommTaskManager:
         self._thread: threading.Thread | None = None
         self.last_completed: CommTask | None = None
         self.hangs: list[CommTask] = []
+        # hang listeners receive (task, diagnostics-dict) AFTER on_hang —
+        # the elastic checkpointer's save-and-exit hook registers here
+        self._listeners: list[Callable] = []
 
     @staticmethod
     def _default_on_hang(task: CommTask):
@@ -87,6 +90,14 @@ class CommTaskManager:
                 elif t.timed_out():
                     self.hangs.append(t)
                     self.on_hang(t)
+                    diag = self.diagnostics(t)
+                    for fn in list(self._listeners):
+                        try:
+                            fn(t, diag)
+                        except Exception:  # a broken listener must not
+                            import traceback  # kill the watchdog loop
+
+                            traceback.print_exc()
                     with self._lock:
                         self._tasks.pop(t.task_id, None)
 
@@ -97,6 +108,29 @@ class CommTaskManager:
                          timeout_s or self.default_timeout)
             self._tasks[t.task_id] = t
         return t
+
+    def diagnostics(self, task: CommTask | None = None) -> dict:
+        """Structured hang report: the hung task (name/elapsed/timeout),
+        the LAST COMPLETED step, every in-flight task's name+elapsed, and
+        the hang history — what a dead pod's post-mortem needs, as data
+        rather than a log line."""
+        with self._lock:
+            in_flight = [
+                {"id": t.task_id, "name": t.name,
+                 "elapsed_s": round(t.elapsed(), 2),
+                 "timeout_s": t.timeout_s, "done": t.done.is_set()}
+                for t in self._tasks.values()]
+        diag = {
+            "task": ({"id": task.task_id, "name": task.name,
+                      "elapsed_s": round(task.elapsed(), 2),
+                      "timeout_s": task.timeout_s} if task else None),
+            "last_completed": ({"id": self.last_completed.task_id,
+                                "name": self.last_completed.name}
+                               if self.last_completed else None),
+            "in_flight": in_flight,
+            "hang_count": len(self.hangs),
+        }
+        return diag
 
 
 _manager = CommTaskManager()
@@ -133,27 +167,19 @@ def _dump_path():
 
 def dump_state(manager: CommTaskManager | None = None) -> dict:
     """Per-collective state dump (reference CommTaskManager async debug
-    report, comm_task_manager.h:37): every in-flight task with name/elapsed,
-    the last completed task, and recorded hangs. Written as JSON next to the
-    logs on hang so a dead job leaves a diagnosable artifact."""
+    report, comm_task_manager.h:37): the structured diagnostics (in-flight
+    tasks with name/elapsed, last completed) plus pid and the hang history.
+    Written as JSON next to the logs on hang so a dead job leaves a
+    diagnosable artifact."""
     import json
 
     mgr = manager or _manager
-    with mgr._lock:
-        in_flight = [
-            {"id": t.task_id, "name": t.name, "elapsed_s": round(t.elapsed(), 2),
-             "timeout_s": t.timeout_s, "done": t.done.is_set()}
-            for t in mgr._tasks.values()
-        ]
-    state = {
-        "pid": __import__("os").getpid(),
-        "in_flight": in_flight,
-        "last_completed": ({"id": mgr.last_completed.task_id,
-                            "name": mgr.last_completed.name}
-                           if mgr.last_completed else None),
-        "hangs": [{"id": t.task_id, "name": t.name,
-                   "elapsed_s": round(t.elapsed(), 2)} for t in mgr.hangs],
-    }
+    state = mgr.diagnostics()
+    state.pop("task", None)  # no single hung task in a full dump
+    state["pid"] = __import__("os").getpid()
+    state["hangs"] = [{"id": t.task_id, "name": t.name,
+                       "elapsed_s": round(t.elapsed(), 2)}
+                      for t in mgr.hangs]
     try:
         with open(_dump_path(), "w") as f:
             json.dump(state, f, indent=2)
@@ -171,5 +197,23 @@ def _on_hang_with_dump(task: CommTask):
           f"in-flight) written to {_dump_path()}", file=sys.stderr)
 
 
+def add_hang_listener(fn: Callable, manager: CommTaskManager | None = None):
+    """Register `fn(task, diagnostics_dict)` to fire after a hang is
+    detected (diagnostics: CommTaskManager.diagnostics — hung task, last
+    completed step, in-flight names, elapsed). Returns an uninstall
+    callable. The elastic checkpointer's save-and-exit hook
+    (checkpoint.elastic.install_hang_handler) registers through here."""
+    mgr = manager or _manager
+    mgr._listeners.append(fn)
+
+    def uninstall():
+        try:
+            mgr._listeners.remove(fn)
+        except ValueError:
+            pass
+
+    return uninstall
+
+
 _manager.on_hang = _on_hang_with_dump
-__all__ += ["dump_state"]
+__all__ += ["dump_state", "add_hang_listener"]

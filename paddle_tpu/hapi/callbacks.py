@@ -1,5 +1,5 @@
 from paddle_tpu.hapi.model import (  # noqa: F401
-    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    ReduceLROnPlateau,
+    AutoCheckpoint, Callback, EarlyStopping, LRScheduler, ModelCheckpoint,
+    ProgBarLogger, ReduceLROnPlateau,
 )
 from paddle_tpu.utils.log_writer import VisualDLCallback  # noqa: F401

@@ -12,7 +12,8 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.io import DataLoader, Dataset
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler", "ReduceLROnPlateau"]
+           "AutoCheckpoint", "EarlyStopping", "LRScheduler",
+           "ReduceLROnPlateau"]
 
 
 class Callback:
@@ -92,6 +93,11 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
+    """Epoch-granular `Model.save` snapshots (reference hapi ModelCheckpoint).
+    For crash-consistent, async, resumable checkpoints use `AutoCheckpoint`
+    (or `fit(auto_checkpoint=dir)`), which runs the elastic commit
+    protocol instead of plain file writes."""
+
     def __init__(self, save_freq=1, save_dir=None):
         self.save_freq = save_freq
         self.save_dir = save_dir
@@ -99,6 +105,141 @@ class ModelCheckpoint(Callback):
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and epoch % self.save_freq == 0:
             self.model.save(f"{self.save_dir}/epoch_{epoch}")
+
+
+class AutoCheckpoint(Callback):
+    """Elastic auto-checkpointing for `Model.fit` (the
+    `fit(auto_checkpoint=dir)` surface).
+
+    * on_train_begin: resumes network + optimizer moments + step count from
+      the latest COMMITTED snapshot under `save_dir` (epoch-granular cursor
+      -> `fit` skips finished epochs), and installs a SIGTERM save-and-exit
+      handler (preempted pods lose at most the save cadence).
+    * on_train_batch_end: every `every_steps` batches (FLAGS_ckpt_every_steps;
+      0 = epoch ends only) an ASYNC save — on the compiled/mesh path the
+      snapshot is captured straight from the compiled step's device arrays
+      (donation-safe copies, no host sync; the writer thread does the
+      readback), so the dispatch stream is never blocked.
+    * a watchdog hang or SIGTERM sets `stop_training`; the fit loop exits
+      mid-epoch after the save.
+
+    Every save runs the crash-consistent commit protocol
+    (distributed.checkpoint.elastic): a kill at any point leaves the
+    previous committed snapshot loadable."""
+
+    def __init__(self, save_dir, every_steps=None, keep_last=None,
+                 install_sigterm=True):
+        from paddle_tpu.core.flags import flag as _flag
+
+        self.save_dir = save_dir
+        self.every_steps = int(_flag("ckpt_every_steps")
+                               if every_steps is None else every_steps)
+        self.keep_last = keep_last
+        self.install_sigterm = install_sigterm
+        self.manager = None
+        self.initial_epoch = 0
+        self.stop_training = False
+        self.resumed_meta = None
+        self._uninstall = None
+        self._epoch = 0
+        self._it = 0
+        self._last_saved = None
+
+    def _capture(self):
+        from paddle_tpu.distributed.checkpoint import elastic
+
+        cursor = {"epoch": self._epoch, "iteration": self._it}
+        dm = getattr(self.model, "_dist_model", None)
+        if dm is not None and getattr(dm, "_step", None) is not None:
+            return elastic.capture(dm._step, cursor=cursor)
+        self.model._sync_dist()
+        return elastic.capture_model(self.model.network,
+                                     self.model._optimizer, cursor=cursor)
+
+    def _save(self, sync=False):
+        snap = self._capture()
+        # an epoch-end save right after a cadence save would re-commit the
+        # same train step — the protocol (rightly) rejects that
+        if snap.step == self._last_saved:
+            return
+        self._last_saved = snap.step
+        try:
+            if sync:
+                self.manager.save(snap)
+            else:
+                self.manager.save_async(snap)
+        except FileExistsError:
+            # e.g. the SIGTERM handler's sync save already committed this
+            # exact step — the state IS durable, keep winding down
+            pass
+
+    def on_train_begin(self, logs=None):
+        from paddle_tpu.distributed.checkpoint import elastic
+
+        self.manager = elastic.CheckpointManager(self.save_dir,
+                                                 keep_last=self.keep_last)
+        latest = self.manager.latest()
+        if latest is not None:
+            arrays, meta = self.manager.load(latest)
+            elastic.restore(arrays, meta, self.model.network,
+                            self.model._optimizer)
+            dm = getattr(self.model, "_dist_model", None)
+            if dm is not None:
+                # the compiled step (re)builds lazily on the first train
+                # batch from the RESTORED network/optimizer; a live step
+                # from an earlier fit holds stale device params, so drop it
+                # rather than train pre-restore weights. The extras (rng/
+                # step/fp8/scaler) are parked for DistModel to apply then.
+                dm._step = None
+                dm._pending_resume = (arrays, meta)
+            self.resumed_meta = meta
+            self._last_saved = int(meta.get("step", 0))
+            cursor = meta.get("cursor") or {}
+            # epoch-granular data resume: an epoch-end snapshot restarts at
+            # the NEXT epoch, a mid-epoch one replays its epoch's data
+            self.initial_epoch = int(cursor.get("epoch", 0)) + (
+                1 if cursor.get("epoch_end") else 0)
+            self._epoch = self.initial_epoch
+        if self.install_sigterm:
+            self._uninstall = elastic.install_preemption_handler(
+                self.manager, self._capture)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        # mid-epoch cadence saves must record the epoch actually running
+        # (a resumed fit starts at initial_epoch, not 0)
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        self._it += 1
+        if self.manager is None:
+            return
+        if self.manager.should_stop:
+            self._save(sync=True)
+            self.stop_training = True
+            return
+        if self.every_steps and self._it % self.every_steps == 0:
+            self._save()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epoch = epoch + 1
+        if self.manager is not None:
+            snap = self._capture()
+            snap.meta.setdefault("cursor", {})["epoch_end"] = True
+            snap.meta["cursor"]["epoch"] = epoch
+            if snap.step != self._last_saved:
+                self._last_saved = snap.step
+                self.manager.save_async(snap)
+
+    def on_train_end(self, logs=None):
+        if self._uninstall is not None:
+            self._uninstall()
+            self._uninstall = None
+        if self.manager is not None:
+            try:
+                self.manager.wait()
+            except FileExistsError:
+                pass  # a duplicate-step async save: state is durable
+            self.manager.close()
 
 
 class EarlyStopping(Callback):
@@ -306,7 +447,8 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None,
-            prefetch_to_device=None, metrics_sync_every=None):
+            prefetch_to_device=None, metrics_sync_every=None,
+            auto_checkpoint=None):
         """reference: hapi/model.py:1750.
 
         Async input/dispatch pipeline (compiled/mesh path only, and only when
@@ -318,7 +460,13 @@ class Model:
         between reads callbacks see the most recent synced value, so a
         larger k trades metric freshness for an unbroken dispatch stream).
         Per-step losses are unchanged by either knob — only WHEN they are
-        read moves."""
+        read moves.
+
+        auto_checkpoint: a directory (or a configured AutoCheckpoint
+        callback) enabling crash-consistent elastic checkpointing: resume
+        from the latest committed snapshot, async saves every
+        FLAGS_ckpt_every_steps batches + every epoch end, SIGTERM
+        save-and-exit (docs/elastic_checkpoint.md)."""
         from paddle_tpu.core.flags import flag as _flag
 
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
@@ -338,6 +486,10 @@ class Model:
             cbs.append(ProgBarLogger(log_freq, verbose))
         if save_dir:
             cbs.append(ModelCheckpoint(save_freq, save_dir))
+        if auto_checkpoint is not None:
+            cbs.append(auto_checkpoint
+                       if isinstance(auto_checkpoint, AutoCheckpoint)
+                       else AutoCheckpoint(auto_checkpoint))
         try:
             n_steps = len(loader)
         except TypeError:
@@ -349,8 +501,13 @@ class Model:
         history = []
         for cb in cbs:
             cb.on_train_begin()
+        # an AutoCheckpoint that resumed from an epoch-end snapshot skips
+        # the finished epochs (epoch-granular data cursor)
+        start_epoch = max((getattr(cb, "initial_epoch", 0) for cb in cbs),
+                          default=0)
         it = 0
-        for epoch in range(epochs):
+        stop_now = False
+        for epoch in range(start_epoch, epochs):
             for m in self._metrics:
                 m.reset()
             for cb in cbs:
@@ -387,7 +544,11 @@ class Model:
                     for cb in cbs:
                         cb.on_train_batch_end(step, logs)
                     it += 1
-                    if num_iters and it >= num_iters:
+                    # preemption (SIGTERM / watchdog hang): the callback
+                    # saved; exit MID-epoch instead of finishing it
+                    stop_now = any(getattr(cb, "stop_training", False)
+                                   for cb in cbs)
+                    if stop_now or (num_iters and it >= num_iters):
                         break
             finally:
                 if feeder is not None:
@@ -405,7 +566,7 @@ class Model:
             for cb in cbs:
                 cb.on_epoch_end(epoch, logs)
             history.append(logs)
-            if any(getattr(cb, "stopped", False) for cb in cbs):
+            if stop_now or any(getattr(cb, "stopped", False) for cb in cbs):
                 break
             if num_iters and it >= num_iters:
                 break

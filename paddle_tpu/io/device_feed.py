@@ -268,6 +268,10 @@ class DeviceFeeder:
         self.spec_cache = BatchSpecCache(mesh, batch_spec)
         self.batches_placed = 0  # diagnostics
         self.leaves_transferred = 0
+        # the data CURSOR an elastic checkpoint records: batches the
+        # CONSUMER took (prefetched-but-unconsumed batches must be replayed
+        # after a resume, so `batches_placed` would over-count)
+        self.batches_consumed = 0
         self._it = iter(iterator)
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._err: BaseException | None = None
@@ -321,6 +325,7 @@ class DeviceFeeder:
                 self._err = None
                 raise err
             raise StopIteration
+        self.batches_consumed += 1
         return item
 
     def close(self):

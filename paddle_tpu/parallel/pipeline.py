@@ -309,8 +309,15 @@ class PipelinedTrainStep:
 
         self._opt_states = None
         if optimizer is not None:
+            # resume path: a restored optimizer._state (elastic checkpoint /
+            # set_state_dict) seeds the moments instead of zero re-init
             self._opt_states = init_opt_states(
-                optimizer, self._embed_vals + self._stacked_blocks + self._head_vals)
+                optimizer,
+                self._embed_vals + self._stacked_blocks + self._head_vals,
+                params=(self._embed_params
+                        + [None] * len(self._stacked_blocks)
+                        + self._head_params),
+                block_params=self._block_params, stack=self._stack)
 
         self._jitted = None
 
@@ -686,6 +693,18 @@ class PipelinedTrainStep:
         # [S, V, bpc, ...] -> layer l = position*bpc + i, position = c*S + r
         return jnp.moveaxis(arr, 1, 0).reshape(
             (self.S * self.blocks_per_stage,) + arr.shape[3:])
+
+    def _stack(self, vals):
+        """[n_layers] per-layer arrays -> the __init__ stacked block layout
+        (the inverse of `_unstack`; resumed optimizer moments go through
+        here)."""
+        bpc = (self.S * self.blocks_per_stage) // (self.S * self.V)
+        arr = jnp.stack(list(vals))
+        if self.V == 1:
+            return arr.reshape((self.S, self.blocks_per_stage)
+                               + arr.shape[1:])
+        arr = arr.reshape((self.V, self.S, bpc) + arr.shape[1:])
+        return jnp.moveaxis(arr, 1, 0)
 
     def sync_params_to_model(self):
         for p, v in zip(self._embed_params, self._embed_vals):

@@ -70,13 +70,75 @@ def sync_pipeline_states_to_optimizer(optimizer, states, embed_params,
     opt._step_count = step_i
 
 
-def init_opt_states(optimizer, vals):
+def init_opt_states(optimizer, vals, params=None, block_params=None,
+                    stack=None):
     """Per-array optimizer state, co-located with its (sharded) value —
-    shared by the compiled pipeline runtimes."""
+    shared by the compiled pipeline runtimes.
+
+    With `params`/`block_params`/`stack`, entries RESUME from a loaded
+    checkpoint's optimizer._state instead of starting from zero moments:
+    `params` aligns embed/head entries with their Parameter (None marks a
+    stacked block column), `block_params[l][i]` is layer l's parameter behind
+    stacked column i, and `stack` maps the per-layer state arrays into the
+    runtime's stacked block layout (the inverse of its `_unstack`). Columns
+    whose per-layer states are missing or mismatched re-init fresh — the same
+    granularity as CompiledTrainStep._resume_states."""
+    existing = getattr(optimizer, "_state", {}) if params is not None else {}
     states = []
-    for v in vals:
-        st = optimizer._init_state(Tensor(v))
-        st = {k: jax.device_put(s, v.sharding) for k, s in st.items()}
+    col_i = 0
+
+    def _shapes_ok(st, v):
+        # a stale-shaped moment (e.g. a resized embedding) must re-init
+        # fresh, not explode later inside the optimizer update
+        return all(tuple(np.shape(s)) in ((), tuple(v.shape))
+                   for s in st.values())
+
+    for idx, v in enumerate(vals):
+        p = params[idx] if params is not None else None
+        st = None
+        if p is not None:
+            saved = existing.get(id(p))
+            if saved:
+                st = dict(saved)
+        elif params is not None and block_params is not None:
+            col = [bp[col_i] for bp in block_params]
+            col_i += 1
+            sts = [existing.get(id(cp)) for cp in col]
+            if any(s is not None for s in sts) and stack is not None:
+                if (all(s is not None for s in sts)
+                        and len({frozenset(s) for s in sts}) == 1):
+                    try:
+                        st = {k: stack([jnp.asarray(s[k]) for s in sts])
+                              for k in sts[0]}
+                    except (ValueError, TypeError):
+                        import warnings
+
+                        # heterogeneous per-layer shapes cannot stack —
+                        # same warn-and-reinit contract as below
+                        warnings.warn(
+                            "pipeline resume: per-layer optimizer state "
+                            "shapes are heterogeneous; reinitializing the "
+                            "stacked entry's moments from zero")
+                        st = None
+                else:
+                    import warnings
+
+                    warnings.warn(
+                        "pipeline resume: per-layer optimizer states are "
+                        "incomplete or have mismatched keys; reinitializing "
+                        "the stacked entry's moments from zero")
+        if st is not None and not _shapes_ok(st, v):
+            import warnings
+
+            warnings.warn(
+                "pipeline resume: restored optimizer state shapes do not "
+                "match the parameter; reinitializing that entry's moments "
+                "from zero")
+            st = None
+        if st is None:
+            st = optimizer._init_state(Tensor(v))
+        st = {k: jax.device_put(jnp.asarray(s), v.sharding)
+              for k, s in st.items()}
         states.append(st)
     return states
 
@@ -917,6 +979,107 @@ class CompiledTrainStep:
             for l, p in enumerate(col):
                 opt._state[id(p)] = {k: v[l] for k, v in st.items()}
         opt._step_count = self._step_i
+
+    # -- elastic checkpoint interface ----------------------------------------
+    def _live_param_map(self):
+        """id(parameter) -> its CURRENT device array. Group-column entries
+        are lazy slices of the stacked [L, ...] arrays (async dispatch, no
+        host sync); model buffers are not included (their Tensors are live)."""
+        live = {}
+        n_outer = len(self._outer_params)
+        for p, v in zip(self._outer_params, self._param_vals[:n_outer]):
+            live[id(p)] = v
+        for col, sv in zip(self._group_cols, self._param_vals[n_outer:]):
+            for l, p in enumerate(col):
+                live[id(p)] = sv[l]
+        return live
+
+    def named_train_state(self):
+        """(arrays, meta) for elastic checkpointing — the full training state
+        under MESH-AGNOSTIC names, without a single host sync:
+
+        * ``model/<state-dict name>`` — every model param (split per layer
+          from the scan stack, so scan on/off saves look identical) + buffer,
+          as live device arrays;
+        * ``opt/<state-dict name>/<slot>`` — optimizer moments keyed by the
+          owning parameter's NAME (not its position), so a pipeline runtime
+          with a different parameter order resumes the same moments;
+        * ``rng/key`` — the step's PRNG key data (the dropout trajectory
+          continues bit-exactly across a resume);
+        * meta: step count, fp8 callsite layout (+ ``fp8/<i>/<slot>`` amax
+          histories in arrays), GradScaler scalars.
+
+        The returned arrays may still be computing and WILL be invalidated by
+        the next step's buffer donation — `checkpoint.elastic.capture` makes
+        donation-safe device copies before the writer thread reads them.
+        GradScaler scalars reflect the last SETTLED step (drain() first for
+        exactness — the documented async-AMP lag)."""
+        live = self._live_param_map()
+        id2name = {}
+        arrays = {}
+        for name, t in self.model.state_dict().items():
+            arrays[f"model/{name}"] = live.get(id(t), t._value)
+            id2name[id(t)] = name
+        if self._opt_states is not None:
+            n_outer = len(self._outer_params)
+            for p, st in zip(self._outer_params, self._opt_states[:n_outer]):
+                name = id2name.get(id(p))
+                if name is None:
+                    continue
+                for k, v in st.items():
+                    arrays[f"opt/{name}/{k}"] = v
+            for col, st in zip(self._group_cols,
+                               self._opt_states[n_outer:]):
+                for l, p in enumerate(col):
+                    name = id2name.get(id(p))
+                    if name is None:
+                        continue
+                    for k, v in st.items():
+                        arrays[f"opt/{name}/{k}"] = v[l]
+        arrays["rng/key"] = jax.random.key_data(self._key)
+        meta = {"step": int(self._step_i)}
+        if self._fp8_states is not None:
+            meta["fp8_layout"] = [list(e) for e in self._fp8_layout]
+            flat = jax.tree_util.tree_leaves(self._fp8_states)
+            meta["fp8_leaves"] = len(flat)
+            for i, leaf in enumerate(flat):
+                arrays[f"fp8/{i:05d}"] = leaf
+        if self._scaler is not None:
+            meta["scaler"] = dict(self._scaler.state_dict())
+        return arrays, meta
+
+    def load_resume_extras(self, arrays, meta):
+        """Restore the per-step extras a plain (model, optimizer) state-dict
+        load cannot carry: RNG key, step counter, fp8 amax histories, and
+        GradScaler scalars. Params/moments flow through
+        `checkpoint.elastic.restore` BEFORE constructing the step (the
+        constructor re-shards them for the target mesh)."""
+        if "rng/key" in arrays:
+            self._key = jax.random.wrap_key_data(
+                jnp.asarray(np.asarray(arrays["rng/key"])))
+        if "step" in meta:
+            self._step_i = int(meta["step"])
+            if self.optimizer is not None:
+                _innermost_opt(self.optimizer)._step_count = self._step_i
+        if meta.get("fp8_layout") is not None and self.fp8_policy != "none":
+            n = int(meta.get("fp8_leaves", 0))
+            leaves = [np.asarray(arrays[f"fp8/{i:05d}"]) for i in range(n)]
+            # rebuild the callsite-state pytree: layout entries expand to one
+            # {x,w,g} dict per callsite (scan entries carry k callsites)
+            from paddle_tpu.amp.fp8 import STATE_KEYS
+
+            # tree_leaves flattened each callsite dict in sorted-key order;
+            # rebuild with the same ordering
+            states, it = [], iter(leaves)
+            for e in meta["fp8_layout"]:
+                count = 1 if e[0] == "plain" else int(e[2])
+                for _ in range(count):
+                    states.append({k: next(it) for k in sorted(STATE_KEYS)})
+            self.load_fp8_state({"layout": [tuple(e) for e in
+                                            meta["fp8_layout"]],
+                                 "states": states})
+        if meta.get("scaler") is not None and self._scaler is not None:
+            self._scaler.load_state_dict(dict(meta["scaler"]))
 
     @property
     def step_count(self):

@@ -320,9 +320,15 @@ class ZBH1PipelinedStep:
         if optimizer is not None:
             from paddle_tpu.parallel.train_step import init_opt_states
 
+            # resume path: a restored optimizer._state (elastic checkpoint /
+            # set_state_dict) seeds the moments instead of zero re-init
             self._opt_states = init_opt_states(
                 optimizer,
-                self._embed_vals + self._stacked_blocks + self._head_vals)
+                self._embed_vals + self._stacked_blocks + self._head_vals,
+                params=(self._embed_params
+                        + [None] * len(self._stacked_blocks)
+                        + self._head_params),
+                block_params=self._block_params, stack=self._stack)
 
     # -- pure per-rank compute pieces ---------------------------------------
 
@@ -931,6 +937,12 @@ class ZBH1PipelinedStep:
 
     def _unstack(self, arr):
         return arr.reshape((self.S * self.bps,) + arr.shape[2:])
+
+    def _stack(self, vals):
+        """[n_layers] per-layer arrays -> [S, bps, ...] (inverse of
+        `_unstack`; resumed optimizer moments go through here)."""
+        arr = jnp.stack(list(vals))
+        return arr.reshape((self.S, self.bps) + arr.shape[1:])
 
     def sync_states_to_optimizer(self):
         """Checkpoint parity (see train_step.sync_pipeline_states_to_optimizer)."""

@@ -57,11 +57,12 @@ def _seed():
 
 @pytest.fixture(autouse=True)
 def _thread_hygiene():
-    """Tier-1 guard: DataLoader/DeviceFeeder prefetch threads must not leak
-    across tests. Every paddle_tpu.io background thread carries the
-    "paddle_tpu.io" name prefix and is joined on close/exhaustion; a test
-    that strands one fails here instead of poisoning the rest of the
-    suite."""
+    """Tier-1 guard: DataLoader/DeviceFeeder prefetch threads AND the
+    elastic-checkpoint writer thread must not leak across tests. Every
+    paddle_tpu.io background thread carries the "paddle_tpu.io" name prefix,
+    the checkpoint writer carries "paddle_tpu.ckpt"; both are joined on
+    close/exhaustion — a test that strands one fails here instead of
+    poisoning the rest of the suite."""
     import threading
     import time
 
@@ -71,7 +72,7 @@ def _thread_hygiene():
 
     def leaked():
         return [t for t in threading.enumerate()
-                if t.name.startswith("paddle_tpu.io")
+                if t.name.startswith(("paddle_tpu.io", "paddle_tpu.ckpt"))
                 and t not in before and t.is_alive()]
 
     yield
