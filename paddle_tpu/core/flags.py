@@ -154,3 +154,39 @@ define_flag("ckpt_keep_last", 3,
 define_flag("ckpt_every_steps", 0,
             "hapi Model.fit(auto_checkpoint=...) cadence: async-save every "
             "k train batches (0 = epoch ends only)", type=int)
+define_flag("serving_page_size", 16,
+            "KV-cache page size in tokens (block granularity of the paged "
+            "decode-attention kernel and the serving allocator)", type=int)
+define_flag("serving_num_pages", 0,
+            "total KV-cache pages in the serving pool (page 0 is the "
+            "reserved null page); 0 = derive from serving_hbm_budget_mb "
+            "and the model geometry", type=int)
+define_flag("serving_hbm_budget_mb", 64,
+            "HBM budget for the paged KV cache when serving_num_pages=0: "
+            "the pool is sized to the largest page count whose K+V bytes "
+            "across all layers fit the budget", type=int)
+define_flag("serving_decode_batch", 8,
+            "fixed decode-batch width of the serving engine: every decode "
+            "step runs this many slots (inactive ones masked), so the "
+            "compiled step has ONE signature and never retraces", type=int)
+define_flag("serving_prefill_chunk", 256,
+            "max tokens per prefill chunk; prompts longer than this run "
+            "through the flash kernel in several page-writing chunks "
+            "(bounds per-admission latency and the compile bucket set)",
+            type=int)
+define_flag("serving_max_seq_len", 0,
+            "max context length (prompt + generated) a served request may "
+            "reach; 0 = the model's max_position_embeddings. Sets "
+            "pages_per_seq = ceil(max_seq_len / page_size)", type=int)
+define_flag("serving_queue_limit", 32,
+            "bounded HTTP request queue: connections beyond this many "
+            "in-flight handler threads are answered 503 instead of "
+            "head-of-line blocking the listener", type=int)
+define_flag("serving_request_timeout_s", 60.0,
+            "per-request wall-clock budget of the HTTP front-end; a /run "
+            "or /generate exceeding it is cut off with 503/timeout event",
+            type=float)
+define_flag("serving_max_body_mb", 8,
+            "Content-Length cap of the HTTP front-end (413 past it; "
+            "chunked/unknown-length bodies are rejected with 411)",
+            type=int)

@@ -38,7 +38,7 @@ import time
 
 import numpy as np
 
-__all__ = ["Artifact", "main"]
+__all__ = ["Artifact", "build_http_server", "main"]
 
 
 _SYNTH_DIM = 1  # symbolic/batch dims synthesize at 1 for warmup/bench
@@ -141,18 +141,100 @@ class Artifact:
                 "p99_ms": pct(99), "platform": self.platform}
 
 
-def _serve_http(artifact: Artifact, port: int):
-    from http.server import BaseHTTPRequestHandler, HTTPServer
+DEFAULT_QUEUE_LIMIT = 32        # == FLAGS_serving_queue_limit default
+DEFAULT_TIMEOUT_S = 60.0        # == FLAGS_serving_request_timeout_s default
+DEFAULT_MAX_BODY_MB = 8         # == FLAGS_serving_max_body_mb default
+
+
+def build_http_server(port: int, run_fn=None, generate_fn=None, *,
+                      queue_limit: int = DEFAULT_QUEUE_LIMIT,
+                      timeout_s: float = DEFAULT_TIMEOUT_S,
+                      max_body_bytes: int = DEFAULT_MAX_BODY_MB << 20,
+                      host: str = "127.0.0.1"):
+    """The serving HTTP front-end, dependency-injected so this module stays
+    frontend-free (it imports no paddle_tpu):
+
+      * POST /run      -> run_fn(list of np arrays) -> list of np arrays
+                          (.npz body inp0..inpK, .npz answer out0..outN)
+      * POST /generate -> generate_fn(payload dict, deadline) yielding event
+                          dicts, streamed as one JSON line each (ndjson) —
+                          the continuous-batching scheduler's token stream
+                          when paddle_tpu.serving.ServingEngine.serve_http
+                          injects it.
+
+    Hardening (the old front-end was a single-threaded HTTPServer that
+    head-of-line blocked on each request and read unbounded bodies):
+
+      * ThreadingHTTPServer — a long /generate stream doesn't block /run
+      * bounded request queue — more than `queue_limit` in-flight handlers
+        are answered 503 immediately instead of queueing unboundedly
+      * Content-Length cap — 413 past `max_body_bytes`; chunked/unknown
+        length is rejected with 411, malformed with 400
+      * per-request timeout — socket reads/writes (header phase included)
+        and the queue wait are bounded by `timeout_s`; a /generate that
+        exceeds it is terminated with a {"error": "timeout"} event, a /run
+        that burned its budget queueing is refused before dispatch (the
+        run_fn computation itself is not interruptible)
+    """
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    slots = threading.BoundedSemaphore(queue_limit)
 
     class Handler(BaseHTTPRequestHandler):
+        # bounds the REQUEST-LINE/HEADER phase too: without it a client
+        # that connects and sends nothing parks a handler thread forever
+        # without ever reaching do_POST's queue accounting
+        timeout = timeout_s
+
+        def _body(self):
+            cl = self.headers.get("Content-Length")
+            if cl is None:
+                self.send_error(411, "Content-Length required")
+                return None
+            try:
+                n = int(cl)
+            except ValueError:
+                self.send_error(400, "malformed Content-Length")
+                return None
+            if n < 0:
+                self.send_error(400, "malformed Content-Length")
+                return None
+            if n > max_body_bytes:
+                self.send_error(413, f"body exceeds {max_body_bytes} bytes")
+                return None
+            return self.rfile.read(n)
+
         def do_POST(self):
-            if self.path != "/run":
-                self.send_error(404)
+            if not slots.acquire(blocking=False):
+                self.send_error(503, "request queue full")
                 return
-            body = self.rfile.read(int(self.headers["Content-Length"]))
+            try:
+                self.connection.settimeout(timeout_s)
+                deadline = time.monotonic() + timeout_s
+                if self.path == "/run" and run_fn is not None:
+                    self._do_run(deadline)
+                elif self.path == "/generate" and generate_fn is not None:
+                    self._do_generate(deadline)
+                else:
+                    self.send_error(404)
+            finally:
+                slots.release()
+
+        def _do_run(self, deadline):
+            body = self._body()
+            if body is None:
+                return
+            # the deadline bounds the I/O phases (socket timeout) and the
+            # queue wait; a request that already burned its budget getting
+            # here is refused before dispatch (a running run_fn itself is
+            # not interruptible from Python)
+            if time.monotonic() > deadline:
+                self.send_error(503, "request timed out in queue")
+                return
             with np.load(io.BytesIO(body)) as z:
                 args = [z[f"inp{i}"] for i in range(len(z.files))]
-            outs = artifact.run(args)
+            outs = run_fn(args)
             buf = io.BytesIO()
             np.savez(buf, **{f"out{i}": o for i, o in enumerate(outs)})
             data = buf.getvalue()
@@ -162,10 +244,52 @@ def _serve_http(artifact: Artifact, port: int):
             self.end_headers()
             self.wfile.write(data)
 
+        def _do_generate(self, deadline):
+            body = self._body()
+            if body is None:
+                return
+            try:
+                payload = json.loads(body)
+            except Exception:
+                self.send_error(400, "body must be JSON")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            # close-delimited stream: one JSON line per event, flushed as
+            # the scheduler emits tokens
+            self.end_headers()
+            try:
+                for event in generate_fn(payload, deadline):
+                    self.wfile.write((json.dumps(event) + "\n").encode())
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away; engine-side cancel already ran
+            except Exception as e:
+                # headers are already out — surface bad payloads and
+                # engine errors as a terminal stream event, not a cut
+                # connection
+                try:
+                    self.wfile.write(
+                        (json.dumps({"error": f"{type(e).__name__}: {e}"})
+                         + "\n").encode())
+                except OSError:
+                    pass
+
         def log_message(self, *a):
             pass
 
-    srv = HTTPServer(("127.0.0.1", port), Handler)
+    srv = ThreadingHTTPServer((host, port), Handler)
+    srv.daemon_threads = True
+    return srv
+
+
+def _serve_http(artifact: Artifact, port: int,
+                queue_limit: int = DEFAULT_QUEUE_LIMIT,
+                timeout_s: float = DEFAULT_TIMEOUT_S,
+                max_body_mb: int = DEFAULT_MAX_BODY_MB):
+    srv = build_http_server(port, run_fn=artifact.run,
+                            queue_limit=queue_limit, timeout_s=timeout_s,
+                            max_body_bytes=max_body_mb << 20)
     print(json.dumps({"serving": True, "port": srv.server_port}), flush=True)
     srv.serve_forever()
 
@@ -178,12 +302,16 @@ def main(argv=None):
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--bench", type=int, default=0)
     ap.add_argument("--http", type=int, default=None)
+    ap.add_argument("--queue-limit", type=int, default=DEFAULT_QUEUE_LIMIT)
+    ap.add_argument("--timeout-s", type=float, default=DEFAULT_TIMEOUT_S)
+    ap.add_argument("--max-body-mb", type=int, default=DEFAULT_MAX_BODY_MB)
     args = ap.parse_args(argv)
     art = Artifact(args.artifact, warmup=args.warmup)
     if args.bench:
         print(json.dumps(art.bench(args.bench)), flush=True)
     if args.http is not None:
-        _serve_http(art, args.http)
+        _serve_http(art, args.http, queue_limit=args.queue_limit,
+                    timeout_s=args.timeout_s, max_body_mb=args.max_body_mb)
     return art
 
 

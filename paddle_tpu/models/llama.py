@@ -47,6 +47,11 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_parallel_cross_entropy: bool = True
     dtype: str = "float32"
+    # size of the ONE hoisted RoPE cos/sin buffer pair (absolute-position
+    # indexed by the serving decode path); 0 = max_position_embeddings.
+    # Raise it to serve contexts past the training length — any position at
+    # or beyond it is a hard error, never a silent clamped-gather
+    rope_max_position: int = 0
     # run the homogeneous decoder stack as ONE lax.scan over layer-stacked
     # params (O(1)-in-depth HLO/compile time); the global `scan_layers` flag
     # or a compiled step's scan packing can also turn this on
@@ -82,6 +87,31 @@ def _shared_rope_tables(head_dim: int, max_pos: int, theta: float):
     and caching staged tracers would poison the cache for later traces."""
     with jax.ensure_compile_time_eval():
         return _rope_tables(head_dim, max_pos, theta)
+
+
+def _rope_limit(config: LlamaConfig) -> int:
+    return int(config.rope_max_position or config.max_position_embeddings)
+
+
+def _check_positions(position_ids, limit: int):
+    """Clear error when a position indexes past the hoisted RoPE tables.
+    XLA gather CLAMPS out-of-range indices, so without this check a too-long
+    context would silently reuse the last table row. Only HOST (numpy)
+    values are checked — device arrays may be tracers, and syncing eager
+    values per layer isn't worth it; traced decode steps are covered by
+    the serving engine's constructor check (max_seq_len <= rope limit)
+    and full-sequence forwards by the seq-len check below."""
+    import numpy as _np
+
+    if position_ids is None or not isinstance(position_ids, _np.ndarray):
+        return
+    mx = int(position_ids.max()) if position_ids.size else 0
+    if mx >= limit:
+        raise ValueError(
+            f"position {mx} is past the hoisted RoPE table "
+            f"(rope_max_position={limit}); raise "
+            f"LlamaConfig.rope_max_position (or max_position_embeddings) "
+            f"to serve longer contexts")
 
 
 def _tag_residual(x):
@@ -120,8 +150,8 @@ class LlamaAttention(nn.Layer):
         self.k_proj = ColumnParallelLinear(h, kv, has_bias=False, gather_output=False)
         self.v_proj = ColumnParallelLinear(h, kv, has_bias=False, gather_output=False)
         self.o_proj = RowParallelLinear(h, h, has_bias=False, input_is_parallel=True)
-        self._rope_geom = (self.head_dim, config.max_position_embeddings,
-                          config.rope_theta)
+        self._rope_geom = (self.head_dim, _rope_limit(config),
+                           config.rope_theta)
 
     def forward(self, x, attn_mask=None, rope=None, segment_ids=None,
                 position_ids=None):
@@ -151,6 +181,9 @@ class LlamaAttention(nn.Layer):
             rope = _shared_rope_tables(*self._rope_geom)
         cos, sin = (r._value if isinstance(r, Tensor) else r for r in rope)
 
+        limit = self._rope_geom[1]
+        _check_positions(position_ids, limit)
+
         def rope_fn(qv, kv_, c, sn):
             if position_ids is not None:
                 # per-row positions (restarting at 0 per packed document):
@@ -158,6 +191,12 @@ class LlamaAttention(nn.Layer):
                 c = c[position_ids].astype(qv.dtype)
                 sn = sn[position_ids].astype(qv.dtype)
             else:
+                if s > limit:
+                    raise ValueError(
+                        f"sequence length {s} is past the hoisted RoPE "
+                        f"table (rope_max_position={limit}); raise "
+                        f"LlamaConfig.rope_max_position to run longer "
+                        f"sequences")
                 c = c[:s].astype(qv.dtype)
                 sn = sn[:s].astype(qv.dtype)
             return apply_rotary(qv, kv_, c, sn)
@@ -171,6 +210,82 @@ class LlamaAttention(nn.Layer):
                                              segment_ids=segment_ids)
         out = out.reshape([b, s, -1])
         return self.o_proj(out)
+
+    def forward_decode(self, x, *, rope, cache, layer_idx, page_table,
+                       context_lens, position_ids, ctx_pad=None):
+        """Serving forward over the paged KV cache. x: [B, T, H]; T == 1 is
+        a decode step (paged ragged attention over the page table), T > 1
+        is a page-writing prefill chunk (runs through the standard flash
+        path over the gathered context). `cache` is the raw
+        {"k","v": [L, Hkv, P, page_size, D]} pool pair; this layer reads
+        and functionally updates stack row `layer_idx`. position_ids
+        [B, T] are ABSOLUTE positions (index the hoisted RoPE buffer);
+        context_lens [B] counts valid cache tokens INCLUDING this chunk.
+        Returns (out, cache)."""
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention
+
+        b, t, _ = x.shape
+        q = self.q_proj(x).reshape([b, t, -1, self.head_dim])
+        k = self.k_proj(x).reshape([b, t, -1, self.head_dim])
+        v = self.v_proj(x).reshape([b, t, -1, self.head_dim])
+        cos, sin = (r._value if isinstance(r, Tensor) else r for r in rope)
+        _check_positions(position_ids, self._rope_geom[1])
+        qv, kv, vv = q._value, k._value, v._value
+        c = cos[position_ids].astype(qv.dtype)
+        sn = sin[position_ids].astype(qv.dtype)
+        qv, kv = apply_rotary(qv, kv, c, sn)
+
+        # write this chunk's K/V into its cache pages (functional scatter;
+        # the engine donates the pools so XLA updates them in place)
+        ck, cv = cache["k"], cache["v"]
+        ps = ck.shape[3]
+        pidx = jnp.take_along_axis(page_table, position_ids // ps, axis=1)
+        slot = position_ids % ps                                   # [B, T]
+        # index tuple (int, :, [B,T], [B,T]): the advanced dims land in
+        # FRONT position, so the updates keep their natural [B, T, Hkv, D]
+        ck = ck.at[layer_idx, :, pidx, slot].set(kv.astype(ck.dtype))
+        cv = cv.at[layer_idx, :, pidx, slot].set(vv.astype(cv.dtype))
+        cache = {"k": ck, "v": cv}
+
+        if t == 1:
+            out = paged_attention(qv[:, 0], ck[layer_idx], cv[layer_idx],
+                                  page_table, context_lens)[:, None]
+        else:
+            # chunked prefill: gather the full context (pages cover the
+            # chunk itself too — just scattered above) and run the SAME
+            # flash kernel training uses, with the chunk's queries placed
+            # at their absolute rows of a [B, ctx_pad] frame so the causal
+            # mask sees true positions; rows past context are padding
+            # whose outputs are dropped by the take_along_axis below
+            if ctx_pad is None:
+                raise ValueError("prefill chunks need ctx_pad (the padded "
+                                 "context bucket the engine compiled for)")
+            pos_full = jnp.arange(ctx_pad, dtype=jnp.int32)
+            pidx_f = page_table[:, pos_full // ps]                 # [B, S]
+            slot_f = jnp.broadcast_to(pos_full % ps, (b, ctx_pad))
+            k_full = jnp.moveaxis(ck[layer_idx][:, pidx_f, slot_f],
+                                  0, 2).astype(qv.dtype)           # [B,S,Hkv,D]
+            v_full = jnp.moveaxis(cv[layer_idx][:, pidx_f, slot_f],
+                                  0, 2).astype(qv.dtype)
+            q_full = jnp.zeros((b, ctx_pad) + qv.shape[2:], qv.dtype)
+            bidx = jnp.arange(b)[:, None]
+            q_full = q_full.at[bidx, position_ids].set(qv)
+            out_full = F.scaled_dot_product_attention(
+                q_full, k_full, v_full, is_causal=True, training=False)
+            out = jnp.take_along_axis(
+                out_full._value if isinstance(out_full, Tensor) else out_full,
+                position_ids[:, :, None, None], axis=1)
+        out = Tensor(out) if not isinstance(out, Tensor) else out
+        out = out.reshape([b, t, -1])
+        return self.o_proj(out), cache
+
+
+def _raw(a):
+    """Unwrap Tensor -> jnp value (functional_call wraps top-level array
+    kwargs; the decode metadata must reach the kernels raw)."""
+    if a is None:
+        return None
+    return a._value if isinstance(a, Tensor) else jnp.asarray(a)
 
 
 class LlamaMLP(nn.Layer):
@@ -202,6 +317,17 @@ class LlamaDecoderLayer(nn.Layer):
         x = _tag_residual(x + self.mlp(self.post_attention_layernorm(x)))
         return x
 
+    def forward_decode(self, x, *, rope, cache, layer_idx, page_table,
+                       context_lens, position_ids, ctx_pad=None):
+        attn_out, cache = self.self_attn.forward_decode(
+            self.input_layernorm(x), rope=rope, cache=cache,
+            layer_idx=layer_idx, page_table=page_table,
+            context_lens=context_lens, position_ids=position_ids,
+            ctx_pad=ctx_pad)
+        x = x + attn_out
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, cache
+
 
 class LlamaModel(nn.Layer):
     # cooperation protocol (paddle_tpu.parallel.scan_layers): compiled steps
@@ -217,9 +343,11 @@ class LlamaModel(nn.Layer):
                                     for _ in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         # ONE shared RoPE table pair for the whole stack (previously every
-        # attention layer registered its own [max_pos, head_dim/2] copies)
+        # attention layer registered its own [max_pos, head_dim/2] copies);
+        # sized by rope_max_position so the serving decode path can index it
+        # at absolute positions past the training max_position_embeddings
         head_dim = config.hidden_size // config.num_attention_heads
-        cos, sin = _rope_tables(head_dim, config.max_position_embeddings,
+        cos, sin = _rope_tables(head_dim, _rope_limit(config),
                                 config.rope_theta)
         self.register_buffer("rope_cos", cos, persistable=False)
         self.register_buffer("rope_sin", sin, persistable=False)
@@ -233,6 +361,26 @@ class LlamaModel(nn.Layer):
         x = self.embed_tokens(input_ids)
         x = self._run_layers(x, attn_mask, segment_ids, position_ids)
         return self.norm(x)
+
+    def decode_forward(self, input_ids, cache, page_table, context_lens,
+                       position_ids, ctx_pad=None):
+        """Serving forward over the paged KV cache (decode step when
+        input_ids is [B, 1], page-writing prefill chunk when [B, T>1]).
+        `cache` = raw {"k","v": [L, Hkv, P, page_size, D]} pools; returns
+        (hidden, updated cache). The layer loop is an unrolled Python loop
+        — decode programs are tiny next to training HLO, and every layer
+        scatters into its own stack row of the donated pools."""
+        page_table = _raw(page_table).astype(jnp.int32)
+        context_lens = _raw(context_lens).astype(jnp.int32)
+        position_ids = _raw(position_ids).astype(jnp.int32)
+        x = self.embed_tokens(input_ids)
+        rope = (self.rope_cos._value, self.rope_sin._value)
+        for i, layer in enumerate(self.layers):
+            x, cache = layer.forward_decode(
+                x, rope=rope, cache=cache, layer_idx=i,
+                page_table=page_table, context_lens=context_lens,
+                position_ids=position_ids, ctx_pad=ctx_pad)
+        return self.norm(x), cache
 
     def _run_layers(self, x, attn_mask, segment_ids=None, position_ids=None):
         """Apply the decoder stack: unrolled python loop, or ONE lax.scan
@@ -361,6 +509,14 @@ class LlamaForCausalLM(nn.Layer):
                 return self.criterion(self.lm_head(hidden), labels)
         with head_scope():
             return self.lm_head(hidden)
+
+    def decode_forward(self, input_ids, cache, page_table, context_lens,
+                       position_ids, ctx_pad=None):
+        """Serving decode/prefill entry: (logits [B, T, vocab], cache)."""
+        hidden, cache = self.llama.decode_forward(
+            input_ids, cache, page_table, context_lens, position_ids,
+            ctx_pad=ctx_pad)
+        return self.lm_head(hidden), cache
 
     # ---- pipeline-parallel factory ----------------------------------------
     @staticmethod

@@ -1,0 +1,18 @@
+"""paddle_tpu.serving — paged-KV-cache continuous-batching LLM serving.
+
+The inference-side counterpart of the training runtimes (ROADMAP item 1):
+`ServingEngine` drives iteration-level batching over a block-granular KV
+cache with a Pallas ragged decode-attention kernel
+(paddle_tpu.ops.pallas.paged_attention). See docs/serving.md.
+"""
+from paddle_tpu.serving.engine import ServingConfig, ServingEngine
+from paddle_tpu.serving.kv_cache import (PageAllocator, kv_page_bytes,
+                                         pages_for_budget)
+from paddle_tpu.serving.sampling import request_key, sample_tokens
+from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                          Request, RequestState)
+
+__all__ = ["ServingConfig", "ServingEngine", "PageAllocator",
+           "kv_page_bytes", "pages_for_budget", "sample_tokens",
+           "request_key", "ContinuousBatchingScheduler", "Request",
+           "RequestState"]

@@ -1,0 +1,564 @@
+"""The serving engine: paged KV cache + continuous-batching decode.
+
+Compiled-signature strategy (ZERO decode retraces):
+
+  * ONE decode program. Every decode step runs the fixed
+    ``[serving_decode_batch]`` slot layout — token ids, context lens, page
+    tables, PRNG keys and per-request sampling knobs are ARRAYS, inactive
+    slots are len-0 rows the kernel skips — so after the first step the
+    program never retraces (``decode_retraces_after_warmup`` asserts it).
+  * A small prefill bucket set. Prompts prefill one request at a time in
+    chunks of ``serving_prefill_chunk`` tokens through the standard flash
+    path; chunk length and padded context round up to power-of-two buckets,
+    bounding compiles to |chunk buckets| x |context buckets|.
+
+Prefill/decode disaggregation: admission prefills write K/V pages (chunk
+attention gathers the growing context back from those pages, so a chunk
+attends to every earlier chunk); decode steps run the Pallas paged ragged
+kernel over the packed active batch. The decode step for a request whose
+prefill just landed REWRITES the last context token's K/V (same values) —
+that one redundant token write buys a single uniform decode program with
+no separate first-token sampling path.
+
+Sampling runs inside the decode program (greedy + temperature/top-k/top-p,
+per-request RNG keys), so a step's host work is queue bookkeeping only.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.serving.kv_cache import (PageAllocator, kv_page_bytes,
+                                         pages_for_budget)
+from paddle_tpu.serving.sampling import request_key, sample_tokens
+from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                          Request, RequestState)
+
+__all__ = ["ServingConfig", "ServingEngine"]
+
+
+@dataclass
+class ServingConfig:
+    page_size: int = 0              # 0 -> FLAGS_serving_page_size
+    num_pages: int = 0              # 0 -> FLAGS_serving_num_pages, then
+                                    #      derive from hbm_budget_mb
+    hbm_budget_mb: int = 0          # 0 -> FLAGS_serving_hbm_budget_mb
+    decode_batch: int = 0           # 0 -> FLAGS_serving_decode_batch
+    prefill_chunk: int = 0          # 0 -> FLAGS_serving_prefill_chunk
+    max_seq_len: int = 0            # 0 -> FLAGS_serving_max_seq_len or model
+    kv_dtype: object = None         # None -> model param dtype
+    sample_seed: int = 0
+
+    def resolved(self, model_max_pos: int):
+        from paddle_tpu.core.flags import flag
+
+        ps = self.page_size or flag("serving_page_size")
+        batch = self.decode_batch or flag("serving_decode_batch")
+        chunk = self.prefill_chunk or flag("serving_prefill_chunk")
+        smax = (self.max_seq_len or flag("serving_max_seq_len")
+                or model_max_pos)
+        budget = self.hbm_budget_mb or flag("serving_hbm_budget_mb")
+        pages = self.num_pages or flag("serving_num_pages")
+        return (int(ps), int(batch), int(chunk), int(smax), int(budget),
+                int(pages))
+
+
+def _buckets(lo: int, hi: int) -> list[int]:
+    """Power-of-two sizes in [lo, hi] plus hi itself (the compile set)."""
+    out, b = [], lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return out
+
+
+def _bucket(n: int, buckets: list[int]) -> int:
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"{n} exceeds the largest bucket {buckets[-1]}")
+
+
+class ServingEngine:
+    """Continuous-batching generation over a decode-capable model (the
+    `decode_forward` protocol LlamaForCausalLM implements)."""
+
+    def __init__(self, model, config: ServingConfig | None = None):
+        self.model = model
+        self.config = config or ServingConfig()
+        mcfg = model.config
+        self.num_layers = int(mcfg.num_hidden_layers)
+        self.num_kv_heads = int(mcfg.num_key_value_heads)
+        self.head_dim = int(mcfg.hidden_size) // int(mcfg.num_attention_heads)
+        (self.page_size, self.decode_batch, self.prefill_chunk,
+         self.max_seq_len, budget_mb, cfg_pages) = self.config.resolved(
+            int(mcfg.max_position_embeddings))
+        rope_limit = int(getattr(mcfg, "rope_max_position", 0)
+                         or mcfg.max_position_embeddings)
+        if self.max_seq_len > rope_limit:
+            raise ValueError(
+                f"serving_max_seq_len={self.max_seq_len} exceeds the hoisted "
+                f"RoPE table (rope_max_position={rope_limit}); raise "
+                f"LlamaConfig.rope_max_position to serve longer contexts")
+        self.pages_per_seq = -(-self.max_seq_len // self.page_size)
+
+        params = [p._value for p in model.parameters()]
+        for p in params:
+            # a CompiledTrainStep DONATES the model's original arrays into
+            # its compiled program and keeps the live weights device-side;
+            # serving a just-trained model without syncing back would die
+            # deep in jit arg-sharding with an opaque "Array has been
+            # deleted" — fail at construction with the fix instead
+            if getattr(p, "is_deleted", lambda: False)():
+                raise ValueError(
+                    "model parameters are donated/deleted device arrays — "
+                    "call CompiledTrainStep.sync_params_to_model() (or "
+                    "reload a checkpoint) before constructing ServingEngine")
+        self.kv_dtype = jnp.dtype(self.config.kv_dtype or params[0].dtype)
+        page_bytes = kv_page_bytes(self.num_layers, self.num_kv_heads,
+                                   self.page_size, self.head_dim,
+                                   self.kv_dtype.itemsize)
+        num_pages = cfg_pages or pages_for_budget(budget_mb << 20,
+                                                  page_bytes)
+        if num_pages - 1 < self.pages_per_seq:
+            raise ValueError(
+                f"KV pool of {num_pages} pages cannot hold ONE max-length "
+                f"request ({self.pages_per_seq} pages); raise "
+                f"serving_num_pages/serving_hbm_budget_mb or lower "
+                f"serving_max_seq_len")
+        self.num_pages = int(num_pages)
+        self.kv_cache_bytes = page_bytes * self.num_pages
+
+        self.allocator = PageAllocator(self.num_pages, self.page_size)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.allocator, self.decode_batch, self.max_seq_len)
+        self._params = params
+        shape = (self.num_layers, self.num_kv_heads, self.num_pages,
+                 self.page_size, self.head_dim)
+        self._ck = jnp.zeros(shape, self.kv_dtype)
+        self._cv = jnp.zeros(shape, self.kv_dtype)
+
+        self._chunk_buckets = _buckets(min(8, self.prefill_chunk),
+                                       self.prefill_chunk)
+        self._ctx_buckets = _buckets(min(32, self._ctx_cap()),
+                                     self._ctx_cap())
+        self._keys: dict[int, np.ndarray] = {}
+        self._submit_seq = 0           # per-engine sample-stream identity
+        self._decode_traces = 0
+        self._prefill_traces = 0
+        self._decode_traces_at_warmup: int | None = None
+        self._donate = (jax.devices()[0].platform == "tpu")
+        from collections import deque
+        self._decode_fn = None
+        self._prefill_fns: dict[tuple[int, int], object] = {}
+        # bounded: a long-lived server must not grow a sample per decode
+        # step forever (utilization_mean is a recent-window statistic)
+        self._util_samples: deque = deque(maxlen=65536)
+        import threading
+        self._http_lock = threading.Lock()
+        self._http_stop = False
+        self._http_error: str | None = None
+
+    def _ctx_cap(self) -> int:
+        return self.pages_per_seq * self.page_size
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _decode(self):
+        if self._decode_fn is None:
+            from paddle_tpu.parallel.train_step import functional_call
+
+            def fn(params, ck, cv, ids, lens, page_table, keys, temp,
+                   top_k, top_p):
+                self._decode_traces += 1
+                positions = jnp.maximum(lens - 1, 0).astype(jnp.int32)
+                logits3, cache = functional_call(
+                    self.model, params, (ids[:, None],),
+                    dict(cache={"k": ck, "v": cv}, page_table=page_table,
+                         context_lens=lens, position_ids=positions[:, None]),
+                    training=False, method="decode_forward")
+                logits = logits3._value[:, 0]
+                tokens, new_keys = sample_tokens(logits, keys, temp,
+                                                 top_k, top_p)
+                # logits are consumed by sampling IN-program and not
+                # returned: a [batch, vocab] fp32 output would otherwise
+                # stay live between steps for nothing
+                return tokens, new_keys, cache["k"], cache["v"]
+
+            self._decode_fn = jax.jit(
+                fn, donate_argnums=(1, 2) if self._donate else ())
+        return self._decode_fn
+
+    def _prefill(self, chunk_pad: int, ctx_pad: int):
+        key = (chunk_pad, ctx_pad)
+        if key not in self._prefill_fns:
+            from paddle_tpu.parallel.train_step import functional_call
+
+            cap = self._ctx_cap()
+
+            def fn(params, ck, cv, ids, start, total, page_row):
+                self._prefill_traces += 1
+                # pad tokens of the final chunk clamp to the last valid
+                # position: they write the one not-yet-valid slot cap-1
+                # (rewritten by decode before it's ever readable) instead
+                # of wrapping into live slots
+                positions = jnp.minimum(
+                    start + jnp.arange(chunk_pad, dtype=jnp.int32), cap - 1)
+                _, cache = functional_call(
+                    self.model, params, (ids[None],),
+                    dict(cache={"k": ck, "v": cv},
+                         page_table=page_row[None],
+                         context_lens=total.reshape(1),
+                         position_ids=positions[None], ctx_pad=ctx_pad),
+                    training=False, method="decode_forward")
+                return cache["k"], cache["v"]
+
+            self._prefill_fns[key] = jax.jit(
+                fn, donate_argnums=(1, 2) if self._donate else ())
+        return self._prefill_fns[key]
+
+    # ------------------------------------------------------------------
+    # request intake
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 16, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0, eos_id: int | None = None,
+               stream_cb=None) -> int:
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      eos_id=eos_id, stream_cb=stream_cb)
+        # pool sufficiency is a CONSTRUCTOR invariant (>= pages_per_seq
+        # usable pages), so any request within serving_max_seq_len fits
+        # alone; the scheduler enforces the length limit
+        rid = self.scheduler.submit(req)
+        self._keys[rid] = self._new_key()
+        return rid
+
+    def _new_key(self) -> np.ndarray:
+        # keyed by per-engine submission ORDER (not the process-global rid):
+        # re-running the same request sequence with the same seed reproduces
+        # the same sampled streams in any process
+        key = request_key(self.config.sample_seed, self._submit_seq)
+        self._submit_seq += 1
+        return np.asarray(key, np.uint32)
+
+    def cancel(self, rid: int) -> bool:
+        return self.scheduler.cancel(rid)
+
+    # ------------------------------------------------------------------
+    # the serving loop
+    # ------------------------------------------------------------------
+    def _run_prefill(self, req: Request):
+        ctx = req.context
+        total = int(ctx.size)
+        row = jnp.asarray(self.allocator.page_table_row(
+            req.rid, self.pages_per_seq))
+        off = 0
+        while off < total:
+            t = min(self.prefill_chunk, total - off)
+            cpad = _bucket(t, self._chunk_buckets)
+            ctx_pad = _bucket(min(off + cpad, self._ctx_cap()),
+                              self._ctx_buckets)
+            ids = np.zeros(cpad, np.int32)
+            ids[:t] = ctx[off:off + t]
+            fn = self._prefill(cpad, ctx_pad)
+            self._ck, self._cv = fn(
+                self._params, self._ck, self._cv, jnp.asarray(ids),
+                jnp.asarray(off, jnp.int32),
+                jnp.asarray(off + t, jnp.int32), row)
+            off += t
+
+    def _decode_once(self, active, finisher):
+        """Pack `active` requests into the fixed decode-batch signature,
+        run ONE compiled decode step, and apply the sampled tokens —
+        shared verbatim by the continuous scheduler and the static-batch
+        baseline so both provably run the same program. `finisher(req)`
+        releases a request that just hit its stop condition."""
+        b, pmax = self.decode_batch, self.pages_per_seq
+        ids = np.zeros(b, np.int32)
+        lens = np.zeros(b, np.int32)
+        pt = np.zeros((b, pmax), np.int32)
+        keys = np.zeros((b, 2), np.uint32)
+        temp = np.zeros(b, np.float32)
+        top_k = np.zeros(b, np.int32)
+        top_p = np.ones(b, np.float32)
+        for i, req in enumerate(active):
+            # NOT req.context[-1]: that concatenates prompt+generated every
+            # step (O(len) per token -> O(len^2) per stream)
+            ids[i] = (req.generated[-1] if req.generated
+                      else int(req.prompt[-1]))
+            lens[i] = req.total_len
+            pt[i] = self.allocator.page_table_row(req.rid, pmax)
+            keys[i] = self._keys[req.rid]
+            temp[i] = req.temperature
+            top_k[i] = req.top_k
+            top_p[i] = req.top_p
+        tokens, new_keys, self._ck, self._cv = self._decode()(
+            self._params, self._ck, self._cv, jnp.asarray(ids),
+            jnp.asarray(lens), jnp.asarray(pt), jnp.asarray(keys),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
+        toks = np.asarray(tokens)
+        nkeys = np.asarray(new_keys)
+        now = time.perf_counter()
+        for i, req in enumerate(active):
+            tok = int(toks[i])
+            req.generated.append(tok)
+            req.token_times.append(now)
+            self._keys[req.rid] = nkeys[i]
+            if req.stream_cb is not None:
+                req.stream_cb(req, tok)
+            if ((req.eos_id is not None and tok == req.eos_id)
+                    or len(req.generated) >= req.max_new_tokens):
+                finisher(req)
+        self._util_samples.append(self.allocator.utilization())
+
+    def step(self) -> bool:
+        """One scheduler iteration: admissions (+ their prefills), chain
+        growth/eviction, then ONE packed decode step. Returns False when
+        nothing is running (idle or waiting-only)."""
+        for req in self.scheduler.admissions():
+            self._run_prefill(req)
+            self.scheduler.activate(req)
+        self.scheduler.grow()
+        running = list(self.scheduler.running)
+        if not running:
+            if self.scheduler.waiting:
+                blocked = self.scheduler.waiting[0]
+                raise RuntimeError(
+                    f"serving deadlock: request {blocked.rid} "
+                    f"({blocked.total_len + 1} tokens) cannot be admitted "
+                    f"with {self.allocator.free_pages} free pages and "
+                    f"nothing left to evict")
+            return False
+        self._decode_once(running, self.scheduler.finish)
+        return True
+
+    def run_until_idle(self, max_steps: int = 1_000_000):
+        steps = 0
+        while not self.scheduler.idle:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"serving loop exceeded {max_steps} steps")
+        return steps
+
+    def release(self, rid: int):
+        """Drop a finished request's bookkeeping (scheduler entry + RNG
+        key) — the per-request memory a long-lived server must not retain."""
+        self.scheduler.release(rid)
+        self._keys.pop(rid, None)
+
+    def generate(self, prompts, max_new_tokens: int = 16, **kw):
+        """Synchronous convenience: submit all, run to completion, return
+        the generated token lists in submission order."""
+        rids = [self.submit(p, max_new_tokens=max_new_tokens, **kw)
+                for p in prompts]
+        self.run_until_idle()
+        outs = [list(self.scheduler.get(r).generated) for r in rids]
+        for r in rids:
+            self.release(r)
+        return outs
+
+    # ------------------------------------------------------------------
+    # static-batch baseline (the bench strawman)
+    # ------------------------------------------------------------------
+    def static_batch_generate(self, prompts, max_new_tokens, **kw):
+        """Naive static batching: groups of `decode_batch` requests run to
+        COLLECTIVE completion before the next group starts — a finished
+        request's slot idles until the group's straggler is done. Same
+        compiled decode program; only the scheduling differs."""
+        new_tokens = (list(max_new_tokens)
+                      if isinstance(max_new_tokens, (list, tuple, np.ndarray))
+                      else [max_new_tokens] * len(prompts))
+        reqs = [Request(prompt=p, max_new_tokens=int(n), **kw)
+                for p, n in zip(prompts, new_tokens)]
+        for req in reqs:
+            self._keys[req.rid] = self._new_key()
+        def finish_static(req):
+            req.state = RequestState.FINISHED
+            self.allocator.free_request(req.rid)
+
+        for g0 in range(0, len(reqs), self.decode_batch):
+            group = reqs[g0:g0 + self.decode_batch]
+            for req in group:
+                if not self.allocator.ensure(
+                        req.rid, req.prompt.size + req.max_new_tokens):
+                    raise RuntimeError("static baseline: KV pool too small "
+                                       "for one full batch")
+                req.state = RequestState.RUNNING
+                req.admitted_t = time.perf_counter()
+                self._run_prefill(req)
+            while any(not r.finished for r in group):
+                self._decode_once([r for r in group if not r.finished],
+                                  finish_static)
+        for req in reqs:      # static requests never enter the scheduler
+            self._keys.pop(req.rid, None)
+        return reqs
+
+    # ------------------------------------------------------------------
+    # HTTP front-end (the /generate endpoint of inference/serve.py)
+    # ------------------------------------------------------------------
+    def _http_generate(self, payload: dict, deadline: float):
+        """Generator of stream events for one /generate request: a driver
+        thread turns the scheduler, per-token callbacks land in a queue,
+        and this generator drains it until completion / deadline (deadline
+        cancels the request so its pages free immediately)."""
+        import queue as queue_mod
+
+        q = queue_mod.Queue()
+        with self._http_lock:
+            rid = self.submit(
+                np.asarray(payload["prompt_ids"], np.int32),
+                max_new_tokens=int(payload.get("max_new_tokens", 16)),
+                temperature=float(payload.get("temperature", 0.0)),
+                top_k=int(payload.get("top_k", 0)),
+                top_p=float(payload.get("top_p", 1.0)),
+                eos_id=payload.get("eos_id"),
+                stream_cb=lambda req, tok: q.put(tok))
+            req = self.scheduler.get(rid)
+        n = 0
+        try:
+            while True:
+                # the deadline bounds STREAMING requests too, not just
+                # stalls — a max_new_tokens large enough to outlive the
+                # budget is cut off mid-stream and its pages freed
+                if time.monotonic() > deadline:
+                    yield {"rid": rid, "error": "timeout", "tokens": n}
+                    return
+                if self._http_error is not None:
+                    # the driver thread died: fail fast instead of letting
+                    # every stream idle out to its deadline
+                    yield {"rid": rid, "error": self._http_error,
+                           "tokens": n}
+                    return
+                try:
+                    tok = q.get(timeout=0.05)
+                except queue_mod.Empty:
+                    if req.finished and q.empty():
+                        break
+                    continue
+                n += 1
+                yield {"rid": rid, "token": int(tok)}
+                if req.finished and q.empty():
+                    break
+            yield {"rid": rid, "done": True, "tokens": n,
+                   "state": req.state.value}
+        finally:
+            # runs on normal completion, timeout, driver error AND
+            # generator teardown (client disconnect -> GeneratorExit at a
+            # yield): an abandoned request must stop occupying its decode
+            # slot and KV pages immediately
+            with self._http_lock:
+                if not req.finished:
+                    self.cancel(rid)
+                self.release(rid)
+
+    def _drive_http(self):
+        while not self._http_stop:
+            try:
+                with self._http_lock:
+                    busy = not self.scheduler.idle
+                    if busy:
+                        self.step()
+            except Exception as e:  # surface through every open stream
+                self._http_error = f"serving driver died: " \
+                                   f"{type(e).__name__}: {e}"
+                return
+            if not busy:
+                time.sleep(0.002)
+
+    def serve_http(self, port: int, block: bool = True):
+        """Serve POST /generate (streaming ndjson token events) through the
+        hardened HTTP front-end in paddle_tpu.inference.serve — the
+        scheduler runs on a driver thread, handler threads only queue
+        requests and drain token streams."""
+        import threading
+
+        from paddle_tpu.core.flags import flag
+        from paddle_tpu.inference.serve import build_http_server
+
+        srv = build_http_server(
+            port, generate_fn=self._http_generate,
+            queue_limit=int(flag("serving_queue_limit")),
+            timeout_s=float(flag("serving_request_timeout_s")),
+            max_body_bytes=int(flag("serving_max_body_mb")) << 20)
+        self._http_stop = False
+        driver = threading.Thread(target=self._drive_http,
+                                  name="paddle_tpu.serving.driver",
+                                  daemon=True)
+        driver.start()
+        self._http_driver = driver
+        self._http_server = srv
+        if block:  # pragma: no cover - CLI path
+            try:
+                srv.serve_forever()
+            finally:
+                self.shutdown_http()
+        return srv
+
+    def shutdown_http(self):
+        self._http_stop = True
+        driver = getattr(self, "_http_driver", None)
+        if driver is not None:
+            driver.join(timeout=5.0)
+            self._http_driver = None
+        srv = getattr(self, "_http_server", None)
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+            self._http_server = None
+
+    # ------------------------------------------------------------------
+    # instrumentation
+    # ------------------------------------------------------------------
+    def mark_warmup(self):
+        """Call after the first real decode step: any trace past this point
+        is a retrace bug (`decode_retraces_after_warmup`)."""
+        self._decode_traces_at_warmup = self._decode_traces
+
+    @property
+    def decode_retraces_after_warmup(self) -> int:
+        if self._decode_traces_at_warmup is None:
+            return 0
+        return self._decode_traces - self._decode_traces_at_warmup
+
+    @property
+    def decode_traces(self) -> int:
+        return self._decode_traces
+
+    @property
+    def prefill_traces(self) -> int:
+        return self._prefill_traces
+
+    def utilization_mean(self) -> float:
+        return float(np.mean(self._util_samples)) if self._util_samples else 0.0
+
+    def reset_stats(self):
+        self._util_samples.clear()
+
+    @staticmethod
+    def latency_stats(requests) -> dict:
+        """Per-token latency over finished requests: a request's first
+        token is timed from ARRIVAL (queueing + prefill + decode — what a
+        caller feels), later tokens from the previous token."""
+        gaps = []
+        for req in requests:
+            prev = req.arrival_t
+            for t in req.token_times:
+                gaps.append((t - prev) * 1e3)
+                prev = t
+        if not gaps:
+            return {"tokens": 0}
+        gaps.sort()
+
+        def pct(p):
+            return round(gaps[min(int(len(gaps) * p / 100),
+                                  len(gaps) - 1)], 3)
+
+        return {"tokens": len(gaps), "p50_ms": pct(50), "p99_ms": pct(99),
+                "mean_ms": round(float(np.mean(gaps)), 3)}

@@ -1,0 +1,137 @@
+"""Block-granular KV-cache page allocator (the vLLM PagedAttention memory
+manager, host side).
+
+The device-side pools are plain ``[layers, kv_heads, num_pages, page_size,
+head_dim]`` arrays owned by the serving engine; this module owns the INDEX
+space: a free list of fixed-size pages, per-request page chains (a request's
+context occupies its chain's pages in order), and HBM-budget accounting that
+sizes the pool. Page 0 is the reserved NULL page — never allocated, it backs
+the dead slots of every page-table row so the kernel's skipped pages have a
+harmless DMA target.
+
+Eviction is COPY-FREE: freeing a chain just returns its page ids to the free
+list (preempt-by-recomputation — the scheduler re-prefills the victim later);
+no page contents ever move.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+__all__ = ["PageAllocator", "kv_page_bytes", "pages_for_budget"]
+
+NULL_PAGE = 0
+
+
+def kv_page_bytes(num_layers: int, num_kv_heads: int, page_size: int,
+                  head_dim: int, dtype_bytes: int = 2) -> int:
+    """K+V bytes ONE page costs across the whole layer stack — the unit of
+    the serving HBM budget."""
+    return 2 * num_layers * num_kv_heads * page_size * head_dim * dtype_bytes
+
+
+def pages_for_budget(budget_bytes: int, page_bytes: int) -> int:
+    """Pool size (incl. the null page) fitting `budget_bytes`."""
+    return max(2, budget_bytes // max(page_bytes, 1))
+
+
+class PageAllocator:
+    """Free-list page allocator with per-request chains.
+
+    Invariants (asserted): a page belongs to at most one chain; the null
+    page belongs to none; chain growth is all-or-nothing (a request either
+    gets every page its context needs or the allocator reports exhaustion
+    and the scheduler evicts/queues).
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError(f"need >= 2 pages (one is the reserved null "
+                             f"page), got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._free = deque(range(1, num_pages))
+        self._chains: dict[object, list[int]] = {}
+        self._owner: dict[int, object] = {}
+
+    # ---- capacity ---------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def utilization(self) -> float:
+        return self.used_pages / max(self.num_pages - 1, 1)
+
+    def pages_for(self, tokens: int) -> int:
+        return -(-int(tokens) // self.page_size) if tokens > 0 else 0
+
+    def can_fit(self, tokens: int) -> bool:
+        return self.pages_for(tokens) <= self.free_pages
+
+    # ---- chains -----------------------------------------------------------
+    def chain(self, rid) -> list[int]:
+        return list(self._chains.get(rid, ()))
+
+    def ensure(self, rid, total_tokens: int) -> bool:
+        """Grow request `rid`'s chain until it covers `total_tokens` tokens.
+        All-or-nothing: on exhaustion nothing is allocated and False is
+        returned (the scheduler then evicts or queues)."""
+        chain = self._chains.setdefault(rid, [])
+        need = self.pages_for(total_tokens) - len(chain)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            if not chain:
+                del self._chains[rid]
+            return False
+        for _ in range(need):
+            page = self._free.popleft()
+            assert page not in self._owner and page != NULL_PAGE, \
+                f"page {page} double-allocated"
+            self._owner[page] = rid
+            chain.append(page)
+        return True
+
+    def free_request(self, rid) -> int:
+        """Return `rid`'s whole chain to the free list (request completion,
+        cancellation, or copy-free eviction). Returns the page count."""
+        chain = self._chains.pop(rid, [])
+        for page in chain:
+            owner = self._owner.pop(page, None)
+            assert owner is rid, \
+                f"page {page} freed by {rid!r} but owned by {owner!r}"
+            self._free.append(page)
+        return len(chain)
+
+    def page_table_row(self, rid, pages_per_seq: int) -> np.ndarray:
+        """The request's kernel-facing page-table row: its chain, padded
+        with the null page."""
+        chain = self._chains.get(rid, ())
+        if len(chain) > pages_per_seq:
+            raise ValueError(f"request {rid!r} chain ({len(chain)} pages) "
+                             f"exceeds pages_per_seq={pages_per_seq}")
+        row = np.full(pages_per_seq, NULL_PAGE, np.int32)
+        row[:len(chain)] = chain
+        return row
+
+    def check_consistency(self):
+        """Test hook: every allocated page owned by exactly one chain, free
+        list and chains partition the non-null pool."""
+        seen = {}
+        for rid, chain in self._chains.items():
+            for page in chain:
+                assert page != NULL_PAGE, f"null page in chain of {rid!r}"
+                assert page not in seen, \
+                    f"page {page} aliased by {seen[page]!r} and {rid!r}"
+                seen[page] = rid
+        free = set(self._free)
+        assert not (free & set(seen)), "free list overlaps a live chain"
+        assert len(free) + len(seen) == self.num_pages - 1, \
+            "pages leaked or duplicated"

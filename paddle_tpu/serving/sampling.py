@@ -1,0 +1,71 @@
+"""Batched token sampling for the serving decode step.
+
+One jit-stable function over the packed decode batch: greedy, temperature,
+top-k and top-p are all driven by PER-REQUEST parameter ARRAYS (a request's
+knobs ride the batch rows), so mixing sampling configs in one batch never
+retraces the decode step. Every request carries its own PRNG key — the
+sampled stream of request A is independent of what else shares its batch,
+and replaying a request with the same seed reproduces the same tokens.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_tokens", "request_key"]
+
+_NEG_INF = -1e30
+
+
+def request_key(seed: int, rid: int):
+    """Deterministic per-request PRNG key: stream identity is (seed, rid),
+    independent of batch placement or admission order."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), rid)
+
+
+def _mask_top_k(logits, top_k):
+    """Per-row top-k: k <= 0 disables. Ties at the k-th value survive
+    (standard behaviour)."""
+    v = logits.shape[-1]
+    k_eff = jnp.where(top_k <= 0, v, jnp.clip(top_k, 1, v))
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def _mask_top_p(logits, top_p):
+    """Per-row nucleus: keep the smallest prefix of the sorted distribution
+    whose mass reaches p (the first exceeding token included); p >= 1
+    disables, p <= 0 degenerates to top-1."""
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep = (csum - probs) < top_p[:, None]
+    keep = keep.at[:, 0].set(True)                   # always keep the argmax
+    thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < thresh, _NEG_INF, logits)
+
+
+def sample_tokens(logits, keys, temperature, top_k, top_p):
+    """One sampling step over the packed decode batch.
+
+    logits: [B, V]; keys: [B, 2] uint32 per-request PRNG keys;
+    temperature: [B] float (<= 0 -> greedy argmax); top_k: [B] int32
+    (<= 0 -> off); top_p: [B] float (>= 1 -> off).
+    Returns (tokens [B] int32, advanced keys [B, 2]).
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = temperature <= 0.0
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    masked = _mask_top_p(_mask_top_k(scaled, top_k), top_p)
+
+    def draw(key, row):
+        use, carry = jax.random.split(key)
+        return jax.random.categorical(use, row), carry
+
+    sampled, new_keys = jax.vmap(draw)(keys, masked)
+    tokens = jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled)
+    # greedy rows keep an advancing key too: switching a request's
+    # temperature mid-stream doesn't correlate it with its own history
+    return tokens.astype(jnp.int32), new_keys

@@ -35,7 +35,7 @@ SLOW_TEST_MODULES = {
     "test_vision_ops", "test_nn_layers", "test_optimizer",
     "test_aux_subsystems", "test_fft_signal_distribution",
     "test_advice_fixes_r4", "test_static_graph", "test_jit_save_load",
-    "test_parallel_parity",
+    "test_parallel_parity", "test_serving_system",
 }
 
 
@@ -72,7 +72,8 @@ def _thread_hygiene():
 
     def leaked():
         return [t for t in threading.enumerate()
-                if t.name.startswith(("paddle_tpu.io", "paddle_tpu.ckpt"))
+                if t.name.startswith(("paddle_tpu.io", "paddle_tpu.ckpt",
+                                      "paddle_tpu.serving"))
                 and t not in before and t.is_alive()]
 
     yield
@@ -91,6 +92,20 @@ def flash_interpret():
     SAME kernel code paths (online softmax, causal+segment masking, block
     skipping) the TPU runs through Mosaic."""
     from paddle_tpu.ops.pallas.flash_attention import force_interpret
+
+    with force_interpret():
+        yield
+
+
+@pytest.fixture
+def paged_interpret():
+    """Run the Pallas paged decode-attention kernel under interpret=True on
+    CPU — the serving analog of `flash_interpret`: the dispatcher
+    (paged_attention) then routes into the SAME kernel code path (scalar-
+    prefetch page gather, online softmax over pages, the shared
+    block-skip predicate) the TPU runs through Mosaic, instead of the XLA
+    reference fallback."""
+    from paddle_tpu.ops.pallas.paged_attention import force_interpret
 
     with force_interpret():
         yield

@@ -1,0 +1,232 @@
+"""Serving engine, system tier (slow: each engine costs a fresh XLA
+compile): packed-batch == per-request loops, copy-free eviction
+equivalence, the static-batch baseline, and the hardened HTTP front-end
+(streaming /generate, concurrency, bounded queue, Content-Length caps)."""
+import io
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_serving import _engine, _model, _prompts, _teacher_greedy
+
+
+@pytest.fixture(scope="module")
+def shared():
+    m, cfg = _model()
+    return m, cfg, _engine(m)
+
+
+class TestEngineSystem:
+    def test_packed_decode_equals_per_request_loops(self, shared):
+        """ISSUE acceptance: one packed multi-request decode step produces
+        exactly what isolated per-request decode loops produce (the shared
+        4-slot engine vs a 1-slot engine)."""
+        m, cfg, eng = shared
+        rng = np.random.RandomState(1)
+        prompts = _prompts(rng, cfg, (6, 13, 4, 9))
+        packed = eng.generate(prompts, max_new_tokens=5)
+        one = _engine(m, decode_batch=1)
+        per_req = [one.generate([p], max_new_tokens=5)[0] for p in prompts]
+        assert packed == per_req
+
+    def test_eviction_recovers_same_greedy_tokens(self, shared):
+        """Copy-free eviction = preempt-by-recomputation: a starved pool
+        must still produce the un-starved greedy streams (vs the full-
+        forward teacher)."""
+        m, cfg, _ = shared
+        rng = np.random.RandomState(3)
+        prompts = _prompts(rng, cfg, (8, 8, 8))
+        starved_eng = _engine(m, num_pages=10, decode_batch=3,
+                              max_seq_len=32)
+        # submit/run directly (generate() releases finished requests, and
+        # this test needs the per-request eviction counters afterwards)
+        rids = [starved_eng.submit(p, max_new_tokens=12) for p in prompts]
+        starved_eng.run_until_idle()
+        reqs = [starved_eng.scheduler.get(r) for r in rids]
+        starved = [list(r.generated) for r in reqs]
+        assert starved == [_teacher_greedy(m, p, 12) for p in prompts]
+        assert sum(r.evictions for r in reqs) > 0  # the pool DID starve
+        starved_eng.allocator.check_consistency()
+        assert starved_eng.allocator.used_pages == 0
+
+    def test_static_batch_matches_greedy(self, shared):
+        m, cfg, eng = shared
+        rng = np.random.RandomState(5)
+        prompts = _prompts(rng, cfg, (5, 9, 3))
+        cont = eng.generate(prompts, max_new_tokens=4)
+        reqs = eng.static_batch_generate(prompts, 4)
+        assert [r.generated for r in reqs] == cont
+
+    def test_sampled_streams_reproducible(self):
+        m, cfg = _model()
+        rng = np.random.RandomState(6)
+        prompts = _prompts(rng, cfg, (5, 11))
+
+        def run():
+            return _engine(m).generate(prompts, max_new_tokens=6,
+                                       temperature=0.9, top_k=50,
+                                       top_p=0.95)
+
+        assert run() == run()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end
+# ---------------------------------------------------------------------------
+
+def _post_raw(port, path, body: bytes, headers=None, read_all=True):
+    """Raw-socket POST so we can observe early rejections (a urllib client
+    dies on the broken pipe when the server 413s before the body lands)."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=30)
+    try:
+        head = [f"POST {path} HTTP/1.1", "Host: x"]
+        for k, v in (headers or {}).items():
+            head.append(f"{k}: {v}")
+        s.sendall(("\r\n".join(head) + "\r\n\r\n").encode())
+        try:
+            s.sendall(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass                                   # server rejected early
+        s.shutdown(socket.SHUT_WR)
+        chunks = []
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            chunks.append(b)
+            if not read_all and b"\r\n\r\n" in b"".join(chunks):
+                break
+        return b"".join(chunks)
+    finally:
+        s.close()
+
+
+class TestHTTPFrontend:
+    @pytest.fixture(scope="class")
+    def engine_server(self):
+        m, cfg = _model()
+        eng = _engine(m)
+        srv = eng.serve_http(0, block=False)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield eng, srv.server_address[1], cfg
+        eng.shutdown_http()
+
+    def test_streaming_generate_and_parity(self, engine_server):
+        eng, port, cfg = engine_server
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, cfg.vocab_size, 7).tolist()
+        body = json.dumps({"prompt_ids": prompt,
+                           "max_new_tokens": 5}).encode()
+        resp = _post_raw(port, "/generate", body,
+                         {"Content-Length": len(body)})
+        head, payload = resp.split(b"\r\n\r\n", 1)
+        assert b"200" in head.split(b"\r\n")[0]
+        events = [json.loads(l) for l in payload.strip().splitlines()]
+        toks = [e["token"] for e in events if "token" in e]
+        assert events[-1]["done"] and events[-1]["tokens"] == 5
+        assert toks == _teacher_greedy(eng.model, np.asarray(prompt), 5)
+
+    def test_concurrent_streams_interleave(self, engine_server):
+        eng, port, cfg = engine_server
+        results = {}
+
+        def call(i, n):
+            body = json.dumps({"prompt_ids": [3 + i, 7, 11],
+                               "max_new_tokens": n}).encode()
+            resp = _post_raw(port, "/generate", body,
+                             {"Content-Length": len(body)})
+            payload = resp.split(b"\r\n\r\n", 1)[1]
+            results[i] = [json.loads(l)
+                          for l in payload.strip().splitlines()]
+
+        threads = [threading.Thread(target=call, args=(i, 4 + i))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(results[i][-1]["tokens"] == 4 + i for i in range(3))
+
+    def test_bad_payload_yields_error_event(self, engine_server):
+        eng, port, _ = engine_server
+        body = json.dumps({"max_new_tokens": 2}).encode()   # no prompt_ids
+        resp = _post_raw(port, "/generate", body,
+                         {"Content-Length": len(body)})
+        payload = resp.split(b"\r\n\r\n", 1)[1]
+        events = [json.loads(l) for l in payload.strip().splitlines()]
+        assert "error" in events[-1] and "KeyError" in events[-1]["error"]
+
+    def test_content_length_cap_and_missing(self, engine_server):
+        _, port, _ = engine_server
+        resp = _post_raw(port, "/generate", b"x" * 64,
+                         {"Content-Length": 9 << 20}, read_all=False)
+        assert b"413" in resp.split(b"\r\n")[0]
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s.sendall(b"POST /generate HTTP/1.1\r\nHost: x\r\n\r\n")
+        resp = s.recv(65536)
+        s.close()
+        assert b"411" in resp.split(b"\r\n")[0]
+
+    def test_unknown_path_404(self, engine_server):
+        _, port, _ = engine_server
+        resp = _post_raw(port, "/nope", b"{}", {"Content-Length": 2})
+        assert b"404" in resp.split(b"\r\n")[0]
+
+    def test_bounded_queue_503(self):
+        """queue_limit in-flight handlers -> the next connection is turned
+        away immediately instead of head-of-line blocking."""
+        from paddle_tpu.inference.serve import build_http_server
+
+        release = threading.Event()
+
+        def slow_gen(payload, deadline):
+            release.wait(timeout=30)
+            yield {"done": True}
+
+        srv = build_http_server(0, generate_fn=slow_gen, queue_limit=1,
+                                timeout_s=30)
+        port = srv.server_address[1]
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            body = b"{}"
+            hold = threading.Thread(
+                target=_post_raw, args=(port, "/generate", body),
+                kwargs={"headers": {"Content-Length": 2}}, daemon=True)
+            hold.start()
+            time.sleep(0.3)                       # let it occupy the slot
+            resp = _post_raw(port, "/generate", body,
+                             {"Content-Length": 2})
+            assert b"503" in resp.split(b"\r\n")[0]
+        finally:
+            release.set()
+            srv.shutdown()
+            srv.server_close()
+
+    def test_threading_run_endpoint_still_serves(self):
+        from paddle_tpu.inference.serve import build_http_server
+
+        def run_fn(arrays):
+            return [arrays[0] * 2]
+
+        srv = build_http_server(0, run_fn=run_fn)
+        port = srv.server_address[1]
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            buf = io.BytesIO()
+            np.savez(buf, inp0=np.arange(4.0))
+            body = buf.getvalue()
+            resp = _post_raw(port, "/run", body,
+                             {"Content-Length": len(body)})
+            payload = resp.split(b"\r\n\r\n", 1)[1]
+            with np.load(io.BytesIO(payload)) as z:
+                np.testing.assert_array_equal(z["out0"], np.arange(4.0) * 2)
+        finally:
+            srv.shutdown()
+            srv.server_close()
