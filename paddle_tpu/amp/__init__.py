@@ -156,6 +156,11 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # consecutive inf-skip streak: a permanently-NaN model must be
+        # SURFACED (warning at half the limit, FloatingPointError at
+        # FLAGS_scaler_max_consecutive_skips), not skip silently forever
+        self._consecutive_skips = 0
+        self._skip_streak_warned = False
         # per-optimizer INIT/UNSCALED/STEPPED state so `scaler.unscale_(opt);
         # clip; scaler.step(opt)` doesn't divide grads by the scale twice
         # (reference amp/grad_scaler.py OptimizerState)
@@ -203,8 +208,41 @@ class GradScaler:
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
 
+    def _track_skip_streak(self):
+        from paddle_tpu.core.flags import flag
+
+        if not self._found_inf:
+            self._consecutive_skips = 0
+            self._skip_streak_warned = False
+            return
+        self._consecutive_skips += 1
+        limit = int(flag("scaler_max_consecutive_skips"))
+        if not limit:
+            return
+        if self._consecutive_skips >= limit:
+            raise FloatingPointError(
+                f"GradScaler skipped {self._consecutive_skips} consecutive "
+                f"steps on non-finite gradients — the model is almost "
+                f"certainly permanently NaN (poisoned weights or a diverged "
+                f"run) and no further step can recover it by itself. "
+                f"Halting instead of skipping forever; roll back to a "
+                f"healthy checkpoint (docs/resilience.md). Limit is "
+                f"FLAGS_scaler_max_consecutive_skips={limit} (0 disables).")
+        if (not self._skip_streak_warned
+                and self._consecutive_skips >= max(1, limit // 2)):
+            self._skip_streak_warned = True
+            import warnings
+
+            warnings.warn(
+                f"GradScaler has skipped {self._consecutive_skips} "
+                f"consecutive steps on non-finite gradients (loss scale now "
+                f"{self._scale:g}); training is making NO progress and will "
+                f"halt at FLAGS_scaler_max_consecutive_skips={limit}")
+
     def update(self):
         self._opt_states.clear()
+        if self._enable:
+            self._track_skip_streak()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -143,11 +143,50 @@ define_flag("fp8_amax_history_len", 16,
             "delayed-scaling amax history length per fp8 matmul callsite "
             "(the scale maps max(history) to the fp8 dtype max)", type=int)
 define_flag("ckpt_fault_injection", "",
-            "elastic-checkpoint fault injection: raise (simulating a kill) "
-            "at the named commit-protocol phase boundary — one of "
-            "after_snapshot|after_shard_write|after_metadata|before_rename|"
-            "before_commit|after_commit; empty = off. Driven by the "
-            "crash-consistency tests and the bench checkpointing arm")
+            "LEGACY alias for the unified fault registry "
+            "(distributed.resilience.faults): arms 'ckpt.<value>' in "
+            "always-fire mode — one of after_snapshot|after_shard_write|"
+            "after_metadata|before_rename|before_commit|after_commit; "
+            "empty = off. Prefer FLAGS_fault_injection='ckpt.<point>'")
+define_flag("fault_injection", "",
+            "unified fault-injection spec: ';'-separated armings of "
+            "registered points, each 'name[:opts]' with opts nth=K | p=X "
+            "| seed=N | mode=once|always (default one-shot), e.g. "
+            "'feeder.collate' or 'ckpt.before_rename:nth=8;"
+            "step.grads:p=0.05,seed=7'. Catalog: resilience.faults"
+            ".describe() / docs/resilience.md")
+define_flag("anomaly_detection", False,
+            "compiled-step anomaly detection default (consulted when a "
+            "step is constructed with anomaly_detector=None): compute the "
+            "in-program health scalar (NaN/inf loss or grads; unhealthy "
+            "steps skip the optimizer update) and feed the host-side "
+            "loss-spike detector")
+define_flag("anomaly_policy", "rollback",
+            "default escalation policy of a flag-constructed "
+            "AnomalyDetector: warn|skip_batch|rollback|halt "
+            "(docs/resilience.md)")
+define_flag("anomaly_window", 32,
+            "rolling loss window (finite losses) behind the median+MAD "
+            "spike detector", type=int)
+define_flag("anomaly_mad_k", 12.0,
+            "loss-spike threshold: flag losses above "
+            "median + k * 1.4826 * MAD of the rolling window", type=float)
+define_flag("anomaly_min_history", 8,
+            "finite losses required in the window before spike detection "
+            "activates (non-finite detection is always on)", type=int)
+define_flag("scaler_max_consecutive_skips", 100,
+            "GradScaler: halt (FloatingPointError) after this many "
+            "CONSECUTIVE inf-skip steps — a permanently-NaN model must "
+            "stop, not silently skip forever (a warning fires at half "
+            "this count; 0 disables both)", type=int)
+define_flag("store_barrier_retries", 2,
+            "TCPStore barrier: bounded retry-with-backoff attempts after "
+            "a timed-out wait before escalating the TimeoutError to the "
+            "caller (the watchdog save-and-exit path)", type=int)
+define_flag("store_heartbeat_interval_s", 5.0,
+            "RankHeartbeat beat interval: each rank refreshes its "
+            "__hb__/<job>/<rank> liveness key this often so dead_peers() "
+            "can NAME a dead rank within ~2 intervals", type=float)
 define_flag("ckpt_keep_last", 3,
             "committed elastic snapshots retained per checkpoint root "
             "(older ones are GC'd after each commit; 0 keeps all)", type=int)

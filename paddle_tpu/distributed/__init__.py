@@ -19,6 +19,7 @@ from paddle_tpu.distributed.parallel import (  # noqa: F401
     DataParallel, init_parallel_env, is_initialized,
 )
 from paddle_tpu.distributed import checkpoint  # noqa: F401
+from paddle_tpu.distributed import resilience  # noqa: F401
 from paddle_tpu.distributed import fleet  # noqa: F401
 from paddle_tpu.distributed import utils  # noqa: F401
 from paddle_tpu.distributed.auto_parallel.api import (  # noqa: F401

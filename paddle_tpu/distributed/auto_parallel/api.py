@@ -406,7 +406,10 @@ class DistModel:
 
             self._step = CompiledTrainStep(
                 self.network, lambda out, lab: self._loss(out, lab),
-                self._optimizer, mesh=self._mesh, zero_axis=self._zero_axis)
+                self._optimizer, mesh=self._mesh, zero_axis=self._zero_axis,
+                # Model.fit(resilience=) parks its AnomalyDetector here so
+                # the lazily built step carries the in-program health check
+                anomaly_detector=getattr(self, "_anomaly", None))
             pending = getattr(self, "_pending_resume", None)
             if pending is not None:
                 # an elastic checkpoint restored before this lazy build left

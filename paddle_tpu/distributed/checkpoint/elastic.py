@@ -23,7 +23,8 @@ resolves ONLY committed snapshots, so a kill at ANY point — mid shard write,
 before the rename, between rename and marker — leaves the previous committed
 checkpoint loadable. Keep-last-K GC runs after commit and never touches the
 newest committed snapshot. Every phase boundary honors the
-``FLAGS_ckpt_fault_injection`` knob (`FAULT_POINTS`), which the
+unified fault registry's ``ckpt.*`` points (`FAULT_POINTS`; the legacy
+``FLAGS_ckpt_fault_injection`` knob still arms them), which the
 crash-consistency tests and ``bench.py checkpointing`` drive.
 
 **Cross-mesh resume.** Snapshots store mesh-agnostic NAMES (model state-dict
@@ -56,6 +57,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.distributed.resilience import faults
+
 __all__ = [
     "FAULT_POINTS", "CheckpointFaultInjected", "Snapshot", "capture",
     "capture_model", "capture_modules", "restore", "rename_arrays",
@@ -71,16 +74,33 @@ _COMMIT = "COMMIT"
 _TMP = "tmp"
 
 
-class CheckpointFaultInjected(RuntimeError):
-    """Raised at the FLAGS_ckpt_fault_injection point — the test/bench
-    stand-in for a kill -9 at that exact phase of the commit protocol."""
+class CheckpointFaultInjected(faults.FaultInjected):
+    """Raised at an armed ckpt.* fault point — the test/bench stand-in for
+    a kill -9 at that exact phase of the commit protocol. Armed through the
+    unified registry (resilience.faults) or the legacy
+    FLAGS_ckpt_fault_injection string knob."""
+
+
+_PHASE_DOCS = {
+    "after_snapshot": "after the donation-safe device copies, before any "
+                      "readback/IO",
+    "after_shard_write": "shard container written+fsync'd, before the "
+                         "written barrier",
+    "after_metadata": "global metadata merged and written, before the "
+                      "publish rename",
+    "before_rename": "the last instant the snapshot is still invisible",
+    "before_commit": "renamed into place but no COMMIT marker yet",
+    "after_commit": "committed; GC has not run",
+}
+for _p in FAULT_POINTS:
+    faults.register(f"ckpt.{_p}",
+                    f"elastic-checkpoint commit protocol: {_PHASE_DOCS[_p]}",
+                    exc=CheckpointFaultInjected,
+                    legacy_flag=("ckpt_fault_injection", _p))
 
 
 def _maybe_inject(point: str):
-    from paddle_tpu.core.flags import flag
-
-    if flag("ckpt_fault_injection") == point:
-        raise CheckpointFaultInjected(point)
+    faults.point(f"ckpt.{point}")
 
 
 def _step_dirname(step: int) -> str:
@@ -289,6 +309,12 @@ class _SaveHandle:
     def done(self) -> bool:
         return self._done.is_set()
 
+    def error(self):
+        """The writer's exception for this snapshot (None while in flight
+        or on success) — the non-blocking probe a supervisor reaps failed
+        saves with."""
+        return self._err if self._done.is_set() else None
+
     def wait(self, timeout=None):
         if not self._done.wait(timeout):
             raise TimeoutError(f"checkpoint save of step {self.step} "
@@ -393,6 +419,12 @@ class CheckpointManager:
         """Mark the job preempted (SIGTERM / watchdog hang); training loops
         poll `should_stop` and exit after the save."""
         self.preempt_reason = reason
+
+    def clear_preempt(self):
+        """Un-mark preemption — the resilience supervisor calls this after
+        an in-process restart from a hang (the checkpoint the hang handler
+        committed has been restored; training may continue)."""
+        self.preempt_reason = None
 
     @property
     def should_stop(self) -> bool:
@@ -522,7 +554,7 @@ class CheckpointManager:
 
     def _write_snapshot(self, snapshot: Snapshot):
         """tmp write -> fsync -> metadata -> rename -> COMMIT -> GC, with a
-        FLAGS_ckpt_fault_injection check at every phase boundary."""
+        ``ckpt.*`` fault-point check at every phase boundary."""
         if self.writing_in_this_thread:
             raise RuntimeError(
                 "re-entrant checkpoint save on the same thread (signal "

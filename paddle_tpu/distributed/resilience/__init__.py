@@ -1,0 +1,24 @@
+"""Self-healing training (ISSUE 10): unified fault injection, in-program
+anomaly detection with checkpoint rollback, and a supervised recovery loop.
+
+Pieces (docs/resilience.md has the full catalog and semantics):
+
+* `faults` — the unified fault-injection registry: every subsystem's named
+  injection points, one-shot/nth-hit/probabilistic triggers, armed from
+  code or `FLAGS_fault_injection`.
+* `anomaly.AnomalyDetector` — the per-step health scalar (riding the
+  compiled step's `found_inf` convention) + host-side median+MAD loss-spike
+  detection, with escalation policies warn | skip_batch | rollback | halt.
+* `supervisor.run_resilient` — the supervised loop: rollback to the last
+  committed elastic checkpoint, data-cursor fast-forward, batch
+  quarantine, feeder-crash retry, hang restart, JSONL incident log,
+  bounded budgets ending in a structured `ResilienceHalt`.
+"""
+from paddle_tpu.distributed.resilience import faults  # noqa: F401
+from paddle_tpu.distributed.resilience.anomaly import (  # noqa: F401
+    Anomaly, AnomalyDetector)
+from paddle_tpu.distributed.resilience.supervisor import (  # noqa: F401
+    IncidentLog, ResilienceHalt, ResiliencePolicy, run_resilient)
+
+__all__ = ["faults", "Anomaly", "AnomalyDetector", "IncidentLog",
+           "ResilienceHalt", "ResiliencePolicy", "run_resilient"]

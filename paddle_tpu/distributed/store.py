@@ -18,8 +18,16 @@ import threading
 import time
 
 from paddle_tpu.core.native import lib as _native_lib
+from paddle_tpu.distributed.resilience import faults
 
-__all__ = ["TCPStore", "create_or_get_global_tcp_store"]
+__all__ = ["TCPStore", "create_or_get_global_tcp_store", "RankHeartbeat",
+           "dead_peers"]
+
+faults.register(
+    "store.barrier",
+    "flaky rendezvous transport: one barrier wait attempt fails (the "
+    "bounded retry-with-backoff must absorb a transient fault; a "
+    "persistent one escalates as TimeoutError)")
 
 
 class _PyStoreServer:
@@ -239,29 +247,51 @@ class TCPStore:
         return outs[0] if len(outs) == 1 else outs
 
     def barrier(self, name: str, world_size: int, timeout: float = 300.0,
-                rank: int | None = None):
+                rank: int | None = None, retries: int | None = None,
+                retry_backoff: float = 0.25):
         """All-arrive barrier. With `rank` given, each participant also
         marks a per-rank key, so a timeout reports WHICH ranks never showed
-        up instead of only how many."""
+        up instead of only how many.
+
+        A timed-out (or transiently failed) wait is RETRIED with bounded
+        exponential backoff — `retries` extra attempts (None reads
+        FLAGS_store_barrier_retries), each with the full `timeout` budget —
+        before the TimeoutError escalates to the caller (on a supervised
+        run, the watchdog save-and-exit path). Arrival is registered ONCE;
+        only the wait is retried, so a retry can never double-count a
+        rank."""
+        if retries is None:
+            from paddle_tpu.core.flags import flag
+
+            retries = int(flag("store_barrier_retries"))
         n = self.add(f"__barrier__/{name}", 1)
         if rank is not None:
             self.set(f"__barrier_arrived__/{name}/{rank}", b"1")
         if n == world_size:
             self.set(f"__barrier_done__/{name}", b"1")
-        try:
-            self.wait(f"__barrier_done__/{name}", timeout)
-        except TimeoutError:
-            arrived_n = struct.unpack(
-                "<q", self.get(f"__barrier__/{name}", b"\0" * 8))[0]
-            detail = f"{arrived_n}/{world_size} ranks arrived"
-            if rank is not None:
-                present = [r for r in range(world_size) if self.get(
-                    f"__barrier_arrived__/{name}/{r}") is not None]
-                absent = [r for r in range(world_size) if r not in present]
-                detail += f"; missing ranks {absent} (arrived: {present})"
-            raise TimeoutError(
-                f"TCPStore barrier '{name}' timed out after {timeout:.1f}s: "
-                f"{detail}") from None
+        backoff = retry_backoff
+        for attempt in range(retries + 1):
+            try:
+                faults.point("store.barrier")
+                self.wait(f"__barrier_done__/{name}", timeout)
+                return
+            except (TimeoutError, faults.FaultInjected, ConnectionError):
+                if attempt >= retries:
+                    break
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+        arrived_n = struct.unpack(
+            "<q", self.get(f"__barrier__/{name}", b"\0" * 8))[0]
+        detail = f"{arrived_n}/{world_size} ranks arrived"
+        if rank is not None:
+            present = [r for r in range(world_size) if self.get(
+                f"__barrier_arrived__/{name}/{r}") is not None]
+            absent = [r for r in range(world_size) if r not in present]
+            detail += f"; missing ranks {absent} (arrived: {present})"
+        raise TimeoutError(
+            f"TCPStore barrier '{name}' timed out after {retries + 1} "
+            f"attempt(s) of {timeout:.1f}s (backoff {retry_backoff}s->"
+            f"{backoff:.2f}s): {detail}") from None
 
     def close(self):
         if self._native is not None:
@@ -327,6 +357,117 @@ class _PyClient:
                 raise ConnectionError
             buf += c
         return buf
+
+
+# -- rank liveness -----------------------------------------------------------
+HEARTBEAT_PREFIX = "__hb__"
+# thread-name prefix for the beat thread: the test suite's thread-hygiene
+# guard keys on it, so a leaked heartbeat fails loudly
+HEARTBEAT_THREAD_PREFIX = "paddle_tpu.store.heartbeat"
+
+
+class RankHeartbeat:
+    """Per-rank liveness beacon: a background thread refreshes
+    ``__hb__/<job>/<rank>`` with the wall-clock every `interval_s` (None
+    reads FLAGS_store_heartbeat_interval_s), so `dead_peers()` can NAME a
+    dead rank within ~2 intervals instead of every healthy rank discovering
+    it only when a barrier times out. `stop()` joins the thread (the
+    thread-hygiene contract) and by default writes a CLEAN-EXIT tombstone
+    (timestamp +inf), so `dead_peers()` can tell a rank that shut down
+    cleanly (tombstone: not dead) from one that died (stale timestamp:
+    dead, with age) and one that never came up (no key at all)."""
+
+    def __init__(self, store: TCPStore, job_id: str, rank: int,
+                 interval_s: float | None = None):
+        if interval_s is None:
+            from paddle_tpu.core.flags import flag
+
+            interval_s = float(flag("store_heartbeat_interval_s"))
+        self.store = store
+        self.key = f"{HEARTBEAT_PREFIX}/{job_id}/{int(rank)}"
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self.beats = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"{HEARTBEAT_THREAD_PREFIX}.{job_id}.{rank}")
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            try:
+                self.store.set(self.key, struct.pack("<d", time.time()))
+                self.beats += 1
+            except (ConnectionError, OSError):
+                # a dead store means the job is coming down anyway; keep
+                # trying until stopped so a recovered store sees us alive
+                pass
+            if self._stop.wait(self.interval_s):
+                return
+
+    def stop(self, mark_clean: bool = True):
+        """Stop beating and JOIN the thread; by default write the
+        clean-exit tombstone (+inf) so this rank never reads as a corpse."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        if mark_clean:
+            try:
+                self.store.set(self.key, struct.pack("<d", float("inf")))
+            except (ConnectionError, OSError):
+                pass  # store already gone — nothing left to mark
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+
+def dead_peers(store: TCPStore, job_id: str, world_size: int,
+               timeout_s: float | None = None,
+               watch: dict | None = None) -> list:
+    """Name the ranks whose heartbeat is stale (or absent): returns
+    ``[{"rank", "age_s"}]`` where age_s is None for a rank that never
+    beat at all. A rank that wrote the clean-exit tombstone (+inf) is
+    NOT dead — it left. `timeout_s` defaults to 2.5x the heartbeat
+    interval — one missed beat is scheduling noise, two is a corpse.
+
+    Without `watch`, age compares the remote rank's wall-clock stamp
+    against the LOCAL clock — fine in-process, but on a real pod an
+    NTP-skewed peer reads as a permanent corpse (clock behind) or a
+    fresh ghost (clock ahead). A polling monitor should pass `watch`
+    (a dict it keeps between calls): staleness is then measured as
+    local time since the rank's beat VALUE last changed, so cross-host
+    clock skew cancels entirely. The first poll only primes the dict;
+    deaths surface from the second poll on."""
+    if timeout_s is None:
+        from paddle_tpu.core.flags import flag
+
+        timeout_s = 2.5 * float(flag("store_heartbeat_interval_s"))
+    now = time.time()
+    out = []
+    for r in range(int(world_size)):
+        raw = store.get(f"{HEARTBEAT_PREFIX}/{job_id}/{r}")
+        if raw is None:
+            out.append({"rank": r, "age_s": None})
+            continue
+        beat = struct.unpack("<d", raw)[0]
+        if beat == float("inf"):
+            if watch is not None:
+                watch.pop(r, None)
+            continue  # clean exit, not a corpse
+        if watch is not None:
+            prev = watch.get(r)
+            if prev is None or prev[0] != beat:
+                watch[r] = (beat, now)
+                continue  # fresh (or first-seen) beat: alive by definition
+            age = now - prev[1]
+        else:
+            age = now - beat
+        if age > timeout_s:
+            out.append({"rank": r, "age_s": round(age, 2)})
+    return out
 
 
 _global_store = [None]

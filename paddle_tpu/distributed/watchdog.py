@@ -12,12 +12,13 @@ doesn't arrive within the timeout, it fires the hang callback with diagnostics
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable
 
-__all__ = ["CommTask", "CommTaskManager", "watch_step"]
+__all__ = ["CommTask", "CommTaskManager", "watch_step", "thread_stacks"]
 
 
 @dataclass
@@ -109,10 +110,14 @@ class CommTaskManager:
             self._tasks[t.task_id] = t
         return t
 
-    def diagnostics(self, task: CommTask | None = None) -> dict:
+    def diagnostics(self, task: CommTask | None = None,
+                    py_stacks: bool = True) -> dict:
         """Structured hang report: the hung task (name/elapsed/timeout),
-        the LAST COMPLETED step, every in-flight task's name+elapsed, and
-        the hang history — what a dead pod's post-mortem needs, as data
+        the LAST COMPLETED step, every in-flight task's name+elapsed, the
+        hang history, and — `py_stacks` — a Python stack dump of every
+        live thread (`sys._current_frames`), so a stuck barrier names
+        WHERE each thread is blocked (which wait/join/recv call), not just
+        that something hangs. What a dead pod's post-mortem needs, as data
         rather than a log line."""
         with self._lock:
             in_flight = [
@@ -130,7 +135,34 @@ class CommTaskManager:
             "in_flight": in_flight,
             "hang_count": len(self.hangs),
         }
+        if py_stacks:
+            diag["threads"] = thread_stacks()
         return diag
+
+
+def thread_stacks() -> list:
+    """Python stack dump of every live thread: ``[{"name", "ident",
+    "daemon", "stack": ["file:line in fn: source", ...]}]`` (innermost
+    frame LAST). The watchdog attaches this to every hang report so the
+    post-mortem shows where each thread — the feeder, the checkpoint
+    writer, the main loop stuck in a barrier — is actually blocked."""
+    import sys
+    import traceback
+
+    frames = sys._current_frames()
+    by_ident = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        t = by_ident.get(ident)
+        stack = [
+            f"{os.path.basename(fs.filename)}:{fs.lineno} in {fs.name}: "
+            f"{(fs.line or '').strip()}"
+            for fs in traceback.extract_stack(frame)]
+        out.append({"name": t.name if t else f"<thread-{ident}>",
+                    "ident": ident,
+                    "daemon": bool(t.daemon) if t else None,
+                    "stack": stack})
+    return out
 
 
 _manager = CommTaskManager()
@@ -159,8 +191,6 @@ def watch_step(arrays, name: str = "train_step", timeout_s: float = 600.0,
 
 
 def _dump_path():
-    import os
-
     return os.path.join(os.getenv("PADDLE_LOG_DIR", "."),
                         f"comm_task_dump_{os.getpid()}.json")
 

@@ -143,12 +143,19 @@ class AutoCheckpoint(Callback):
         self._uninstall = None
         self._epoch = 0
         self._it = 0
+        self._epoch_it = 0
         self._last_saved = None
+        # resilient mode (fit(resilience=...)): a FAILED save becomes an
+        # incident + retry at the next cadence instead of killing the run
+        # (the previous committed snapshot stays loadable throughout)
+        self.resilient = False
+        self.incidents: list = []
 
     def _capture(self):
         from paddle_tpu.distributed.checkpoint import elastic
 
-        cursor = {"epoch": self._epoch, "iteration": self._it}
+        cursor = {"epoch": self._epoch, "iteration": self._it,
+                  "epoch_it": self._epoch_it}
         dm = getattr(self.model, "_dist_model", None)
         if dm is not None and getattr(dm, "_step", None) is not None:
             return elastic.capture(dm._step, cursor=cursor)
@@ -172,6 +179,19 @@ class AutoCheckpoint(Callback):
             # e.g. the SIGTERM handler's sync save already committed this
             # exact step — the state IS durable, keep winding down
             pass
+        except Exception as e:
+            if not self.resilient:
+                raise
+            self._save_incident(e)
+
+    def _save_incident(self, e):
+        import warnings
+
+        self.incidents.append({"event": "ckpt_save_failed", "cause": repr(e),
+                               "epoch": self._epoch, "it": self._it})
+        warnings.warn(
+            f"auto-checkpoint save failed ({e!r}); previous committed "
+            f"snapshot remains loadable — will retry at the next cadence")
 
     def on_train_begin(self, logs=None):
         from paddle_tpu.distributed.checkpoint import elastic
@@ -208,9 +228,14 @@ class AutoCheckpoint(Callback):
         # mid-epoch cadence saves must record the epoch actually running
         # (a resumed fit starts at initial_epoch, not 0)
         self._epoch = epoch
+        self._epoch_it = 0
 
     def on_train_batch_end(self, step, logs=None):
         self._it += 1
+        # batch-granular cursor WITHIN the epoch, derived from the loop's
+        # step index so a resilience replay (which re-runs steps >= the
+        # snapshot's epoch_it) keeps it consistent
+        self._epoch_it = step + 1
         if self.manager is None:
             return
         if self.manager.should_stop:
@@ -230,6 +255,18 @@ class AutoCheckpoint(Callback):
                 self._last_saved = snap.step
                 self.manager.save_async(snap)
 
+    def abort(self):
+        """Teardown for fit exiting via an exception (resilience halt,
+        exhausted budget): on_train_end will not run, but the preemption
+        handler must come off and the writer thread must be JOINED (the
+        thread-hygiene contract). close() is idempotent and does not
+        re-raise save errors already surfaced per handle."""
+        if self._uninstall is not None:
+            self._uninstall()
+            self._uninstall = None
+        if self.manager is not None:
+            self.manager.close()
+
     def on_train_end(self, logs=None):
         if self._uninstall is not None:
             self._uninstall()
@@ -239,7 +276,239 @@ class AutoCheckpoint(Callback):
                 self.manager.wait()
             except FileExistsError:
                 pass  # a duplicate-step async save: state is durable
+            except Exception as e:
+                if not self.resilient:
+                    self.manager.close()
+                    raise
+                self._save_incident(e)
             self.manager.close()
+
+
+class _EpochReplay(Exception):
+    """Internal fit control flow: re-run the epoch from `replay_from`
+    (batches before it are already covered by the restored snapshot / the
+    already-applied updates). `epoch` is None for the current epoch; a
+    rollback whose restored snapshot predates the current epoch sets it so
+    the fit loop re-enters THERE instead of silently dropping the batches
+    between the snapshot and the current epoch."""
+
+    def __init__(self, replay_from: int, cause: str, epoch: int | None = None):
+        super().__init__(cause)
+        self.replay_from = int(replay_from)
+        self.cause = cause
+        self.epoch = epoch
+
+
+class _FitResilience:
+    """Self-healing glue for `Model.fit(resilience=...)`
+    (docs/resilience.md).
+
+    Wires an AnomalyDetector into the compiled step (dist path: the
+    in-program health scalar + lazy settling; eager path: the per-batch
+    loss is observed directly — detection there is post-hoc, so only
+    'rollback' truly recovers a poisoned eager model), and turns
+    escalations into fit-loop actions:
+
+    * rollback  -> restore the latest committed AutoCheckpoint snapshot and
+                   replay the epoch from the snapshot's `epoch_it` cursor
+                   (bit-exact for deterministic, unshuffled loaders);
+    * skip_batch -> quarantine the (epoch, step) so replays skip it;
+    * halt      -> raise, with the incident list attached;
+    * feeder crashes -> resume the epoch after the last completed batch
+                   (no restore needed: the params are fine).
+
+    Budgets mirror the supervisor's: exhausting `max_rollbacks` or
+    `max_feeder_retries` raises instead of looping."""
+
+    def __init__(self, spec, model, autockpt, max_rollbacks=3,
+                 max_feeder_retries=2):
+        from paddle_tpu.distributed.resilience import faults
+        from paddle_tpu.distributed.resilience.anomaly import AnomalyDetector
+
+        # a malformed FLAGS_fault_injection spec fails here, at config
+        # time, not wrapped in FeederWorkerError at the first site hit
+        faults.check_flag_spec()
+        self.detector = (spec if isinstance(spec, AnomalyDetector)
+                         else AnomalyDetector(
+                             policy=None if spec is True else spec))
+        self.model = model
+        self.autockpt = autockpt
+        self.max_rollbacks = int(max_rollbacks)
+        self.max_feeder_retries = int(max_feeder_retries)
+        self.rollbacks = 0
+        self.feeder_retries = 0
+        self.incidents: list = []
+        self.quarantined: set = set()
+        self._stepmap: dict = {}
+        self._anomaly_counts: dict = {}
+        self._last_rb_step = None  # train-step of the last restored snapshot
+        if autockpt is not None:
+            autockpt.resilient = True
+
+    def attach(self):
+        """After on_train_begin: hand the detector to the (lazily built)
+        compiled step and make sure a rollback target exists."""
+        dm = getattr(self.model, "_dist_model", None)
+        if dm is not None:
+            dm._anomaly = self.detector
+            if dm._step is not None:
+                # a step compiled by an earlier fit predates the detector;
+                # sync its trained device state back FIRST (params and
+                # moments — dropping it raw would restart from the stale
+                # eager tensors), then drop it so the rebuild carries the
+                # health scalar
+                dm._step.drain()
+                dm._step.sync_params_to_model()
+                dm._step.sync_states_to_optimizer()
+                dm._step = None
+                self.model._dist_dirty = False
+        if (self.autockpt is not None and self.autockpt.manager is not None
+                and self.autockpt.manager.latest() is None):
+            self.autockpt._save(sync=True)  # the step-0 rollback target
+
+    def _incident(self, event, **fields):
+        rec = {"event": event, **fields}
+        self.incidents.append(rec)
+        return rec
+
+    def is_quarantined(self, epoch, step) -> bool:
+        return (epoch, step) in self.quarantined
+
+    def on_feeder_crash(self, err, epoch, completed_step) -> _EpochReplay:
+        self.feeder_retries += 1
+        self._incident("feeder_crash", epoch=epoch, phase=err.phase,
+                       batch_index=err.batch_index,
+                       cause=repr(err.__cause__))
+        if self.feeder_retries > self.max_feeder_retries:
+            raise RuntimeError(
+                f"input pipeline crashed {self.feeder_retries} times "
+                f"(last: {err}); incidents: {self.incidents}") from err
+        return _EpochReplay(completed_step + 1, f"feeder_crash:{err.phase}")
+
+    def after_batch(self, epoch, step, eager_loss=None):
+        """Observe the batch that just ran; raise _EpochReplay on a
+        rollback escalation."""
+        det = self.detector
+        dm = getattr(self.model, "_dist_model", None)
+        st = getattr(dm, "_step", None) if dm is not None else None
+        if st is not None and st.anomaly_detector is det:
+            self._stepmap[st.step_count] = (epoch, step)
+            st.settle_anomalies()
+        elif eager_loss is not None:
+            self._stepmap[len(self._stepmap) + 1] = (epoch, step)
+            det.observe(len(self._stepmap), float(eager_loss), 0.0)
+        self._handle_pending(epoch, step)
+
+    def settle_epoch_end(self, epoch, last_step):
+        """Settle anomalies still in the async run-ahead window before the
+        epoch-end callbacks run: after_batch only consumes READY health
+        buffers, so without this the last dispatch-window batches' anomalies
+        would escape this epoch — and the AutoCheckpoint epoch-end save
+        would commit poisoned state as the newest rollback target. Raises
+        _EpochReplay exactly like after_batch."""
+        dm = getattr(self.model, "_dist_model", None)
+        st = getattr(dm, "_step", None) if dm is not None else None
+        if st is not None and st.anomaly_detector is self.detector:
+            st.drain()  # settles every outstanding health scalar
+        self._handle_pending(epoch, last_step)
+
+    def _handle_pending(self, epoch, step):
+        det = self.detector
+        if det.pending is None:
+            return
+        a = det.pending
+        where = self._stepmap.get(a.step, (epoch, step))
+        rec = a.to_json()
+        rec["train_step"] = rec.pop("step")
+        self._incident("anomaly", epoch=where[0], step=where[1], **rec)
+        if a.action == "halt":
+            raise RuntimeError(
+                f"anomaly at epoch {where[0]} step {where[1]} with policy "
+                f"'halt': {a.kind} (loss={a.loss!r}); incidents: "
+                f"{self.incidents}")
+        self._anomaly_counts[where] = self._anomaly_counts.get(where, 0) + 1
+        if a.action == "skip_batch" or self._anomaly_counts[where] >= 2:
+            self.quarantined.add(where)
+            self._incident("quarantine", epoch=where[0], step=where[1])
+            if a.action == "skip_batch":
+                det.clear_pending()
+                return
+        det.clear_pending()
+        self._rollback(epoch, cause=f"anomaly:{a.kind}", anomaly_step=a.step)
+
+    def _rollback(self, epoch, cause, anomaly_step=None):
+        import time as _time
+
+        from paddle_tpu.distributed.checkpoint import elastic
+
+        if self.autockpt is None or self.autockpt.manager is None:
+            raise RuntimeError(
+                f"resilience policy 'rollback' needs "
+                f"fit(auto_checkpoint=...); {cause} detected but there is "
+                f"no checkpoint manager to restore from")
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise RuntimeError(
+                f"rollback budget ({self.max_rollbacks}) exhausted — "
+                f"persistent fault; incidents: {self.incidents}")
+        t0 = _time.perf_counter()
+        mgr = self.autockpt.manager
+        dm = getattr(self.model, "_dist_model", None)
+        if dm is not None and dm._step is not None:
+            dm._step.drain()
+        try:
+            mgr.wait()  # flush queued saves so latest() is current
+        except FileExistsError:
+            pass  # a duplicate-step async save: state is durable
+        except Exception as e:
+            self.autockpt._save_incident(e)
+        # poison-window guard (same rule as the supervisor): an anomaly
+        # RIGHT after a restore means the restored snapshot itself captured
+        # poisoned state (detection lag outran the save cadence) — step
+        # back past it instead of restoring the same poison forever
+        before = None
+        if (self._last_rb_step is not None and anomaly_step is not None
+                and anomaly_step <= self._last_rb_step + 2):
+            before = self._last_rb_step
+        candidates = [s for s in mgr.steps()
+                      if before is None or s < before]
+        if not candidates:
+            raise RuntimeError(
+                f"{cause}: no committed checkpoint "
+                f"{'older than step ' + str(before) if before else ''} to "
+                f"roll back to; incidents: {self.incidents}")
+        target = max(candidates)
+        arrays, meta = mgr.load(target)
+        self._last_rb_step = int(meta.get("step", 0))
+        elastic.restore(arrays, meta, self.model.network,
+                        self.model._optimizer)
+        if dm is not None:
+            dm._step = None  # rebuild from the restored weights
+            dm._pending_resume = (arrays, meta)
+        self.model._dist_dirty = False
+        self.detector.reset_history()
+        self.detector.clear_pending()
+        self._stepmap.clear()
+        cursor = meta.get("cursor") or {}
+        snap_epoch = int(cursor.get("epoch", epoch))
+        if cursor.get("epoch_end"):
+            # covers its whole epoch: replay resumes at the NEXT one
+            snap_epoch, snap_it = snap_epoch + 1, 0
+        else:
+            snap_it = int(cursor.get("epoch_it", 0))
+        # the snapshot can predate this epoch (e.g. the previous epoch-end
+        # save failed and resilient mode swallowed it): the replay must
+        # re-enter at the SNAPSHOT's epoch, or every batch between it and
+        # this epoch would be silently dropped from training
+        target_epoch = min(snap_epoch, epoch)
+        replay_from = snap_it if target_epoch == snap_epoch else 0
+        self._incident(
+            "rollback", epoch=epoch, to_step=int(meta.get("step", 0)),
+            replay_epoch=target_epoch, replay_from=replay_from, cause=cause,
+            recovery_ms=round((_time.perf_counter() - t0) * 1e3, 2))
+        raise _EpochReplay(replay_from, cause,
+                           epoch=(None if target_epoch == epoch
+                                  else target_epoch))
 
 
 class EarlyStopping(Callback):
@@ -448,7 +717,7 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None,
             prefetch_to_device=None, metrics_sync_every=None,
-            auto_checkpoint=None):
+            auto_checkpoint=None, resilience=None):
         """reference: hapi/model.py:1750.
 
         Async input/dispatch pipeline (compiled/mesh path only, and only when
@@ -466,7 +735,18 @@ class Model:
         callback) enabling crash-consistent elastic checkpointing: resume
         from the latest committed snapshot, async saves every
         FLAGS_ckpt_every_steps batches + every epoch end, SIGTERM
-        save-and-exit (docs/elastic_checkpoint.md)."""
+        save-and-exit (docs/elastic_checkpoint.md).
+
+        resilience: self-healing training (docs/resilience.md): an anomaly
+        policy string ('warn' | 'skip_batch' | 'rollback' | 'halt'), True
+        (flag-configured policy), or a resilience.AnomalyDetector. Enables
+        the compiled step's in-program health check (NaN/inf loss or grads
+        skip the update), host-side loss-spike detection, feeder-crash
+        epoch resume, failed-save retry, and — with 'rollback', which
+        requires auto_checkpoint — restore-and-replay of the current epoch
+        from the last committed snapshot (bit-exact for deterministic
+        unshuffled loaders). Budgets are bounded; a persistent fault raises
+        with the incident list attached instead of looping."""
         from paddle_tpu.core.flags import flag as _flag
 
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
@@ -486,10 +766,31 @@ class Model:
             cbs.append(ProgBarLogger(log_freq, verbose))
         if save_dir:
             cbs.append(ModelCheckpoint(save_freq, save_dir))
+        auto_cb = None
         if auto_checkpoint is not None:
-            cbs.append(auto_checkpoint
+            auto_cb = (auto_checkpoint
                        if isinstance(auto_checkpoint, AutoCheckpoint)
                        else AutoCheckpoint(auto_checkpoint))
+            cbs.append(auto_cb)
+        resil = None
+        if resilience is not None and resilience is not False:
+            resil = _FitResilience(resilience, self, auto_cb)
+            if resil.detector.policy == "rollback" and auto_cb is None:
+                raise ValueError(
+                    "fit(resilience='rollback') needs auto_checkpoint=: "
+                    "rollback restores the last committed elastic snapshot")
+            if (shuffle and not isinstance(train_data, DataLoader)
+                    and resil.detector.policy in ("rollback", "skip_batch")):
+                import warnings
+
+                warnings.warn(
+                    f"fit(resilience={resil.detector.policy!r}) replays and "
+                    f"quarantines batches BY POSITION, but shuffle=True "
+                    f"re-orders every epoch pass: a rollback replay will "
+                    f"train different samples than the snapshot covered and "
+                    f"a quarantine may skip an innocent sample. Pass "
+                    f"shuffle=False (or a deterministic loader) for "
+                    f"bit-exact recovery.")
         try:
             n_steps = len(loader)
         except TypeError:
@@ -501,75 +802,146 @@ class Model:
         history = []
         for cb in cbs:
             cb.on_train_begin()
+        if resil is not None:
+            resil.attach()
         # an AutoCheckpoint that resumed from an epoch-end snapshot skips
         # the finished epochs (epoch-granular data cursor)
         start_epoch = max((getattr(cb, "initial_epoch", 0) for cb in cbs),
                           default=0)
         it = 0
         stop_now = False
-        for epoch in range(start_epoch, epochs):
-            for m in self._metrics:
-                m.reset()
-            for cb in cbs:
-                cb.on_epoch_begin(epoch)
-            logs = {}
-            source = iter(loader)
-            feeder = None
-            if use_feed:
-                from paddle_tpu.io.device_feed import DeviceFeeder
-
-                feeder = DeviceFeeder(source, mesh=self._dist_model._mesh,
-                                      depth=feed_depth)
-                source = feeder
-            pending = None  # newest un-read LossFuture
-            last_loss = None
-            try:
-                for step, batch in enumerate(source):
-                    data, label = _split_batch(batch)
-                    sync = (k_sync <= 1) or ((step + 1) % k_sync == 0)
-                    logs = self.train_batch(list(data), label,
-                                            fetch=not use_async or sync)
-                    if use_async:
-                        lval = logs.get("loss")
-                        if isinstance(lval, (int, float)):
-                            last_loss = float(lval)
-                            pending = None
-                        else:  # deferred: report the last synced value
-                            pending = lval
-                            logs = dict(logs)
-                            if last_loss is None:
-                                del logs["loss"]
-                            else:
-                                logs["loss"] = last_loss
-                    for cb in cbs:
-                        cb.on_train_batch_end(step, logs)
-                    it += 1
-                    # preemption (SIGTERM / watchdog hang): the callback
-                    # saved; exit MID-epoch instead of finishing it
-                    stop_now = any(getattr(cb, "stop_training", False)
-                                   for cb in cbs)
-                    if stop_now or (num_iters and it >= num_iters):
-                        break
-            finally:
-                if feeder is not None:
-                    feeder.close()
-            if pending is not None:
-                # settle the epoch's true final loss before epoch-end logs
-                logs = dict(logs)
-                logs["loss"] = last_loss = float(pending)
-                pending = None
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_data, batch_size=batch_size, verbose=0)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+        epoch = start_epoch
+        rewind_from = 0  # replay offset injected by a cross-epoch rollback
+        try:
+            while epoch < epochs:
+                for m in self._metrics:
+                    m.reset()
                 for cb in cbs:
-                    cb.on_eval_end(eval_logs)
-            for cb in cbs:
-                cb.on_epoch_end(epoch, logs)
-            history.append(logs)
-            if stop_now or any(getattr(cb, "stopped", False) for cb in cbs):
-                break
-            if num_iters and it >= num_iters:
-                break
+                    cb.on_epoch_begin(epoch)
+                logs = {}
+                # resilience replays re-enter this loop: batches below
+                # `replay_from` are already covered (by the restored snapshot,
+                # or — after a feeder crash — by the updates that already ran)
+                # and are skipped; quarantined (epoch, step) pairs are skipped
+                # on every pass
+                replay_from = rewind_from
+                rewind_from = 0
+                rewind = None
+                while True:
+                    source = iter(loader)
+                    if replay_from:
+                        # fast-forward BEFORE the feeder wraps the stream, so
+                        # already-covered batches are never collated+device_put
+                        # just to be discarded by the consumer
+                        import itertools
+
+                        source = itertools.islice(source, replay_from, None)
+                    feeder = None
+                    if use_feed:
+                        from paddle_tpu.io.device_feed import DeviceFeeder
+
+                        feeder = DeviceFeeder(source,
+                                              mesh=self._dist_model._mesh,
+                                              depth=feed_depth)
+                        source = feeder
+                    pending = None  # newest un-read LossFuture
+                    last_loss = None
+                    replay = None
+                    step = replay_from - 1
+                    try:
+                        for batch in source:
+                            step += 1
+                            if resil is not None and resil.is_quarantined(epoch,
+                                                                          step):
+                                continue
+                            data, label = _split_batch(batch)
+                            sync = (k_sync <= 1) or ((step + 1) % k_sync == 0)
+                            logs = self.train_batch(list(data), label,
+                                                    fetch=not use_async or sync)
+                            if use_async:
+                                lval = logs.get("loss")
+                                if isinstance(lval, (int, float)):
+                                    last_loss = float(lval)
+                                    pending = None
+                                else:  # deferred: report the last synced value
+                                    pending = lval
+                                    logs = dict(logs)
+                                    if last_loss is None:
+                                        del logs["loss"]
+                                    else:
+                                        logs["loss"] = last_loss
+                            for cb in cbs:
+                                cb.on_train_batch_end(step, logs)
+                            it += 1
+                            if resil is not None:
+                                resil.after_batch(epoch, step,
+                                                  eager_loss=logs.get("loss"))
+                            # preemption (SIGTERM / watchdog hang): the callback
+                            # saved; exit MID-epoch instead of finishing it
+                            stop_now = any(getattr(cb, "stop_training", False)
+                                           for cb in cbs)
+                            if stop_now or (num_iters and it >= num_iters):
+                                break
+                    except _EpochReplay as rb:
+                        replay = rb
+                    except Exception as e:
+                        from paddle_tpu.io.device_feed import FeederWorkerError
+
+                        if resil is None or not isinstance(e, FeederWorkerError):
+                            raise
+                        replay = resil.on_feeder_crash(e, epoch,
+                                                       completed_step=step)
+                    finally:
+                        if feeder is not None:
+                            feeder.close()
+                    if replay is None:
+                        # anomalies still in the run-ahead window must settle
+                        # BEFORE on_epoch_end (the AutoCheckpoint save must not
+                        # commit state a health scalar already flagged); skipped
+                        # on a preemption stop — that path is winding down
+                        if resil is not None and not stop_now:
+                            try:
+                                resil.settle_epoch_end(epoch, step)
+                            except _EpochReplay as rb:
+                                replay = rb
+                        if replay is None:
+                            break
+                    if replay.epoch is not None and replay.epoch != epoch:
+                        # the restored snapshot predates this epoch: re-enter
+                        # the epoch loop there so the batches between the
+                        # snapshot and here are replayed, not dropped
+                        rewind = replay
+                        break
+                    replay_from = replay.replay_from
+                if rewind is not None:
+                    epoch = rewind.epoch
+                    rewind_from = rewind.replay_from
+                    continue
+                if pending is not None:
+                    # settle the epoch's true final loss before epoch-end logs
+                    logs = dict(logs)
+                    logs["loss"] = last_loss = float(pending)
+                    pending = None
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+                    logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                    for cb in cbs:
+                        cb.on_eval_end(eval_logs)
+                for cb in cbs:
+                    cb.on_epoch_end(epoch, logs)
+                history.append(logs)
+                if stop_now or any(getattr(cb, "stopped", False) for cb in cbs):
+                    break
+                if num_iters and it >= num_iters:
+                    break
+                epoch += 1
+        except BaseException:
+            # a resilience halt / exhausted budget escaping mid-run
+            # skips on_train_end: still uninstall the preemption
+            # handler and JOIN the checkpoint writer thread
+            if auto_cb is not None:
+                auto_cb.abort()
+            raise
         for cb in cbs:
             cb.on_train_end()
         return history

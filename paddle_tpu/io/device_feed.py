@@ -37,11 +37,21 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.resilience import faults
 from paddle_tpu.profiler import RecordEvent
 
-__all__ = ["DeviceFeeder", "prefetch_to_device", "BatchSpecCache",
-           "LossFuture", "DispatchWindow", "default_batch_spec",
-           "trim_batch_spec"]
+__all__ = ["DeviceFeeder", "FeederWorkerError", "prefetch_to_device",
+           "BatchSpecCache", "LossFuture", "DispatchWindow",
+           "default_batch_spec", "trim_batch_spec"]
+
+faults.register(
+    "feeder.collate",
+    "DeviceFeeder worker crash during fetch/collate of the next batch "
+    "(a dataset/transform bug or a dying storage mount)")
+faults.register(
+    "feeder.device_put",
+    "DeviceFeeder worker crash during the sharded host->device placement "
+    "of a collated batch")
 
 # thread-name prefix shared by every io/reader background thread: the test
 # suite's thread-hygiene guard keys on it to detect leaked prefetchers
@@ -240,6 +250,24 @@ class DispatchWindow:
         return len(self._pending)
 
 
+class FeederWorkerError(RuntimeError):
+    """A DeviceFeeder worker crash, re-raised in the CONSUMER with the
+    position attached: `batch_index` is the 0-based index (within this
+    feeder's stream) of the batch being processed when the worker died, and
+    `phase` says whether fetch/collate ('collate') or the sharded
+    host->device placement ('device_put') failed — so a supervisor can
+    rebuild the pipeline at the right cursor and an operator knows whether
+    to suspect the dataset or the device. The original exception rides as
+    ``__cause__``."""
+
+    def __init__(self, phase: str, batch_index: int, cause: BaseException):
+        super().__init__(
+            f"DeviceFeeder worker crashed in {phase!r} of batch "
+            f"{batch_index}: {cause!r}")
+        self.phase = phase
+        self.batch_index = batch_index
+
+
 class _End:
     __slots__ = ()
 
@@ -294,19 +322,28 @@ class DeviceFeeder:
         return interruptible_put(self._q, item, self._stop)
 
     def _run(self):
+        phase = "collate"
         try:
             while not self._stop.is_set():
+                phase = "collate"
                 with RecordEvent("DeviceFeeder::fetch"):
                     try:
+                        faults.point("feeder.collate")
                         batch = next(self._it)
                     except StopIteration:
                         break
+                phase = "device_put"
                 with RecordEvent("DeviceFeeder::place"):
+                    faults.point("feeder.device_put")
                     placed = self._place_batch(batch)
                 if not self._put(placed):
                     return
-        except BaseException as e:  # propagate to the consumer
-            self._err = e
+        except BaseException as e:  # propagate to the consumer, with the
+            # cursor + phase attached (batches_placed = the index of the
+            # batch that was being processed when the worker died)
+            err = FeederWorkerError(phase, self.batches_placed, e)
+            err.__cause__ = e
+            self._err = err
         finally:
             self._put(_End)
 
@@ -320,6 +357,9 @@ class DeviceFeeder:
         item = self._q.get()
         if item is _End:
             err = self._err
+            # close() also DRAINS the bounded queue: prefetched device
+            # batches queued behind the crash are freed (HBM back) and a
+            # producer blocked on a full queue can never deadlock shutdown
             self.close()
             if err is not None:
                 self._err = None

@@ -30,9 +30,38 @@ from paddle_tpu.autograd import tape as _tape
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.distributed.fleet import rng as fleet_rng
 from paddle_tpu.distributed.mesh import get_mesh
+from paddle_tpu.distributed.resilience import faults
 
 __all__ = ["CompiledTrainStep", "functional_call", "init_opt_states",
            "apply_optimizer_update"]
+
+faults.register(
+    "step.grads",
+    "poison one training step (fire_check site in CompiledTrainStep): "
+    "NaN-scales the first float batch leaf (NaN grads — the in-program "
+    "health check catches it the SAME step and skips the update) or, for "
+    "integer-only batches, the learning rate (params corrupted — caught "
+    "on the NEXT step's non-finite loss; only rollback recovers)")
+
+
+def _nan_poison(vals):
+    """Chaos helper for the `step.grads` point: NaN-scale the first
+    floating batch leaf. Returns (vals, poisoned?) — False means the batch
+    has no float leaf (token ids) and the caller poisons the lr instead."""
+    if isinstance(vals, dict):
+        for k in sorted(vals):
+            v = vals[k]
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+                out = dict(vals)
+                out[k] = v * jnp.asarray(float("nan"), v.dtype)
+                return out, True
+        return vals, False
+    out = list(vals)
+    for i, v in enumerate(out):
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            out[i] = v * jnp.asarray(float("nan"), v.dtype)
+            return tuple(out), True
+    return vals, False
 
 
 def _innermost_opt(opt):
@@ -351,6 +380,17 @@ class CompiledTrainStep:
       dispatch: flags settle lazily as their device values become ready
       (drain() settles all), so the scale a queued step uses may lag by the
       in-flight window — the documented async-AMP semantics.
+    anomaly_detector: in-program anomaly detection (docs/resilience.md):
+      an `resilience.AnomalyDetector` (or True for a flag-configured one;
+      None reads the `anomaly_detection` flag; False forces off). When on,
+      the step computes a health scalar (non-finite loss or grads) INSIDE
+      the program — an unhealthy step skips the whole optimizer update,
+      exactly like the GradScaler found_inf path — and settles it into the
+      detector lazily (only ready buffers are read), so `step_async`
+      run-ahead never blocks on detection. The detector additionally flags
+      host-side loss spikes (rolling median+MAD) and records/escalates per
+      its policy; the resilience supervisor or Model.fit(resilience=) act
+      on the escalations.
     scan_layers: stack the model's `scan_group()` layer parameters along a
       leading layer axis OUTSIDE the program and run the stack as one
       `lax.scan` — HLO size and compile time become O(1) in depth. None reads
@@ -367,7 +407,8 @@ class CompiledTrainStep:
                  metrics_every: int | None = None,
                  dispatch_window: int | None = None,
                  zero3_gather: str | None = None,
-                 fp8_policy: str | None = None, grad_scaler=None):
+                 fp8_policy: str | None = None, grad_scaler=None,
+                 anomaly_detector=None):
         from paddle_tpu.amp.fp8 import normalize_fp8_policy
         from paddle_tpu.core.flags import flag
         from paddle_tpu.io.device_feed import DispatchWindow
@@ -389,6 +430,29 @@ class CompiledTrainStep:
         self._scaler = (grad_scaler if grad_scaler is not None
                         and grad_scaler.is_enable() else None)
         self._pending_inf: list = []
+        # in-program anomaly detection (docs/resilience.md): None reads the
+        # anomaly_detection flag, True builds a flag-configured detector,
+        # False forces OFF, an AnomalyDetector instance is used as-is
+        from paddle_tpu.distributed.resilience.anomaly import AnomalyDetector
+        if anomaly_detector is None:
+            anomaly_detector = bool(flag("anomaly_detection"))
+        if anomaly_detector is True:
+            anomaly_detector = AnomalyDetector()
+        self._anomaly_det = (anomaly_detector
+                             if isinstance(anomaly_detector, AnomalyDetector)
+                             else None)
+        self._anomaly = self._anomaly_det is not None
+        if (self._anomaly and self._scaler is not None
+                and getattr(self._scaler, "_enable", True)
+                and getattr(self._scaler, "_dynamic", True)
+                and not getattr(self._anomaly_det, "tolerance_explicit",
+                                False)
+                and self._anomaly_det.nonfinite_tolerance == 0):
+            # a dynamic loss scaler OVERFLOWS by design at every growth
+            # interval (the skip + scale-halving is the recovery); only a
+            # non-finite STREAK the scaler can't break is a real anomaly
+            self._anomaly_det.nonfinite_tolerance = 2
+        self._pending_health: list = []
         self._layer_capable = bool(getattr(model, "layer_remat_capable", False))
         if scan_layers is None:
             scan_layers = bool(flag("scan_layers"))
@@ -720,41 +784,69 @@ class CompiledTrainStep:
                 unscaled.append(g32.astype(g.dtype))
             grads = unscaled
             found_inf = bad
-            if fp8_on:
-                # an overflow step must not poison the amax histories: the
-                # backward observed inf/nan amaxes, and delayed_scale of an
-                # inf history is 0 -> NaN gradients on the NEXT step. Keep
-                # the previous state, mirroring the params/moments skip.
-                new_fp8 = jax.tree_util.tree_map(
-                    lambda old, new: jnp.where(found_inf, old, new),
-                    fp8_in, list(new_fp8))
+        if self._anomaly:
+            # the per-step HEALTH scalar (docs/resilience.md), riding the
+            # found_inf convention: non-finite loss or ANY non-finite grad
+            # marks the step unhealthy — the update below is skipped (a NaN
+            # batch can never poison the params) and the scalar settles on
+            # the host lazily, feeding the AnomalyDetector
+            bad = (found_inf if found_inf is not None
+                   else jnp.zeros((), jnp.bool_))
+            if not scaling:
+                for g in grads:
+                    bad = bad | ~jnp.isfinite(g).all()
+            found_inf = bad | ~jnp.isfinite(loss)
+        if fp8_on and found_inf is not None:
+            # a skipped step must not poison the amax histories: the
+            # backward observed inf/nan amaxes, and delayed_scale of an
+            # inf history is 0 -> NaN gradients on the NEXT step. Keep
+            # the previous state, mirroring the params/moments skip.
+            new_fp8 = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(found_inf, old, new),
+                fp8_in, list(new_fp8))
 
         new_params = list(param_vals)
         new_states = list(opt_states) if opt_states is not None else None
         if self.optimizer is not None:
-            for j, i in enumerate(trainable_idx):
+            offload = self._offload and self._state_shardings is not None
+
+            def one_update(j, i, st):
                 g = grads[j]
                 if g.dtype != param_vals[i].dtype:
                     g = g.astype(param_vals[i].dtype)
+                return self.optimizer._update(param_vals[i], g, st, lr,
+                                              step_i)
+
+            def streamed_state(i):
                 st = opt_states[i]
-                if self._offload and self._state_shardings is not None:
-                    # states live in pinned host memory; stream to HBM for the
-                    # update (out_shardings stream the results back) — the
-                    # reference's offload variants do the same H2D/D2H per step
+                if offload:
+                    # states live in pinned host memory; stream to HBM for
+                    # the update (out_shardings stream the results back) —
+                    # the reference's offload variants do the same H2D/D2H
+                    # per step
                     st = {k: jax.device_put(v, self._state_shardings[i][k]
                                             .with_memory_kind("device"))
                           for k, v in st.items()}
-                np_, ns_ = self.optimizer._update(param_vals[i], g, st, lr, step_i)
+                return st
+
+            for j, i in enumerate(trainable_idx):
+                st = streamed_state(i)
+                np_, ns_ = one_update(j, i, st)
                 if found_inf is not None:
-                    # inf/nan grads skip the WHOLE update: params and
-                    # moments keep their previous values (GradScaler
-                    # inf-skip semantics under jit)
+                    # inf/nan grads (or an unhealthy anomaly-detected step)
+                    # skip the WHOLE update: params and moments keep their
+                    # previous values (GradScaler inf-skip semantics under
+                    # jit). Per-tensor select, NOT one lax.cond around the
+                    # loop: XLA fuses the select into the update kernel's
+                    # epilogue (measured noise-level overhead), whereas the
+                    # conditional's operand boundary materializes/copies
+                    # every captured param+moment (measured ~10%/step).
                     np_ = jnp.where(found_inf, param_vals[i], np_)
                     ns_ = {k: jnp.where(found_inf, st[k], v)
                            for k, v in ns_.items()}
                 new_params[i] = np_
                 new_states[i] = ns_
-        if fp8_on or scaling:
+        if fp8_on or scaling or self._anomaly:
             flag_out = (found_inf.astype(jnp.float32) if found_inf is not None
                         else jnp.zeros((), jnp.float32))
             return loss, new_params, new_states, list(new_fp8), flag_out
@@ -762,7 +854,8 @@ class CompiledTrainStep:
 
     def _build(self):
         mesh = self.mesh
-        extended = self.fp8_policy != "none" or self._scaler is not None
+        extended = (self.fp8_policy != "none" or self._scaler is not None
+                    or self._anomaly)
         if mesh is not None and self.optimizer is not None:
             pshard = [NamedSharding(mesh, s) for s in self._param_specs]
             sshard = self._state_shardings
@@ -829,7 +922,14 @@ class CompiledTrainStep:
         lr = jnp.asarray(
             self.optimizer.get_lr() if self.optimizer is not None else 0.0, jnp.float32
         )
-        extended = self.fp8_policy != "none" or self._scaler is not None
+        if faults.fire_check("step.grads"):
+            # chaos: poison THIS step — NaN grads via the first float batch
+            # leaf, or (integer-only batches) a NaN lr corrupting the params
+            vals, leaf_poisoned = _nan_poison(vals)
+            if not leaf_poisoned:
+                lr = jnp.asarray(float("nan"), jnp.float32)
+        extended = (self.fp8_policy != "none" or self._scaler is not None
+                    or self._anomaly)
         with RecordEvent("CompiledTrainStep::dispatch"):
             if extended:
                 scale_arr = jnp.asarray(
@@ -850,6 +950,12 @@ class CompiledTrainStep:
                     # dispatch never blocks here (drain() settles the rest)
                     self._pending_inf.append(found)
                     self._settle_scaler(block=False)
+                if self._anomaly:
+                    # same lazy contract for the health scalar: the detector
+                    # only sees READY values, so step_async run-ahead is
+                    # never broken by detection
+                    self._pending_health.append((self._step_i, loss, found))
+                    self.settle_anomalies(block=False)
             else:
                 loss, self._param_vals, self._opt_states = self._jitted(
                     self._param_vals, self._opt_states, vals, sub, lr,
@@ -881,10 +987,34 @@ class CompiledTrainStep:
 
     def drain(self):
         """Block until every dispatched step has executed (and, with a
-        grad_scaler, fold every outstanding found_inf flag into it)."""
+        grad_scaler / anomaly detector, fold every outstanding found_inf
+        and health flag into them)."""
         self._window.drain()
         if self._scaler is not None:
             self._settle_scaler(block=True)
+        if self._anomaly:
+            self.settle_anomalies(block=True)
+
+    # -- anomaly detection ---------------------------------------------------
+    @property
+    def anomaly_detector(self):
+        return self._anomaly_det
+
+    def settle_anomalies(self, block: bool = False):
+        """Feed the AnomalyDetector from finished steps' device health
+        scalars, in dispatch order. block=False only consumes values whose
+        buffers are already ready — the non-blocking path __call__ runs
+        after every dispatch; drain() settles the rest."""
+        if self._anomaly_det is None:
+            return
+        while self._pending_health:
+            step_i, loss, health = self._pending_health[0]
+            if not block:
+                ready = getattr(health, "is_ready", None)
+                if ready is not None and not ready():
+                    break
+            self._pending_health.pop(0)
+            self._anomaly_det.observe(step_i, float(loss), float(health))
 
     # -- fp8 delayed-scaling state -------------------------------------------
     def _discover_fp8(self, vals):

@@ -56,6 +56,18 @@ def _seed():
 
 
 @pytest.fixture(autouse=True)
+def _faults_hygiene():
+    """A test that arms a fault point (or leaves FLAGS_fault_injection set)
+    must not chaos-inject into the rest of the suite."""
+    yield
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed.resilience import faults
+
+    faults.reset()
+    set_flags({"fault_injection": "", "ckpt_fault_injection": ""})
+
+
+@pytest.fixture(autouse=True)
 def _thread_hygiene():
     """Tier-1 guard: DataLoader/DeviceFeeder prefetch threads AND the
     elastic-checkpoint writer thread must not leak across tests. Every
@@ -73,7 +85,8 @@ def _thread_hygiene():
     def leaked():
         return [t for t in threading.enumerate()
                 if t.name.startswith(("paddle_tpu.io", "paddle_tpu.ckpt",
-                                      "paddle_tpu.serving"))
+                                      "paddle_tpu.serving",
+                                      "paddle_tpu.store"))
                 and t not in before and t.is_alive()]
 
     yield
