@@ -1401,6 +1401,306 @@ def _resilience_probe():
     return None
 
 
+ROUTER_PROBE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import json, threading, time
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (InProcessReplica, Router, RouterConfig,
+                                ServingConfig, ServingEngine)
+
+# Router probe, two arms (docs/router.md):
+# (1) routed overhead — the same sequential greedy requests consumed
+#     through the engine's OWN serving seam (driver thread + per-request
+#     token queue: exactly what serve_http runs, via the replica stream)
+#     vs through the Router in front of that same replica. ABBA-paired
+#     per request (direct/routed/routed/direct) so CPU drift cancels;
+#     median per-pair ratio gates the router's added p50 per-token
+#     latency < 5%. The synchronous submit+run_until_idle number is
+#     reported as context: on this 2-core CPU box the driver<->consumer
+#     GIL handoff costs ~1ms/token for ANY threaded serving path (the
+#     engine's included) — on TPU the step executes with the GIL released,
+#     so that seam cost vanishes; the router's own relay is what this
+#     gate pins.
+# (2) chaos — Poisson mixed-length load over 3 replicas, replica 1 killed
+#     once it is mid-service: zero lost requests (every stream completes
+#     AND equals the fault-free greedy reference), failover count, goodput
+#     recovery to >= 2/3 of the pre-kill window within the drain bound,
+#     p99 per-token gap from true arrival, zero decode retraces on the
+#     survivors.
+S = 64
+cfg = LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=S,
+                  use_parallel_cross_entropy=False)
+paddle.seed(0)
+model = LlamaForCausalLM(cfg)
+model.eval()
+PS, BATCH = 8, 4
+
+
+def make_engine():
+    eng = ServingEngine(model, ServingConfig(
+        page_size=PS, num_pages=96, decode_batch=BATCH, prefill_chunk=16,
+        max_seq_len=S))
+    w = np.random.RandomState(1)
+    # touch every prefill ctx bucket (8/16/32) + the decode program so an
+    # eviction/failover re-prefill mid-run can never compile
+    eng.generate([w.randint(1, cfg.vocab_size, n).astype(np.int32)
+                  for n in (5, 11, 30)], max_new_tokens=4)
+    eng.mark_warmup()
+    eng.reset_stats()
+    return eng
+
+
+def gap_stats(gaps):
+    gaps = sorted(gaps)
+    if not gaps:
+        return {"tokens": 0}
+    pct = lambda p: round(gaps[min(int(len(gaps) * p / 100),
+                                   len(gaps) - 1)], 3)
+    return {"tokens": len(gaps), "p50_ms": pct(50), "p99_ms": pct(99)}
+
+
+eng0 = make_engine()
+rng = np.random.RandomState(3)
+over_prompts = [rng.randint(1, cfg.vocab_size, int(n)).astype(np.int32)
+                for n in rng.randint(4, 25, 10)]
+N_NEW = 16
+
+# ---- arm 1: synchronous reference + greedy token reference ----------------
+sync_ms, direct_toks = [], []
+for p in over_prompts:
+    arrival = time.perf_counter()
+    rid = eng0.submit(p, max_new_tokens=N_NEW)
+    eng0.run_until_idle()
+    direct_toks.append(list(eng0.scheduler.get(rid).generated))
+    sync_ms.append((time.perf_counter() - arrival) * 1e3 / N_NEW)
+    eng0.release(rid)
+sync_ms.sort()
+
+# chaos workload + its fault-free greedy reference (PR-9 contract: a
+# failover re-prefill on a peer reproduces this stream exactly) — computed
+# NOW, while eng0 has no driver thread yet (once InProcessReplica wraps it,
+# the driver owns stepping)
+N, KILL_TARGET = 30, 1.5
+rng = np.random.RandomState(7)
+prompt_lens = np.clip(np.exp(rng.normal(2.2, 0.5, N)).astype(int), 4, 24)
+new_toks = np.minimum(
+    np.clip(np.exp(rng.normal(3.0, 0.5, N)).astype(int), 12, 48),
+    S - prompt_lens)                               # prompt+new fits S
+prompts = [rng.randint(1, cfg.vocab_size, int(n)).astype(np.int32)
+           for n in prompt_lens]
+arrivals = np.cumsum(rng.exponential(0.15, N))     # ~6.7 req/s over ~4.5 s
+expected = [eng0.generate([p], max_new_tokens=int(n))[0]
+            for p, n in zip(prompts, new_toks)]
+
+# ---- arm 1: the SAME requests behind a single-replica router ---------------
+rcfg = dict(probe_interval_s=0.05, failure_threshold=2,
+            breaker_cooldown_s=0.5, dispatch_attempts=4,
+            backoff_initial_s=0.02, backoff_max_s=0.2, gap_timeout_s=5.0,
+            max_inflight=64, shed_queue_depth=10_000, shed_max_new_tokens=8,
+            retry_after_s=0.5)
+rep0 = InProcessReplica(eng0, replica_id=0)
+router1 = Router([rep0], RouterConfig(**rcfg))
+
+
+def one_direct(p):
+    # the engine's own serving path: stream through the replica seam
+    # (driver thread + per-request queue — what serve_http runs), no router
+    t = time.perf_counter()
+    h = rep0.open_stream({"prompt_ids": [int(x) for x in p],
+                          "max_new_tokens": N_NEW})
+    toks = []
+    while True:
+        ev = h.next_event(0.05)
+        if ev is None:
+            continue
+        if "token" in ev:
+            toks.append(ev["token"])
+        elif ev.get("done"):
+            break
+    h.close()
+    return (time.perf_counter() - t) * 1e3 / N_NEW, toks
+
+
+def one_routed(p):
+    t = time.perf_counter()
+    toks = []
+    for ev in router1.stream({"prompt_ids": [int(x) for x in p],
+                              "max_new_tokens": N_NEW}):
+        if "token" in ev:
+            toks.append(ev["token"])
+    return (time.perf_counter() - t) * 1e3 / N_NEW, toks
+
+
+one_direct(over_prompts[0])      # warm both consumption paths once
+one_routed(over_prompts[0])
+ratios, direct_ms, routed_ms = [], [], []
+for _ in range(3):               # 30 ABBA pairs: medians over thread-
+    for p, want in zip(over_prompts, direct_toks):   # scheduling jitter
+        d1, t1 = one_direct(p)
+        r1, t2 = one_routed(p)
+        r2, t3 = one_routed(p)
+        d2, t4 = one_direct(p)
+        assert (t1 == t2 == t3 == t4 == want), \
+            "stream diverged from sync greedy"
+        ratios.append((r1 + r2) / max(d1 + d2, 1e-9))
+        direct_ms += [d1, d2]
+        routed_ms += [r1, r2]
+router1.close()
+ratios.sort()
+direct_ms.sort()
+routed_ms.sort()
+overhead = ratios[len(ratios) // 2] - 1.0
+direct_p50 = direct_ms[len(direct_ms) // 2]
+routed_p50 = routed_ms[len(routed_ms) // 2]
+routed_zero_retrace = eng0.decode_retraces_after_warmup == 0
+
+# ---- arm 2: kill 1 of 3 replicas under Poisson load ------------------------
+engines = [eng0, make_engine(), make_engine()]
+reps = [rep0] + [InProcessReplica(e, replica_id=i)
+                 for i, e in enumerate(engines[1:], start=1)]
+router = Router(reps, RouterConfig(**rcfg))
+
+lock = threading.Lock()
+tok_wall, chaos_gaps = [], []
+results = [None] * N
+t0 = time.perf_counter()
+
+
+def client(i):
+    time.sleep(max(0.0, t0 + float(arrivals[i]) - time.perf_counter()))
+    prev = time.perf_counter()                     # true arrival
+    toks, term = [], None
+    for ev in router.stream({"prompt_ids": [int(t) for t in prompts[i]],
+                             "max_new_tokens": int(new_toks[i])}):
+        now = time.perf_counter()
+        if "token" in ev:
+            toks.append(ev["token"])
+            with lock:
+                tok_wall.append(now - t0)
+                chaos_gaps.append((now - prev) * 1e3)
+            prev = now
+        else:
+            term = ev
+    results[i] = (toks, term)
+
+
+kill_rel = [None]
+
+
+def killer():
+    # reach the target time, then wait until the victim is actually
+    # mid-service so the kill strands live streams (the failover path,
+    # not just the membership change)
+    time.sleep(max(0.0, t0 + KILL_TARGET - time.perf_counter()))
+    deadline = time.perf_counter() + 5.0
+    while (time.perf_counter() < deadline
+           and not engines[1].scheduler.running):
+        time.sleep(0.002)
+    kill_rel[0] = time.perf_counter() - t0
+    reps[1].kill()
+
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(N)]
+kt = threading.Thread(target=killer)
+for t in threads:
+    t.start()
+kt.start()
+for t in threads:
+    t.join(timeout=120.0)
+kt.join(timeout=10.0)
+KILL_AT = kill_rel[0] if kill_rel[0] is not None else KILL_TARGET
+
+completed = sum(1 for r in results if r and r[1] and r[1].get("done"))
+errored = sum(1 for r in results if r and r[1] and "error" in r[1])
+lost = N - completed - errored
+match = all(r is not None and r[0] == e for r, e in zip(results, expected))
+
+
+def rate(lo, hi):
+    return sum(lo <= t < hi for t in tok_wall) / max(hi - lo, 1e-9)
+
+
+pre = rate(KILL_AT - 1.25, KILL_AT - 0.1)
+recovery_ms, recovered_rate, probe_t = None, 0.0, KILL_AT
+end = max(tok_wall) if tok_wall else KILL_AT
+while probe_t + 0.75 <= end + 0.75:
+    w = rate(probe_t, probe_t + 0.75)
+    if w >= (2.0 / 3.0) * pre:
+        recovery_ms, recovered_rate = (probe_t - KILL_AT) * 1e3, w
+        break
+    probe_t += 0.05
+stats = router.stats()
+router.close()
+for rep in reps:
+    rep.close()
+
+out = {
+    "routed_overhead": {
+        "requests": len(over_prompts), "new_tokens": N_NEW,
+        "engine_sync_per_token_p50_ms": round(sync_ms[len(sync_ms) // 2], 3),
+        "direct_per_token_p50_ms": round(direct_p50, 3),
+        "routed_per_token_p50_ms": round(routed_p50, 3),
+        "overhead_frac_paired_median": round(overhead, 4),
+        "overhead_ok": bool(overhead < 0.05),
+        "zero_retrace_behind_router": bool(routed_zero_retrace),
+    },
+    "chaos": {
+        "replicas": 3, "killed_replica": 1,
+        "kill_at_s": round(KILL_AT, 3),
+        "requests": N,
+        "prompt_len_mean": round(float(np.mean(prompt_lens)), 1),
+        "new_tokens_mean": round(float(np.mean(new_toks)), 1),
+        "completed": completed, "errored": errored, "lost": lost,
+        "zero_lost_ok": bool(lost == 0 and errored == 0),
+        "streams_match_fault_free": bool(match),
+        "failovers": stats["failovers"],
+        "failover_exercised": bool(stats["failovers"] >= 1),
+        "drained": stats["drained"],
+        "breaker_open_on_corpse":
+            stats["replicas"]["1"]["circuit"] == "open",
+        "goodput_pre_kill_tok_s": round(pre, 1),
+        "goodput_recovered_tok_s": round(recovered_rate, 1),
+        "recovery_ms": (round(recovery_ms, 1)
+                        if recovery_ms is not None else None),
+        "recovery_ok": bool(recovery_ms is not None),
+        "per_token_latency_from_arrival": gap_stats(chaos_gaps),
+        "zero_retrace_survivors": bool(all(
+            engines[i].decode_retraces_after_warmup == 0 for i in (0, 2))),
+    },
+}
+print("ROUTER_JSON " + json.dumps(out))
+"""
+
+
+def _router_probe():
+    """Fleet-router chaos probe on CPU: routed-vs-direct per-token overhead
+    gated < 5%, then Poisson load over 3 replicas with replica 1 killed
+    mid-run — zero lost requests, streams equal to the fault-free greedy
+    reference, goodput recovery within the drain bound (ROUTER_JSON)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        res = subprocess.run([sys.executable, "-c", ROUTER_PROBE],
+                             capture_output=True, text=True, timeout=540,
+                             env=env)
+        for line in res.stdout.splitlines():
+            if line.startswith("ROUTER_JSON "):
+                return json.loads(line[len("ROUTER_JSON "):])
+        print(f"router probe produced no result; stderr tail:\n"
+              f"{res.stderr[-800:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"router probe failed: {e!r}", file=sys.stderr)
+    return None
+
+
 def _pipeline_overhead():
     """Run the compiled-pipeline bubble probe on a virtual CPU mesh."""
     env = dict(os.environ)
@@ -1753,6 +2053,7 @@ def main():
     ckpt = _checkpointing_probe()
     serving = _serving_probe()
     resilience = _resilience_probe()
+    router = _router_probe()
     # fixed-geometry 8-layer probe: compile-time O(1)-in-depth + remat-policy
     # memory lever, comparable across rounds on any platform. The measured
     # bench arms are attached UNCONDITIONALLY: a probe failure must not
@@ -1791,7 +2092,8 @@ def main():
                    "low_precision": lowp,
                    "checkpointing": ckpt,
                    "serving": serving,
-                   "resilience": resilience},
+                   "resilience": resilience,
+                   "router": router},
     }))
 
 

@@ -229,3 +229,52 @@ define_flag("serving_max_body_mb", 8,
             "Content-Length cap of the HTTP front-end (413 past it; "
             "chunked/unknown-length bodies are rejected with 411)",
             type=int)
+define_flag("serving_waiting_queue_limit", 128,
+            "bound on the scheduler's WAITING queue (distinct from the "
+            "HTTP handler queue): submissions past this many queued "
+            "requests raise the typed QueueFull, which the front-end/"
+            "router maps to 503 + Retry-After instead of growing the "
+            "queue without limit; 0 = unbounded (legacy)", type=int)
+define_flag("router_probe_interval_s", 0.25,
+            "router health-monitor cadence: each replica's health()/"
+            "readiness (queue depth, slot fill, retraces) is probed this "
+            "often, and heartbeat liveness (dead_peers) is re-read on the "
+            "same tick", type=float)
+define_flag("router_failure_threshold", 3,
+            "consecutive dispatch/probe failures that trip a replica's "
+            "circuit breaker OPEN (dispatches stop routing to it)",
+            type=int)
+define_flag("router_breaker_cooldown_s", 1.0,
+            "seconds an OPEN replica circuit waits before HALF-OPEN: one "
+            "trial dispatch is let through; success closes the circuit, "
+            "failure re-opens it for another cooldown", type=float)
+define_flag("router_dispatch_attempts", 3,
+            "total dispatch attempts per request (first try + failover "
+            "re-dispatches); past this the request returns ONE typed "
+            "error event instead of retrying forever", type=int)
+define_flag("router_backoff_initial_s", 0.05,
+            "first failover re-dispatch backoff; doubles per retry up to "
+            "router_backoff_max_s", type=float)
+define_flag("router_backoff_max_s", 1.0,
+            "failover re-dispatch backoff ceiling", type=float)
+define_flag("router_gap_timeout_s", 5.0,
+            "max silence between consecutive stream events from a "
+            "replica before the router declares it wedged FOR THIS "
+            "REQUEST and fails over (also the detection bound for a "
+            "dropped dispatch)", type=float)
+define_flag("router_max_inflight", 64,
+            "router admission cap: requests in flight across all "
+            "replicas; past it new requests are refused with 503 + "
+            "Retry-After at admission (before any replica dispatch)",
+            type=int)
+define_flag("router_shed_queue_depth", 32,
+            "overload shed watermark: when aggregate depth (router "
+            "in-flight + probed replica queue depths) exceeds this, the "
+            "shed policy caps max_new_tokens instead of dropping "
+            "requests", type=int)
+define_flag("router_shed_max_new_tokens", 32,
+            "max_new_tokens cap applied by the shed policy under "
+            "overload (degrade before drop)", type=int)
+define_flag("router_retry_after_s", 1.0,
+            "Retry-After seconds advertised on admission-control 503s",
+            type=float)

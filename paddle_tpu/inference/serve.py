@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import io
 import json
+import math
 import time
 
 import numpy as np
@@ -150,7 +151,8 @@ def build_http_server(port: int, run_fn=None, generate_fn=None, *,
                       queue_limit: int = DEFAULT_QUEUE_LIMIT,
                       timeout_s: float = DEFAULT_TIMEOUT_S,
                       max_body_bytes: int = DEFAULT_MAX_BODY_MB << 20,
-                      host: str = "127.0.0.1"):
+                      host: str = "127.0.0.1",
+                      admit_fn=None, health_fn=None, stats_fn=None):
     """The serving HTTP front-end, dependency-injected so this module stays
     frontend-free (it imports no paddle_tpu):
 
@@ -161,6 +163,21 @@ def build_http_server(port: int, run_fn=None, generate_fn=None, *,
                           the continuous-batching scheduler's token stream
                           when paddle_tpu.serving.ServingEngine.serve_http
                           injects it.
+      * GET /healthz   -> health_fn() dict, answered as JSON (503 when the
+                          dict carries ``"ok": False`` or health_fn raises)
+      * GET /stats     -> stats_fn() dict as JSON — queue depth, in-flight
+                          count, slot fill, retraces-after-warmup — so
+                          liveness/readiness probes (and the fleet router)
+                          never need a generate call. GETs bypass the
+                          bounded POST queue: a saturated engine must still
+                          answer its probes, that's the whole point.
+
+    ``admit_fn(payload) -> None | dict`` is consulted BEFORE the 200 of a
+    /generate: returning ``{"status": 503, "retry_after": 1.0, "message":
+    ...}`` refuses the request with that status and a Retry-After header
+    (admission control backpressure), instead of burying the refusal in a
+    stream event after headers are already out. The dict contract (rather
+    than a shared exception class) keeps this module frontend-free.
 
     Hardening (the old front-end was a single-threaded HTTPServer that
     head-of-line blocked on each request and read unbounded bodies):
@@ -204,6 +221,33 @@ def build_http_server(port: int, run_fn=None, generate_fn=None, *,
                 self.send_error(413, f"body exceeds {max_body_bytes} bytes")
                 return None
             return self.rfile.read(n)
+
+        def _json_reply(self, obj: dict, status: int = 200,
+                        extra_headers: dict | None = None):
+            data = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            # no slot accounting: probes must answer even when the POST
+            # queue is saturated (a probe that 503s under load reads as a
+            # dead replica and triggers a spurious drain)
+            try:
+                if self.path == "/healthz" and health_fn is not None:
+                    h = dict(health_fn())
+                    self._json_reply(h, 200 if h.get("ok", True) else 503)
+                elif self.path == "/stats" and stats_fn is not None:
+                    self._json_reply(dict(stats_fn()))
+                else:
+                    self.send_error(404)
+            except Exception as e:
+                self._json_reply(
+                    {"ok": False, "error": f"{type(e).__name__}: {e}"}, 503)
 
         def do_POST(self):
             if not slots.acquire(blocking=False):
@@ -253,6 +297,19 @@ def build_http_server(port: int, run_fn=None, generate_fn=None, *,
             except Exception:
                 self.send_error(400, "body must be JSON")
                 return
+            if admit_fn is not None:
+                rej = admit_fn(payload)
+                if rej:  # refuse BEFORE the 200: clean status + Retry-After
+                    hdrs = {}
+                    if rej.get("retry_after") is not None:
+                        # RFC 9110 delta-seconds is an INTEGER; a float
+                        # string gets discarded by strict clients
+                        hdrs["Retry-After"] = math.ceil(
+                            float(rej["retry_after"]))
+                    self._json_reply(
+                        {"error": rej.get("message", "rejected")},
+                        int(rej.get("status", 503)), hdrs)
+                    return
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             # close-delimited stream: one JSON line per event, flushed as

@@ -4,15 +4,31 @@ The inference-side counterpart of the training runtimes (ROADMAP item 1):
 `ServingEngine` drives iteration-level batching over a block-granular KV
 cache with a Pallas ragged decode-attention kernel
 (paddle_tpu.ops.pallas.paged_attention). See docs/serving.md.
+
+The fleet front (ROADMAP item 4, docs/router.md): `Router` dispatches
+over N replicas behind the `replica.py` transport seam — health-aware
+placement with session-affinity rendezvous hashing, circuit breaking +
+draining, bounded failover re-dispatch, and admission control/shedding
+under overload. `InProcessReplica` is the CI-grade transport (engine +
+driver thread in-process); real deployments speak the same three-method
+protocol over HTTP/RPC against serve.py's /healthz + /stats + /generate.
 """
 from paddle_tpu.serving.engine import ServingConfig, ServingEngine
 from paddle_tpu.serving.kv_cache import (PageAllocator, kv_page_bytes,
                                          pages_for_budget)
+from paddle_tpu.serving.replica import (InProcessReplica, ReplicaDead,
+                                        ReplicaError, ReplicaStream,
+                                        StreamCut, StreamGap)
+from paddle_tpu.serving.router import (Router, RouterConfig, backoff_delays,
+                                       rendezvous_order)
 from paddle_tpu.serving.sampling import request_key, sample_tokens
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
-                                          Request, RequestState)
+                                          QueueFull, Request, RequestState)
 
 __all__ = ["ServingConfig", "ServingEngine", "PageAllocator",
            "kv_page_bytes", "pages_for_budget", "sample_tokens",
            "request_key", "ContinuousBatchingScheduler", "Request",
-           "RequestState"]
+           "RequestState", "QueueFull", "Router", "RouterConfig",
+           "rendezvous_order", "backoff_delays", "InProcessReplica",
+           "ReplicaError", "ReplicaDead", "ReplicaStream", "StreamCut",
+           "StreamGap"]

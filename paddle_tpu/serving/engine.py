@@ -36,7 +36,7 @@ from paddle_tpu.serving.kv_cache import (PageAllocator, kv_page_bytes,
                                          pages_for_budget)
 from paddle_tpu.serving.sampling import request_key, sample_tokens
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
-                                          Request, RequestState)
+                                          QueueFull, Request, RequestState)
 
 __all__ = ["ServingConfig", "ServingEngine"]
 
@@ -52,6 +52,7 @@ class ServingConfig:
     max_seq_len: int = 0            # 0 -> FLAGS_serving_max_seq_len or model
     kv_dtype: object = None         # None -> model param dtype
     sample_seed: int = 0
+    max_waiting: int = 0            # 0 -> FLAGS_serving_waiting_queue_limit
 
     def resolved(self, model_max_pos: int):
         from paddle_tpu.core.flags import flag
@@ -63,8 +64,9 @@ class ServingConfig:
                 or model_max_pos)
         budget = self.hbm_budget_mb or flag("serving_hbm_budget_mb")
         pages = self.num_pages or flag("serving_num_pages")
+        waiting = self.max_waiting or flag("serving_waiting_queue_limit")
         return (int(ps), int(batch), int(chunk), int(smax), int(budget),
-                int(pages))
+                int(pages), int(waiting))
 
 
 def _buckets(lo: int, hi: int) -> list[int]:
@@ -96,7 +98,8 @@ class ServingEngine:
         self.num_kv_heads = int(mcfg.num_key_value_heads)
         self.head_dim = int(mcfg.hidden_size) // int(mcfg.num_attention_heads)
         (self.page_size, self.decode_batch, self.prefill_chunk,
-         self.max_seq_len, budget_mb, cfg_pages) = self.config.resolved(
+         self.max_seq_len, budget_mb, cfg_pages,
+         self.max_waiting) = self.config.resolved(
             int(mcfg.max_position_embeddings))
         rope_limit = int(getattr(mcfg, "rope_max_position", 0)
                          or mcfg.max_position_embeddings)
@@ -136,7 +139,8 @@ class ServingEngine:
 
         self.allocator = PageAllocator(self.num_pages, self.page_size)
         self.scheduler = ContinuousBatchingScheduler(
-            self.allocator, self.decode_batch, self.max_seq_len)
+            self.allocator, self.decode_batch, self.max_seq_len,
+            max_waiting=self.max_waiting)
         self._params = params
         shape = (self.num_layers, self.num_kv_heads, self.num_pages,
                  self.page_size, self.head_dim)
@@ -412,15 +416,28 @@ class ServingEngine:
 
         q = queue_mod.Queue()
         with self._http_lock:
-            rid = self.submit(
-                np.asarray(payload["prompt_ids"], np.int32),
-                max_new_tokens=int(payload.get("max_new_tokens", 16)),
-                temperature=float(payload.get("temperature", 0.0)),
-                top_k=int(payload.get("top_k", 0)),
-                top_p=float(payload.get("top_p", 1.0)),
-                eos_id=payload.get("eos_id"),
-                stream_cb=lambda req, tok: q.put(tok))
-            req = self.scheduler.get(rid)
+            try:
+                rid = self.submit(
+                    np.asarray(payload["prompt_ids"], np.int32),
+                    max_new_tokens=int(payload.get("max_new_tokens", 16)),
+                    temperature=float(payload.get("temperature", 0.0)),
+                    top_k=int(payload.get("top_k", 0)),
+                    top_p=float(payload.get("top_p", 1.0)),
+                    eos_id=payload.get("eos_id"),
+                    stream_cb=lambda req, tok: q.put(tok))
+            except QueueFull:
+                # admission raced past the pre-headers check: headers are
+                # already out, so the refusal becomes the ONE terminal
+                # stream event (with the same Retry-After semantics)
+                rid = None
+            else:
+                req = self.scheduler.get(rid)
+        if rid is None:
+            from paddle_tpu.core.flags import flag
+
+            yield {"error": "queue_full",
+                   "retry_after": float(flag("router_retry_after_s"))}
+            return
         n = 0
         try:
             while True:
@@ -472,21 +489,55 @@ class ServingEngine:
             if not busy:
                 time.sleep(0.002)
 
+    def _http_admit(self, payload: dict) -> dict | None:
+        """serve.py's `admit_fn` contract: refuse BEFORE response headers
+        when the waiting queue is at its bound, so the common case of
+        sustained overload gets a clean 503 + Retry-After instead of a
+        200 whose stream immediately carries a queue_full error event
+        (that in-stream path remains only for the submit race)."""
+        from paddle_tpu.core.flags import flag
+
+        depth = self.scheduler.queue_depth
+        if self.max_waiting and depth >= self.max_waiting:
+            return {"status": 503,
+                    "retry_after": float(flag("router_retry_after_s")),
+                    "message": f"serving waiting queue full ({depth} "
+                               f"queued >= {self.max_waiting})"}
+        return None
+
+    def _http_health(self) -> dict:
+        """/healthz: liveness (driver thread state) + the readiness
+        snapshot. ok=False once the driver died — probes see the corpse
+        without waiting for a generate call to fail."""
+        h = {"ok": self._http_error is None, **self.stats()}
+        if self._http_error is not None:
+            h["error"] = self._http_error
+        return h
+
     def serve_http(self, port: int, block: bool = True):
         """Serve POST /generate (streaming ndjson token events) through the
         hardened HTTP front-end in paddle_tpu.inference.serve — the
         scheduler runs on a driver thread, handler threads only queue
-        requests and drain token streams."""
+        requests and drain token streams. GET /healthz and /stats answer
+        the same readiness fields the fleet router probes."""
         import threading
 
         from paddle_tpu.core.flags import flag
+        from paddle_tpu.distributed.resilience import faults
         from paddle_tpu.inference.serve import build_http_server
+
+        # standalone serving processes validate FLAGS_fault_injection at
+        # startup too (the supervisor/fit contract): a typo'd chaos spec
+        # fails HERE, not at whichever injection site fires first
+        faults.check_flag_spec()
 
         srv = build_http_server(
             port, generate_fn=self._http_generate,
             queue_limit=int(flag("serving_queue_limit")),
             timeout_s=float(flag("serving_request_timeout_s")),
-            max_body_bytes=int(flag("serving_max_body_mb")) << 20)
+            max_body_bytes=int(flag("serving_max_body_mb")) << 20,
+            admit_fn=self._http_admit, health_fn=self._http_health,
+            stats_fn=self.stats)
         self._http_stop = False
         driver = threading.Thread(target=self._drive_http,
                                   name="paddle_tpu.serving.driver",
@@ -534,6 +585,24 @@ class ServingEngine:
     @property
     def prefill_traces(self) -> int:
         return self._prefill_traces
+
+    def stats(self) -> dict:
+        """Readiness snapshot — the fields /stats serves and the fleet
+        router's probes consume (queue depth, oldest wait age, slot fill,
+        retraces-after-warmup), so liveness/readiness never needs a
+        generate call. Lock-free BY DESIGN: every read is a GIL-atomic
+        int or a list snapshot, so a probe answers even while the driver
+        thread holds the step lock mid-decode."""
+        running = len(self.scheduler.running)
+        return {
+            "queue_depth": self.scheduler.queue_depth,
+            "oldest_wait_age_s": round(self.scheduler.oldest_wait_age(), 4),
+            "in_flight": running + self.scheduler.queue_depth,
+            "slot_fill": round(running / max(self.decode_batch, 1), 4),
+            "decode_retraces_after_warmup": self.decode_retraces_after_warmup,
+            "free_pages": self.allocator.free_pages,
+            "waiting_limit": self.max_waiting,
+        }
 
     def utilization_mean(self) -> float:
         return float(np.mean(self._util_samples)) if self._util_samples else 0.0

@@ -23,7 +23,22 @@ import numpy as np
 
 from paddle_tpu.serving.kv_cache import PageAllocator
 
-__all__ = ["Request", "RequestState", "ContinuousBatchingScheduler"]
+__all__ = ["Request", "RequestState", "ContinuousBatchingScheduler",
+           "QueueFull"]
+
+
+class QueueFull(RuntimeError):
+    """Typed admission refusal: the WAITING queue is at its bound. The
+    HTTP front-end/router maps this to 503 + Retry-After — backpressure
+    the caller can act on — instead of letting the queue grow without
+    limit until every request times out inside it."""
+
+    def __init__(self, depth: int, limit: int):
+        super().__init__(
+            f"serving waiting queue full: {depth} queued >= "
+            f"serving_waiting_queue_limit={limit}")
+        self.depth = depth
+        self.limit = limit
 
 
 class RequestState(Enum):
@@ -77,10 +92,14 @@ class Request:
 
 class ContinuousBatchingScheduler:
     def __init__(self, allocator: PageAllocator, max_batch: int,
-                 max_seq_len: int):
+                 max_seq_len: int, max_waiting: int = 0):
         self.allocator = allocator
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq_len)
+        # bound on NEW submissions only: eviction re-queues (accepted work
+        # being recovered) bypass it, so a full queue can never deadlock
+        # an eviction. 0 = unbounded.
+        self.max_waiting = int(max_waiting)
         self.waiting: list[Request] = []
         self.running: list[Request] = []        # admission order == age
         self._by_rid: dict[int, Request] = {}
@@ -92,6 +111,8 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"request needs {req.prompt.size + req.max_new_tokens} "
                 f"tokens > serving_max_seq_len={limit}")
+        if self.max_waiting and len(self.waiting) >= self.max_waiting:
+            raise QueueFull(len(self.waiting), self.max_waiting)
         self.waiting.append(req)
         self._by_rid[req.rid] = req
         return req.rid
@@ -102,6 +123,23 @@ class ContinuousBatchingScheduler:
     @property
     def idle(self) -> bool:
         return not self.waiting and not self.running
+
+    # ---- readiness probes (what /stats and the router consume) ------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    def oldest_wait_age(self) -> float:
+        """Seconds the longest-queued WAITING request has been waiting —
+        the wedge signal a bare depth number can't give (a short queue
+        nobody drains is worse than a long one draining fast). Snapshots
+        the list: probes read it lock-free from another thread while the
+        driver admits/evicts."""
+        waiting = list(self.waiting)
+        if not waiting:
+            return 0.0
+        now = time.perf_counter()
+        return max(now - r.arrival_t for r in waiting)
 
     # ---- per-step policy --------------------------------------------------
     def admissions(self) -> list[Request]:

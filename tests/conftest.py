@@ -69,10 +69,12 @@ def _faults_hygiene():
 
 @pytest.fixture(autouse=True)
 def _thread_hygiene():
-    """Tier-1 guard: DataLoader/DeviceFeeder prefetch threads AND the
-    elastic-checkpoint writer thread must not leak across tests. Every
-    paddle_tpu.io background thread carries the "paddle_tpu.io" name prefix,
-    the checkpoint writer carries "paddle_tpu.ckpt"; both are joined on
+    """Tier-1 guard: DataLoader/DeviceFeeder prefetch threads, the
+    elastic-checkpoint writer, store heartbeats, AND the serving fleet's
+    threads (engine drivers, replica drivers, the router health monitor)
+    must not leak across tests. Every such background thread carries its
+    subsystem name prefix ("paddle_tpu.io", "paddle_tpu.ckpt",
+    "paddle_tpu.serving", "paddle_tpu.store") and is joined on
     close/exhaustion — a test that strands one fails here instead of
     poisoning the rest of the suite."""
     import threading

@@ -44,6 +44,14 @@ CHAOS_POINTS = [
     "feeder.collate", "feeder.device_put", "step.grads", "store.barrier",
     "watchdog.hang",
 ]
+# the serving half of the registry (PR 11): registered at import of
+# paddle_tpu.serving.replica/router, exercised by the routed chaos matrix
+# in test_router.py — these points fire on serving traffic, so injecting
+# them into a Model.fit run would test nothing
+SERVING_CHAOS_POINTS = [
+    "serving.dispatch.drop", "serving.replica.kill", "serving.replica.slow",
+    "serving.stream.cut",
+]
 
 
 @pytest.fixture(autouse=True)
@@ -94,9 +102,12 @@ def _make_step_factory(n_total):
 
 class TestFaultRegistry:
     def test_points_register_at_import(self):
-        assert set(CHAOS_POINTS) <= set(faults.registered())
+        import paddle_tpu.serving.replica  # noqa: F401 — serving.* points
+        import paddle_tpu.serving.router  # noqa: F401
+        assert (set(CHAOS_POINTS) | set(SERVING_CHAOS_POINTS)
+                <= set(faults.registered()))
         docs = faults.describe()
-        for p in CHAOS_POINTS:
+        for p in CHAOS_POINTS + SERVING_CHAOS_POINTS:
             assert docs[p], f"{p} has no catalog doc"
 
     def test_unknown_point_raises(self):
@@ -608,9 +619,16 @@ class TestFitChaosMatrix:
         return rec.losses
 
     def test_chaos_matrix_covers_registry(self):
-        assert sorted(CHAOS_POINTS) == sorted(faults.registered()), (
-            "a fault point was registered without being added to the "
-            "chaos matrix (CHAOS_POINTS)")
+        # serving points register at import of the serving modules; pull
+        # them in so the pin is deterministic whether or not another test
+        # module imported paddle_tpu.serving first
+        import paddle_tpu.serving.replica  # noqa: F401
+        import paddle_tpu.serving.router  # noqa: F401
+        assert (sorted(CHAOS_POINTS + SERVING_CHAOS_POINTS)
+                == sorted(faults.registered())), (
+            "a fault point was registered without being added to a chaos "
+            "matrix (CHAOS_POINTS here, SERVING_CHAOS_POINTS -> "
+            "test_router.py)")
 
     @pytest.mark.slow
     def test_every_point_recovers_with_fault_free_trajectory(self, tmp_path):
@@ -1025,6 +1043,8 @@ class TestRegistryCoverage:
         import paddle_tpu.distributed.store  # noqa: F401
         import paddle_tpu.io.device_feed  # noqa: F401
         import paddle_tpu.parallel.train_step  # noqa: F401
+        import paddle_tpu.serving.replica  # noqa: F401
+        import paddle_tpu.serving.router  # noqa: F401
 
         tests_dir = os.path.dirname(__file__)
         corpus = ""
