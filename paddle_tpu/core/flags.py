@@ -229,6 +229,21 @@ define_flag("serving_max_body_mb", 8,
             "Content-Length cap of the HTTP front-end (413 past it; "
             "chunked/unknown-length bodies are rejected with 411)",
             type=int)
+define_flag("serving_spec_k", 0,
+            "speculative decoding draft window: the n-gram self-draft "
+            "proposer proposes this many tokens per request per step and "
+            "ONE [batch, K+1] verify pass through the paged kernel accepts "
+            "the longest agreeing prefix (exact greedy/temperature "
+            "semantics — streams are bit-equal to plain decode); 0 = off "
+            "(the PR-9 one-token decode step)", type=int)
+define_flag("serving_prefix_sharing", 1,
+            "copy-on-write shared-prefix KV page reuse: admission matches "
+            "the longest committed-full-page prefix of the new context in "
+            "the allocator's radix index and links those pages (refcounted)"
+            " into the new chain, so prefill runs only the unmatched tail "
+            "and one physical page backs every sharer of a common system "
+            "prompt; writes into shared pages copy-on-write. 0 = off",
+            type=int)
 define_flag("serving_waiting_queue_limit", 128,
             "bound on the scheduler's WAITING queue (distinct from the "
             "HTTP handler queue): submissions past this many queued "

@@ -212,16 +212,25 @@ class LlamaAttention(nn.Layer):
         return self.o_proj(out)
 
     def forward_decode(self, x, *, rope, cache, layer_idx, page_table,
-                       context_lens, position_ids, ctx_pad=None):
+                       context_lens, position_ids, ctx_pad=None,
+                       write_mask=None, verify=False):
         """Serving forward over the paged KV cache. x: [B, T, H]; T == 1 is
         a decode step (paged ragged attention over the page table), T > 1
         is a page-writing prefill chunk (runs through the standard flash
-        path over the gathered context). `cache` is the raw
+        path over the gathered context) — unless `verify=True`, which runs
+        the T-token SPECULATIVE VERIFY frame through the same paged kernel
+        with per-query causal limits (query i at absolute position
+        context_lens-1+i). `cache` is the raw
         {"k","v": [L, Hkv, P, page_size, D]} pool pair; this layer reads
         and functionally updates stack row `layer_idx`. position_ids
         [B, T] are ABSOLUTE positions (index the hoisted RoPE buffer);
-        context_lens [B] counts valid cache tokens INCLUDING this chunk.
-        Returns (out, cache)."""
+        context_lens [B] counts valid cache tokens INCLUDING this chunk
+        (for verify: committed context incl. the frame's rewrite token
+        only — draft tokens are PROVISIONAL). `write_mask` [B, T] bool
+        redirects masked entries' K/V writes to the reserved null page —
+        how a verify frame keeps out-of-window draft slots (past a row's
+        budget/context cap) from scribbling live cache. Returns
+        (out, cache)."""
         from paddle_tpu.ops.pallas.paged_attention import paged_attention
 
         b, t, _ = x.shape
@@ -240,6 +249,11 @@ class LlamaAttention(nn.Layer):
         ck, cv = cache["k"], cache["v"]
         ps = ck.shape[3]
         pidx = jnp.take_along_axis(page_table, position_ids // ps, axis=1)
+        if write_mask is not None:
+            # masked entries scatter into the null page (page 0): a
+            # harmless spill target the allocator never hands out and the
+            # kernel's skip predicate never reads as live context
+            pidx = jnp.where(write_mask, pidx, 0)
         slot = position_ids % ps                                   # [B, T]
         # index tuple (int, :, [B,T], [B,T]): the advanced dims land in
         # FRONT position, so the updates keep their natural [B, T, Hkv, D]
@@ -250,6 +264,13 @@ class LlamaAttention(nn.Layer):
         if t == 1:
             out = paged_attention(qv[:, 0], ck[layer_idx], cv[layer_idx],
                                   page_table, context_lens)[:, None]
+        elif verify:
+            # the [B, T, Hq, D] query frame rides the SAME scalar-prefetch
+            # page gather as plain decode; per-query causal limits live in
+            # the kernel (query i sees keys < context_lens + i, which
+            # includes the draft K/V scattered just above)
+            out = paged_attention(qv, ck[layer_idx], cv[layer_idx],
+                                  page_table, context_lens)
         else:
             # chunked prefill: gather the full context (pages cover the
             # chunk itself too — just scattered above) and run the SAME
@@ -318,12 +339,13 @@ class LlamaDecoderLayer(nn.Layer):
         return x
 
     def forward_decode(self, x, *, rope, cache, layer_idx, page_table,
-                       context_lens, position_ids, ctx_pad=None):
+                       context_lens, position_ids, ctx_pad=None,
+                       write_mask=None, verify=False):
         attn_out, cache = self.self_attn.forward_decode(
             self.input_layernorm(x), rope=rope, cache=cache,
             layer_idx=layer_idx, page_table=page_table,
             context_lens=context_lens, position_ids=position_ids,
-            ctx_pad=ctx_pad)
+            ctx_pad=ctx_pad, write_mask=write_mask, verify=verify)
         x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, cache
@@ -363,9 +385,11 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
     def decode_forward(self, input_ids, cache, page_table, context_lens,
-                       position_ids, ctx_pad=None):
+                       position_ids, ctx_pad=None, write_mask=None,
+                       verify=False):
         """Serving forward over the paged KV cache (decode step when
-        input_ids is [B, 1], page-writing prefill chunk when [B, T>1]).
+        input_ids is [B, 1], page-writing prefill chunk when [B, T>1],
+        speculative verify frame when [B, T>1] with verify=True).
         `cache` = raw {"k","v": [L, Hkv, P, page_size, D]} pools; returns
         (hidden, updated cache). The layer loop is an unrolled Python loop
         — decode programs are tiny next to training HLO, and every layer
@@ -373,13 +397,15 @@ class LlamaModel(nn.Layer):
         page_table = _raw(page_table).astype(jnp.int32)
         context_lens = _raw(context_lens).astype(jnp.int32)
         position_ids = _raw(position_ids).astype(jnp.int32)
+        write_mask = _raw(write_mask)
         x = self.embed_tokens(input_ids)
         rope = (self.rope_cos._value, self.rope_sin._value)
         for i, layer in enumerate(self.layers):
             x, cache = layer.forward_decode(
                 x, rope=rope, cache=cache, layer_idx=i,
                 page_table=page_table, context_lens=context_lens,
-                position_ids=position_ids, ctx_pad=ctx_pad)
+                position_ids=position_ids, ctx_pad=ctx_pad,
+                write_mask=write_mask, verify=verify)
         return self.norm(x), cache
 
     def _run_layers(self, x, attn_mask, segment_ids=None, position_ids=None):
@@ -511,11 +537,13 @@ class LlamaForCausalLM(nn.Layer):
             return self.lm_head(hidden)
 
     def decode_forward(self, input_ids, cache, page_table, context_lens,
-                       position_ids, ctx_pad=None):
-        """Serving decode/prefill entry: (logits [B, T, vocab], cache)."""
+                       position_ids, ctx_pad=None, write_mask=None,
+                       verify=False):
+        """Serving decode/prefill/verify entry: (logits [B, T, vocab],
+        cache)."""
         hidden, cache = self.llama.decode_forward(
             input_ids, cache, page_table, context_lens, position_ids,
-            ctx_pad=ctx_pad)
+            ctx_pad=ctx_pad, write_mask=write_mask, verify=verify)
         return self.lm_head(hidden), cache
 
     # ---- pipeline-parallel factory ----------------------------------------

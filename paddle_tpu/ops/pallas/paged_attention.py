@@ -102,7 +102,7 @@ def _interpret_mode() -> bool:
 
 def _decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
                    m_ref, l_ref, acc_ref, *, page_size: int, scale: float,
-                   pages_per_seq: int):
+                   pages_per_seq: int, q_len: int, group: int):
     b = pl.program_id(0)
     p = pl.program_id(2)
 
@@ -114,22 +114,32 @@ def _decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
 
     length = lens_ref[b]
     first = p * page_size
-    # THE shared block-skip predicate: the query's valid key range is
-    # [0, len-1], page p covers positions [first, first+ps-1]; a page whose
-    # range can't intersect contributes nothing (len==0 rows skip ALL pages)
-    needed = _seg_blocks_can_touch(0, length - 1, first,
-                                   first + page_size - 1)
+    # THE shared block-skip predicate: the LAST query's valid key range is
+    # [0, len+q_len-2] (verify query i sits at absolute position
+    # len-1+i and may attend keys <= its own position; q_len==1 is plain
+    # decode with range [0, len-1]), page p covers positions
+    # [first, first+ps-1]; a page whose range can't intersect contributes
+    # nothing, and len==0 rows skip ALL pages
+    needed = _seg_blocks_can_touch(0, length + (q_len - 1) - 1, first,
+                                   first + page_size - 1) & (length > 0)
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale      # [G, D]
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [T*G, D]
         k = k_ref[0, 0].astype(jnp.float32)               # [PS, D]
         v = v_ref[0, 0].astype(jnp.float32)
         g = q.shape[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [G, PS]
+                                preferred_element_type=jnp.float32)  # [T*G, PS]
         k_pos = first + jax.lax.broadcasted_iota(jnp.int32, (g, page_size), 1)
-        s = jnp.where(k_pos < length, s, _NEG_INF)
+        # per-query causal limit: query row r belongs to frame r // group
+        # at absolute position length-1 + r//group -> keys < length + r//group
+        # (lax.div with an explicit i32 divisor: a Python-int `//` would
+        # promote to i64 under an x64-enabled outer trace in interpret mode)
+        q_frame = jax.lax.div(
+            jax.lax.broadcasted_iota(jnp.int32, (g, page_size), 0),
+            jnp.int32(group))
+        s = jnp.where(k_pos < length + q_frame, s, jnp.float32(_NEG_INF))
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         pexp = jnp.exp(s - m_new)
@@ -145,11 +155,15 @@ def _decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
     def _finish():
         # inactive rows (len 0) never accumulated: l==0 -> output zeros
         o_ref[0, 0] = (acc_ref[...] /
-                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+                       jnp.maximum(l_ref[...],
+                                   jnp.float32(1e-30))).astype(o_ref.dtype)
 
 
 def _check_shapes(q, k_pages, v_pages, page_table, context_lens):
-    b, hq, d = q.shape
+    if q.ndim == 4:                     # [B, T, Hq, D] verify frame
+        b, _, hq, d = q.shape
+    else:
+        b, hq, d = q.shape
     hkv, _, ps, dk = k_pages.shape
     if v_pages.shape != k_pages.shape:
         raise ValueError(f"k_pages {k_pages.shape} != v_pages "
@@ -171,14 +185,23 @@ def _check_shapes(q, k_pages, v_pages, page_table, context_lens):
 def paged_decode_attention(q, k_pages, v_pages, page_table, context_lens,
                            scale: float | None = None,
                            interpret: bool | None = None):
-    """One decode step of attention over the paged KV cache (the Pallas
-    kernel). q: [B, Hq, D] (one query token per sequence);
+    """Attention over the paged KV cache (the Pallas kernel). q is either
+    ``[B, Hq, D]`` (one query token per sequence — plain decode) or
+    ``[B, T, Hq, D]`` (a speculative VERIFY frame: query i of row b sits at
+    absolute position ``context_lens[b] - 1 + i`` and attends causally up
+    to its own position, so ONE pass scores a whole draft window).
     k_pages/v_pages: [Hkv, P, page_size, D]; page_table:
-    [B, pages_per_seq] int32; context_lens: [B] int32. Returns [B, Hq, D].
+    [B, pages_per_seq] int32; context_lens: [B] int32 counts committed
+    context INCLUDING the frame's first (rewrite) token. Returns q's shape.
     """
     b, hq, hkv, ps, d = _check_shapes(q, k_pages, v_pages, page_table,
                                       context_lens)
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    t = q.shape[1]
     group = hq // hkv
+    tg = t * group
     pages_per_seq = page_table.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
@@ -187,14 +210,18 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, context_lens,
     if not interpret and not _HAS_PLTPU:  # pragma: no cover
         raise RuntimeError("pallas TPU backend unavailable; use "
                            "paged_attention_reference or force_interpret()")
-    qg = q.reshape(b, hkv, group, d)
+    # [B, T, Hkv, G, D] -> [B, Hkv, T*G, D]: the kernel's q block carries
+    # the whole verify window, frame index recovered as row // group
+    qg = (q.reshape(b, t, hkv, group, d).transpose(0, 2, 1, 3, 4)
+          .reshape(b, hkv, tg, d))
     kernel = functools.partial(_decode_kernel, page_size=ps, scale=scale,
-                               pages_per_seq=pages_per_seq)
+                               pages_per_seq=pages_per_seq, q_len=t,
+                               group=group)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hkv, pages_per_seq),
         in_specs=[
-            pl.BlockSpec((1, 1, group, d),
+            pl.BlockSpec((1, 1, tg, d),
                          lambda bb, h, p, lens, pt: (bb, h, 0, 0)),
             # the page gather IS the index map: scalar-prefetched page-table
             # entries pick which pool page streams into VMEM this grid step
@@ -203,23 +230,25 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, context_lens,
             pl.BlockSpec((1, 1, ps, d),
                          lambda bb, h, p, lens, pt: (h, pt[bb, p], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, d),
+        out_specs=pl.BlockSpec((1, 1, tg, d),
                                lambda bb, h, p, lens, pt: (bb, h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((group, 1), jnp.float32),
-            pltpu.VMEM((group, 1), jnp.float32),
-            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((tg, 1), jnp.float32),
+            pltpu.VMEM((tg, 1), jnp.float32),
+            pltpu.VMEM((tg, d), jnp.float32),
         ],
     )
     with _x64_off():
         out = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
+            out_shape=jax.ShapeDtypeStruct((b, hkv, tg, d), q.dtype),
             interpret=interpret,
         )(jnp.asarray(context_lens, jnp.int32),
           jnp.asarray(page_table, jnp.int32), qg, k_pages, v_pages)
-    return out.reshape(b, hq, d)
+    out = (out.reshape(b, hkv, t, group, d).transpose(0, 2, 1, 3, 4)
+           .reshape(b, t, hq, d))
+    return out[:, 0] if squeeze else out
 
 
 # ---------------------------------------------------------------------------
@@ -230,9 +259,15 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, context_lens,
                               scale: float | None = None):
     """jnp gather + masked-softmax reference of `paged_decode_attention` —
     the XLA fallback the serving engine uses off-TPU (fast under jit on
-    CPU, where interpret-mode Pallas would run the grid in Python)."""
+    CPU, where interpret-mode Pallas would run the grid in Python).
+    Accepts the same [B, Hq, D] decode and [B, T, Hq, D] verify-frame
+    query layouts with identical per-query causal semantics."""
     b, hq, hkv, ps, d = _check_shapes(q, k_pages, v_pages, page_table,
                                       context_lens)
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    t = q.shape[1]
     group = hq // hkv
     if scale is None:
         scale = 1.0 / math.sqrt(d)
@@ -242,20 +277,24 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, context_lens,
     # [Hkv, B, Pmax, PS, D] -> [B, Hkv, S, D]
     k = jnp.moveaxis(k_pages[:, pt], 1, 0).reshape(b, hkv, s_max, d)
     v = jnp.moveaxis(v_pages[:, pt], 1, 0).reshape(b, hkv, s_max, d)
-    qg = q.reshape(b, hkv, group, d).astype(jnp.float32) * scale
-    s = jnp.einsum("bhgd,bhsd->bhgs", qg, k.astype(jnp.float32))
+    qg = q.reshape(b, t, hkv, group, d).astype(jnp.float32) * scale
+    s = jnp.einsum("bthgd,bhsd->bthgs", qg, k.astype(jnp.float32))
     pos = jnp.arange(s_max, dtype=jnp.int32)
-    s = jnp.where(pos[None, None, None, :] < lens[:, None, None, None],
-                  s, _NEG_INF)
+    # per-query causal limit: frame i attends keys < lens + i (its own
+    # absolute position lens-1+i included)
+    limit = lens[:, None] + jnp.arange(t, dtype=jnp.int32)[None]   # [B, T]
+    s = jnp.where(pos[None, None, None, None, :]
+                  < limit[:, :, None, None, None], s, _NEG_INF)
     # inactive rows (len 0): every position masked; renormalize safely to 0
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     denom = jnp.sum(p, axis=-1, keepdims=True)
-    active = (lens > 0)[:, None, None, None]
-    out = jnp.einsum("bhgs,bhsd->bhgd", p / jnp.maximum(denom, 1e-30),
+    active = (lens > 0)[:, None, None, None, None]
+    out = jnp.einsum("bthgs,bhsd->bthgd", p / jnp.maximum(denom, 1e-30),
                      v.astype(jnp.float32))
     out = jnp.where(active, out, 0.0)
-    return out.reshape(b, hq, d).astype(q.dtype)
+    out = out.reshape(b, t, hq, d).astype(q.dtype)
+    return out[:, 0] if squeeze else out
 
 
 def paged_attention(q, k_pages, v_pages, page_table, context_lens,

@@ -13,6 +13,7 @@ under overload. `InProcessReplica` is the CI-grade transport (engine +
 driver thread in-process); real deployments speak the same three-method
 protocol over HTTP/RPC against serve.py's /healthz + /stats + /generate.
 """
+from paddle_tpu.serving.drafts import NGramProposer
 from paddle_tpu.serving.engine import ServingConfig, ServingEngine
 from paddle_tpu.serving.kv_cache import (PageAllocator, kv_page_bytes,
                                          pages_for_budget)
@@ -25,7 +26,8 @@ from paddle_tpu.serving.sampling import request_key, sample_tokens
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           QueueFull, Request, RequestState)
 
-__all__ = ["ServingConfig", "ServingEngine", "PageAllocator",
+__all__ = ["ServingConfig", "ServingEngine", "NGramProposer",
+           "PageAllocator",
            "kv_page_bytes", "pages_for_budget", "sample_tokens",
            "request_key", "ContinuousBatchingScheduler", "Request",
            "RequestState", "QueueFull", "Router", "RouterConfig",

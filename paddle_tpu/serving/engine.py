@@ -1,27 +1,44 @@
-"""The serving engine: paged KV cache + continuous-batching decode.
+"""The serving engine: paged KV cache + continuous-batching decode, with
+speculative self-draft decoding and copy-on-write prefix page sharing.
 
 Compiled-signature strategy (ZERO decode retraces):
 
-  * ONE decode program. Every decode step runs the fixed
-    ``[serving_decode_batch]`` slot layout — token ids, context lens, page
-    tables, PRNG keys and per-request sampling knobs are ARRAYS, inactive
-    slots are len-0 rows the kernel skips — so after the first step the
-    program never retraces (``decode_retraces_after_warmup`` asserts it).
+  * ONE decode program per draft window K. Every decode step runs the
+    fixed ``[serving_decode_batch]`` slot layout — token ids, context
+    lens, page tables, PRNG keys, per-request sampling knobs AND
+    per-request draft windows are ARRAYS, inactive slots are len-0 rows
+    the kernel skips — so after the first step the program never retraces
+    (``decode_retraces_after_warmup`` asserts it). With
+    ``serving_spec_k=K > 0`` the decode step widens from ``[batch]`` to a
+    ``[batch, K+1]`` VERIFY frame through the same paged kernel: the host
+    n-gram proposer (`drafts.NGramProposer`, no second model) drafts K
+    tokens per request, the frame scores every draft position in ONE
+    dispatch (per-query causal limits inside the kernel), and the program
+    returns the sampled token chain + the accepted-prefix length. Exact
+    semantics: position i's token is sampled (or argmax'd) from the same
+    logits/PRNG chain plain decode would produce, a draft is accepted iff
+    it EQUALS that token, and commits stop at the first mismatch — so the
+    committed stream is bit-equal to non-speculative decode, speculation
+    only changes how many tokens ONE dispatch commits (1..K+1). Rejected
+    drafts' K/V are provisional garbage past the committed length and are
+    rewritten before they ever become readable (the PR-9 last-token
+    rewrite, widened to the frame head).
   * A small prefill bucket set. Prompts prefill one request at a time in
     chunks of ``serving_prefill_chunk`` tokens through the standard flash
     path; chunk length and padded context round up to power-of-two buckets,
-    bounding compiles to |chunk buckets| x |context buckets|.
-
-Prefill/decode disaggregation: admission prefills write K/V pages (chunk
-attention gathers the growing context back from those pages, so a chunk
-attends to every earlier chunk); decode steps run the Pallas paged ragged
-kernel over the packed active batch. The decode step for a request whose
-prefill just landed REWRITES the last context token's K/V (same values) —
-that one redundant token write buys a single uniform decode program with
-no separate first-token sampling path.
+    bounding compiles to |chunk buckets| x |context buckets|. With
+    ``serving_prefix_sharing`` on, admission adopts the longest indexed
+    committed-prefix pages (refcounted, copy-on-write — kv_cache.py) and
+    prefill runs ONLY the unmatched tail: a fleet of requests sharing one
+    system prompt prefills it once.
 
 Sampling runs inside the decode program (greedy + temperature/top-k/top-p,
-per-request RNG keys), so a step's host work is queue bookkeeping only.
+per-request RNG keys), so a step's host work is queue bookkeeping plus
+O(K) dictionary lookups in the draft proposer.
+
+Chaos: ``serving.spec.verify_mismatch`` (PR-10 registry) zeroes every
+row's draft window for the step — a forced full rejection; the engine must
+degrade to plain one-token decode, never wedge.
 """
 from __future__ import annotations
 
@@ -32,6 +49,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.serving.drafts import NGramProposer
 from paddle_tpu.serving.kv_cache import (PageAllocator, kv_page_bytes,
                                          pages_for_budget)
 from paddle_tpu.serving.sampling import request_key, sample_tokens
@@ -39,6 +58,12 @@ from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           QueueFull, Request, RequestState)
 
 __all__ = ["ServingConfig", "ServingEngine"]
+
+faults.register(
+    "serving.spec.verify_mismatch",
+    "forces a speculative verify step to reject every draft (every row's "
+    "window zeroed): the engine must degrade to plain one-token decode "
+    "for the step — same stream, lower throughput — never wedge")
 
 
 @dataclass
@@ -53,6 +78,8 @@ class ServingConfig:
     kv_dtype: object = None         # None -> model param dtype
     sample_seed: int = 0
     max_waiting: int = 0            # 0 -> FLAGS_serving_waiting_queue_limit
+    spec_k: int | None = None       # None -> FLAGS_serving_spec_k
+    prefix_sharing: bool | None = None  # None -> FLAGS_serving_prefix_sharing
 
     def resolved(self, model_max_pos: int):
         from paddle_tpu.core.flags import flag
@@ -65,8 +92,12 @@ class ServingConfig:
         budget = self.hbm_budget_mb or flag("serving_hbm_budget_mb")
         pages = self.num_pages or flag("serving_num_pages")
         waiting = self.max_waiting or flag("serving_waiting_queue_limit")
+        spec_k = (flag("serving_spec_k") if self.spec_k is None
+                  else self.spec_k)
+        sharing = (flag("serving_prefix_sharing")
+                   if self.prefix_sharing is None else self.prefix_sharing)
         return (int(ps), int(batch), int(chunk), int(smax), int(budget),
-                int(pages), int(waiting))
+                int(pages), int(waiting), int(spec_k), bool(sharing))
 
 
 def _buckets(lo: int, hi: int) -> list[int]:
@@ -98,9 +129,12 @@ class ServingEngine:
         self.num_kv_heads = int(mcfg.num_key_value_heads)
         self.head_dim = int(mcfg.hidden_size) // int(mcfg.num_attention_heads)
         (self.page_size, self.decode_batch, self.prefill_chunk,
-         self.max_seq_len, budget_mb, cfg_pages,
-         self.max_waiting) = self.config.resolved(
+         self.max_seq_len, budget_mb, cfg_pages, self.max_waiting,
+         self.spec_k, self.prefix_sharing) = self.config.resolved(
             int(mcfg.max_position_embeddings))
+        if self.spec_k < 0:
+            raise ValueError(f"serving_spec_k must be >= 0, "
+                             f"got {self.spec_k}")
         rope_limit = int(getattr(mcfg, "rope_max_position", 0)
                          or mcfg.max_position_embeddings)
         if self.max_seq_len > rope_limit:
@@ -140,7 +174,9 @@ class ServingEngine:
         self.allocator = PageAllocator(self.num_pages, self.page_size)
         self.scheduler = ContinuousBatchingScheduler(
             self.allocator, self.decode_batch, self.max_seq_len,
-            max_waiting=self.max_waiting)
+            max_waiting=self.max_waiting,
+            prefix_sharing=self.prefix_sharing, spec_k=self.spec_k)
+        self._proposer = NGramProposer()
         self._params = params
         shape = (self.num_layers, self.num_kv_heads, self.num_pages,
                  self.page_size, self.head_dim)
@@ -159,7 +195,19 @@ class ServingEngine:
         self._donate = (jax.devices()[0].platform == "tpu")
         from collections import deque
         self._decode_fn = None
+        self._verify_fns: dict[int, object] = {}    # draft window K -> fn
+        self._copy_fn = None
         self._prefill_fns: dict[tuple[int, int], object] = {}
+        # speculation / prefix-sharing accounting (stats() surfaces these;
+        # the bench's accepted-tokens/step and prefix-hit-rate gates read
+        # them): committed counts REAL tokens delivered to requests, steps
+        # counts decode/verify dispatches, draft_ms the host proposer time
+        self._committed_tokens = 0
+        self._decode_steps = 0
+        self._slot_steps = 0        # sum over steps of active slots
+        self._draft_ms = 0.0
+        self._prefix_admit_tokens = 0
+        self._prefix_matched_tokens = 0
         # bounded: a long-lived server must not grow a sample per decode
         # step forever (utilization_mean is a recent-window statistic)
         self._util_samples: deque = deque(maxlen=65536)
@@ -227,6 +275,103 @@ class ServingEngine:
                 fn, donate_argnums=(1, 2) if self._donate else ())
         return self._prefill_fns[key]
 
+    def _verify(self, k: int):
+        """The [batch, K+1] speculative verify program for draft window
+        `k` — compiled once per K (programs are cached, so toggling K at
+        runtime never retraces a warmed window)."""
+        if k not in self._verify_fns:
+            from paddle_tpu.parallel.train_step import functional_call
+
+            t_frame = k + 1
+            cap = self._ctx_cap()
+
+            def fn(params, ck, cv, ids, lens, page_table, keys, temp,
+                   top_k, top_p, drafts, n_spec):
+                self._decode_traces += 1
+                base = jnp.maximum(lens - 1, 0).astype(jnp.int32)   # [B]
+                offs = jnp.arange(t_frame, dtype=jnp.int32)[None]   # [1,T]
+                positions = base[:, None] + offs                    # [B,T]
+                # frame slot i writes K/V only inside the row's window
+                # (i <= n_spec), inside the context cap, and only for
+                # active rows; everything else spills to the null page
+                write_mask = ((offs <= n_spec[:, None])
+                              & (positions < cap)
+                              & (lens > 0)[:, None])
+                positions = jnp.minimum(positions, cap - 1)
+                logits3, cache = functional_call(
+                    self.model, params, (ids,),
+                    dict(cache={"k": ck, "v": cv}, page_table=page_table,
+                         context_lens=lens, position_ids=positions,
+                         write_mask=write_mask, verify=True),
+                    training=False, method="decode_forward")
+                logits = logits3._value                           # [B,T,V]
+                # the EXACT plain-decode sampling chain, unrolled over the
+                # frame: position i draws with the key plain decode would
+                # hold after i commits, so the committed stream is
+                # bit-equal to non-speculative decode by construction
+                toks, carries = [], []
+                kc = keys
+                for i in range(t_frame):
+                    t_i, kc = sample_tokens(logits[:, i], kc, temp,
+                                            top_k, top_p)
+                    toks.append(t_i)
+                    carries.append(kc)
+                tokens = jnp.stack(toks, axis=1)                  # [B, T]
+                keyc = jnp.stack(carries, axis=1)                 # [B,T,2]
+                # a draft is ACCEPTED iff it equals the token the target
+                # chain sampled at its position (acceptance probability ==
+                # p(draft), the point-mass rejection-sampling rate);
+                # commits = accepted prefix + the first divergent sample,
+                # which is itself drawn from the exact conditional
+                match = ((tokens[:, :k] == drafts)
+                         & (jnp.arange(k, dtype=jnp.int32)[None]
+                            < n_spec[:, None]))
+                accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
+                                               axis=1), axis=1)    # [B]
+                new_keys = jnp.take_along_axis(
+                    keyc, accepted[:, None, None], axis=1)[:, 0]
+                return tokens, accepted, new_keys, cache["k"], cache["v"]
+
+            self._verify_fns[k] = jax.jit(
+                fn, donate_argnums=(1, 2) if self._donate else ())
+        return self._verify_fns[k]
+
+    def _copy_page(self):
+        """One-page copy-on-write program (src/dst ride as arrays — ONE
+        compile serves every copy)."""
+        if self._copy_fn is None:
+            def fn(ck, cv, src, dst):
+                return (ck.at[:, :, dst].set(ck[:, :, src]),
+                        cv.at[:, :, dst].set(cv[:, :, src]))
+
+            self._copy_fn = jax.jit(
+                fn, donate_argnums=(0, 1) if self._donate else ())
+        return self._copy_fn
+
+    def configure_speculation(self, spec_k: int | None = None,
+                              prefix_sharing: bool | None = None):
+        """Runtime toggle for A/B runs on ONE engine (the bench's
+        baseline-vs-speculative arms share every compiled program): verify
+        programs are cached per K, so switching back to a warmed window
+        costs nothing."""
+        if spec_k is not None:
+            if spec_k < 0:
+                raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+            turning_on = spec_k > 0 and self.spec_k == 0
+            self.spec_k = int(spec_k)
+            self.scheduler.spec_k = int(spec_k)
+            if turning_on:
+                # plain decode neither seeds nor feeds the proposer, so
+                # live requests would draft from missing/stale tables
+                # (every verify frame fully rejected — (K+1)x compute per
+                # committed token). Reseed from each committed stream:
+                # tables are a pure function of it, so this is exact.
+                for rid, req in self.scheduler._by_rid.items():
+                    self._proposer.add_request(rid, req.context)
+        if prefix_sharing is not None:
+            self.prefix_sharing = bool(prefix_sharing)
+            self.scheduler.prefix_sharing = bool(prefix_sharing)
+
     # ------------------------------------------------------------------
     # request intake
     # ------------------------------------------------------------------
@@ -241,6 +386,8 @@ class ServingEngine:
         # alone; the scheduler enforces the length limit
         rid = self.scheduler.submit(req)
         self._keys[rid] = self._new_key()
+        if self.spec_k > 0:
+            self._proposer.add_request(rid, req.prompt)
         return rid
 
     def _new_key(self) -> np.ndarray:
@@ -262,7 +409,16 @@ class ServingEngine:
         total = int(ctx.size)
         row = jnp.asarray(self.allocator.page_table_row(
             req.rid, self.pages_per_seq))
-        off = 0
+        # prefix sharing: the adopted pages already hold the matched
+        # prefix's committed K/V — prefill runs ONLY the unmatched tail
+        # (chunk attention still gathers the WHOLE context back from the
+        # pages, shared ones included, so the tail attends to the shared
+        # prefix exactly as if it had been prefilled here). A full match
+        # skips prefill entirely; the first decode step's last-token
+        # rewrite (CoW'd if the page is shared) keeps the stream exact.
+        off = int(req.matched_tokens)
+        self._prefix_admit_tokens += total
+        self._prefix_matched_tokens += off
         while off < total:
             t = min(self.prefill_chunk, total - off)
             cpad = _bucket(t, self._chunk_buckets)
@@ -319,16 +475,123 @@ class ServingEngine:
             if ((req.eos_id is not None and tok == req.eos_id)
                     or len(req.generated) >= req.max_new_tokens):
                 finisher(req)
+        self._committed_tokens += len(active)
+        self._slot_steps += len(active)
+        self._decode_steps += 1
         self._util_samples.append(self.allocator.utilization())
 
+    def _verify_once(self, active, finisher):
+        """Pack `active` requests into the fixed [batch, K+1] verify
+        signature, run ONE compiled verify step, and commit the accepted
+        token runs — the speculative sibling of `_decode_once` (same
+        program role, 1..K+1 committed tokens per request per dispatch)."""
+        b, pmax, k = self.decode_batch, self.pages_per_seq, self.spec_k
+        t_frame = k + 1
+        cap = self._ctx_cap()
+        ids = np.zeros((b, t_frame), np.int32)
+        drafts = np.zeros((b, k), np.int32)
+        n_spec = np.zeros(b, np.int32)
+        lens = np.zeros(b, np.int32)
+        pt = np.zeros((b, pmax), np.int32)
+        keys = np.zeros((b, 2), np.uint32)
+        temp = np.zeros(b, np.float32)
+        top_k = np.zeros(b, np.int32)
+        top_p = np.ones(b, np.float32)
+        # chaos: a forced FULL rejection — every window zeroed, the frame
+        # degrades to plain one-token decode for this step
+        chaos_reject = faults.fire_check("serving.spec.verify_mismatch")
+        t_draft = time.perf_counter()
+        for i, req in enumerate(active):
+            ids[i, 0] = (req.generated[-1] if req.generated
+                         else int(req.prompt[-1]))
+            lens[i] = req.total_len
+            pt[i] = self.allocator.page_table_row(req.rid, pmax)
+            keys[i] = self._keys[req.rid]
+            temp[i] = req.temperature
+            top_k[i] = req.top_k
+            top_p[i] = req.top_p
+            # the row's draft window: never past the request's remaining
+            # budget (commits = window+1 at most) nor the context cap
+            # (frame writes reach position total_len-1+window)
+            n = min(k, req.max_new_tokens - len(req.generated) - 1,
+                    cap - req.total_len)
+            if chaos_reject or n <= 0:
+                continue
+            prop = self._proposer.propose(req.rid, n)
+            drafts[i, :n] = prop
+            ids[i, 1:1 + n] = prop
+            n_spec[i] = n
+        self._draft_ms += (time.perf_counter() - t_draft) * 1e3
+        tokens, accepted, new_keys, self._ck, self._cv = self._verify(k)(
+            self._params, self._ck, self._cv, jnp.asarray(ids),
+            jnp.asarray(lens), jnp.asarray(pt), jnp.asarray(keys),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(drafts), jnp.asarray(n_spec))
+        toks = np.asarray(tokens)
+        acc = np.asarray(accepted)
+        nkeys = np.asarray(new_keys)
+        now = time.perf_counter()
+        for i, req in enumerate(active):
+            # the verified chain: accepted drafts + the first divergent
+            # (or bonus) sample — each token is exactly what plain decode
+            # would have produced, so streaming/eos/budget handling is
+            # token-by-token identical
+            self._keys[req.rid] = nkeys[i]
+            for tok in toks[i, :int(acc[i]) + 1]:
+                tok = int(tok)
+                req.generated.append(tok)
+                req.token_times.append(now)
+                self._committed_tokens += 1
+                if self.spec_k > 0:
+                    self._proposer.observe(req.rid, tok)
+                if req.stream_cb is not None:
+                    req.stream_cb(req, tok)
+                if ((req.eos_id is not None and tok == req.eos_id)
+                        or len(req.generated) >= req.max_new_tokens):
+                    finisher(req)
+                    break
+        self._slot_steps += len(active)
+        self._decode_steps += 1
+        self._util_samples.append(self.allocator.utilization())
+
+    def _apply_cow(self):
+        """Apply the scheduler's pending copy-on-write page copies
+        device-side (src keeps the sharers; dst is the writer's private
+        copy — byte-identical at the moment of the split)."""
+        copies = self.scheduler.pending_cow
+        if not copies:
+            return
+        self.scheduler.pending_cow = []
+        fn = self._copy_page()
+        for src, dst in copies:
+            self._ck, self._cv = fn(self._ck, self._cv,
+                                    jnp.asarray(src, jnp.int32),
+                                    jnp.asarray(dst, jnp.int32))
+
     def step(self) -> bool:
-        """One scheduler iteration: admissions (+ their prefills), chain
-        growth/eviction, then ONE packed decode step. Returns False when
-        nothing is running (idle or waiting-only)."""
-        for req in self.scheduler.admissions():
+        """One scheduler iteration: admissions (+ their tail prefills and
+        prefix registration), chain growth/eviction + copy-on-write, then
+        ONE packed decode step — the [batch] plain-decode program, or the
+        [batch, K+1] speculative verify frame when serving_spec_k > 0.
+        Returns False when nothing is running (idle or waiting-only)."""
+        while True:
+            # one admission at a time: each request's prefill + prefix
+            # registration lands BEFORE the next match, so same-step
+            # arrivals sharing a system prompt adopt each other's pages
+            admitted = self.scheduler.admissions(limit=1)
+            if not admitted:
+                break
+            req = admitted[0]
             self._run_prefill(req)
+            if self.prefix_sharing:
+                # a request's committed context (prompt + pre-eviction
+                # generation) becomes matchable the moment its pages are
+                # written: the next admission sharing the prefix adopts
+                # them instead of re-prefilling
+                self.allocator.register_prefix(req.rid, req.context)
             self.scheduler.activate(req)
         self.scheduler.grow()
+        self._apply_cow()
         running = list(self.scheduler.running)
         if not running:
             if self.scheduler.waiting:
@@ -339,7 +602,10 @@ class ServingEngine:
                     f"with {self.allocator.free_pages} free pages and "
                     f"nothing left to evict")
             return False
-        self._decode_once(running, self.scheduler.finish)
+        if self.spec_k > 0:
+            self._verify_once(running, self.scheduler.finish)
+        else:
+            self._decode_once(running, self.scheduler.finish)
         return True
 
     def run_until_idle(self, max_steps: int = 1_000_000):
@@ -352,10 +618,12 @@ class ServingEngine:
         return steps
 
     def release(self, rid: int):
-        """Drop a finished request's bookkeeping (scheduler entry + RNG
-        key) — the per-request memory a long-lived server must not retain."""
+        """Drop a finished request's bookkeeping (scheduler entry, RNG
+        key, draft tables) — the per-request memory a long-lived server
+        must not retain."""
         self.scheduler.release(rid)
         self._keys.pop(rid, None)
+        self._proposer.drop(rid)
 
     def generate(self, prompts, max_new_tokens: int = 16, **kw):
         """Synchronous convenience: submit all, run to completion, return
@@ -602,13 +870,51 @@ class ServingEngine:
             "decode_retraces_after_warmup": self.decode_retraces_after_warmup,
             "free_pages": self.allocator.free_pages,
             "waiting_limit": self.max_waiting,
+            # PR-12: REAL-token accounting — with speculation one dispatch
+            # commits 1..K+1 tokens per slot, so slot_fill alone
+            # understates delivered throughput; routers/dashboards should
+            # watermark on accepted tokens, not steps
+            "spec_k": self.spec_k,
+            "accepted_tokens_per_step": self.accepted_tokens_per_step,
+            "prefix_hit_rate": self.prefix_hit_rate,
+            "cow_copies": self.allocator.cow_copies,
+            "draft_ms_total": round(self._draft_ms, 3),
         }
+
+    @property
+    def accepted_tokens_per_step(self) -> float:
+        """Committed (real) tokens per OCCUPIED SLOT per dispatch — 1.0
+        for plain decode, up to K+1 with perfect draft acceptance
+        (normalized by slot-steps, so batching can't inflate it)."""
+        return round(self._committed_tokens / self._slot_steps, 4) \
+            if self._slot_steps else 0.0
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admission context tokens covered by adopted shared
+        prefix pages (prefill skipped for exactly these tokens)."""
+        return round(self._prefix_matched_tokens
+                     / self._prefix_admit_tokens, 4) \
+            if self._prefix_admit_tokens else 0.0
+
+    @property
+    def draft_ms_total(self) -> float:
+        return self._draft_ms
 
     def utilization_mean(self) -> float:
         return float(np.mean(self._util_samples)) if self._util_samples else 0.0
 
     def reset_stats(self):
         self._util_samples.clear()
+        self._committed_tokens = 0
+        self._decode_steps = 0
+        self._slot_steps = 0
+        self._draft_ms = 0.0
+        self._prefix_admit_tokens = 0
+        self._prefix_matched_tokens = 0
+        self.allocator.cow_copies = 0
+        self.allocator.prefix_matches = 0
+        self.allocator.prefix_tokens_matched = 0
 
     @staticmethod
     def latency_stats(requests) -> dict:

@@ -1,5 +1,5 @@
 """Block-granular KV-cache page allocator (the vLLM PagedAttention memory
-manager, host side).
+manager, host side) with refcounted copy-on-write prefix sharing.
 
 The device-side pools are plain ``[layers, kv_heads, num_pages, page_size,
 head_dim]`` arrays owned by the serving engine; this module owns the INDEX
@@ -9,9 +9,25 @@ sizes the pool. Page 0 is the reserved NULL page — never allocated, it backs
 the dead slots of every page-table row so the kernel's skipped pages have a
 harmless DMA target.
 
-Eviction is COPY-FREE: freeing a chain just returns its page ids to the free
-list (preempt-by-recomputation — the scheduler re-prefills the victim later);
-no page contents ever move.
+Prefix sharing (PR 12): a page holding a COMMITTED, FULL page of tokens can
+be registered in a prefix index keyed by the literal token prefix it
+completes (hash-map per depth == a radix walk in page_size strides, with the
+exact token bytes as the key so a hash collision can never alias two
+different prefixes). Admission matches the longest indexed prefix of the new
+request's context and links those pages into the new chain — one physical
+page then backs the shared system prompt of every concurrent request, and
+prefill runs only over the unmatched tail. Pages are refcounted by the
+chains holding them; a write into a shared page triggers COPY-ON-WRITE
+(`make_writable` hands the engine (src, dst) pairs to copy device-side and
+swaps the fresh page into the writer's chain), so a sharer's reads are
+byte-identical forever. A page leaves the index when its last holder frees
+it — the index retains nothing, so sharing happens among live overlapping
+requests and `check_consistency` keeps a strict partition invariant.
+
+Eviction is COPY-FREE: freeing a chain decrefs its pages (preempt-by-
+recomputation — the scheduler re-prefills the victim later); pages still
+held by sharers survive untouched, and a re-admitted victim re-matches the
+shared prefix so its re-prefill skips the shared pages again.
 """
 from __future__ import annotations
 
@@ -25,9 +41,14 @@ NULL_PAGE = 0
 
 
 def kv_page_bytes(num_layers: int, num_kv_heads: int, page_size: int,
-                  head_dim: int, dtype_bytes: int = 2) -> int:
+                  head_dim: int, dtype_bytes=2) -> int:
     """K+V bytes ONE page costs across the whole layer stack — the unit of
-    the serving HBM budget."""
+    the serving HBM budget. `dtype_bytes` is the CACHE POOL dtype (an
+    itemsize int, or any np/jnp dtype spec) — the pool may be narrower than
+    the compute dtype (an int8 KV pool under a bf16 model halves page
+    bytes, doubling the pages a budget buys)."""
+    if not isinstance(dtype_bytes, int):
+        dtype_bytes = int(np.dtype(dtype_bytes).itemsize)
     return 2 * num_layers * num_kv_heads * page_size * head_dim * dtype_bytes
 
 
@@ -36,13 +57,24 @@ def pages_for_budget(budget_bytes: int, page_bytes: int) -> int:
     return max(2, budget_bytes // max(page_bytes, 1))
 
 
-class PageAllocator:
-    """Free-list page allocator with per-request chains.
+def _prefix_key(tokens: np.ndarray, depth: int, page_size: int) -> bytes:
+    """Index key of the prefix that ends with full page `depth`: the exact
+    token bytes (not a digest — equality IS the match, collisions are
+    structurally impossible)."""
+    return np.ascontiguousarray(
+        tokens[:(depth + 1) * page_size], np.int32).tobytes()
 
-    Invariants (asserted): a page belongs to at most one chain; the null
-    page belongs to none; chain growth is all-or-nothing (a request either
-    gets every page its context needs or the allocator reports exhaustion
-    and the scheduler evicts/queues).
+
+class PageAllocator:
+    """Refcounted free-list page allocator with per-request chains and a
+    shared-prefix index.
+
+    Invariants (asserted by `check_consistency`): a page's refcount equals
+    the number of chains holding it; the free list and the refcounted pages
+    partition the non-null pool; the null page belongs to no chain; every
+    indexed prefix page is allocated; chain growth and prefix adoption are
+    all-or-nothing (a request either gets every page its context needs or
+    the allocator reports exhaustion and the scheduler evicts/queues).
     """
 
     def __init__(self, num_pages: int, page_size: int):
@@ -55,7 +87,12 @@ class PageAllocator:
         self.page_size = int(page_size)
         self._free = deque(range(1, num_pages))
         self._chains: dict[object, list[int]] = {}
-        self._owner: dict[int, object] = {}
+        self._holders: dict[int, set] = {}      # page -> rids (refcount)
+        self._prefix_index: dict[bytes, int] = {}   # token prefix -> page
+        self._page_prefix: dict[int, bytes] = {}    # page -> its index key
+        self.prefix_matches = 0                 # admissions that hit
+        self.prefix_tokens_matched = 0          # tokens skipped via the index
+        self.cow_copies = 0                     # copy-on-write page copies
 
     # ---- capacity ---------------------------------------------------------
     @property
@@ -79,35 +116,73 @@ class PageAllocator:
     def chain(self, rid) -> list[int]:
         return list(self._chains.get(rid, ()))
 
-    def ensure(self, rid, total_tokens: int) -> bool:
+    def ref_count(self, page: int) -> int:
+        return len(self._holders.get(page, ()))
+
+    def is_shared(self, page: int) -> bool:
+        return len(self._holders.get(page, ())) > 1
+
+    def _alloc_one(self, rid) -> int:
+        page = self._free.popleft()
+        assert page not in self._holders and page != NULL_PAGE, \
+            f"page {page} double-allocated"
+        self._holders[page] = {rid}
+        return page
+
+    def _release_one(self, page: int, rid):
+        holders = self._holders.get(page)
+        assert holders is not None and rid in holders, \
+            f"page {page} released by {rid!r} but held by " \
+            f"{sorted(map(repr, holders or ()))}"
+        holders.discard(rid)
+        if not holders:
+            del self._holders[page]
+            key = self._page_prefix.pop(page, None)
+            if key is not None and self._prefix_index.get(key) == page:
+                del self._prefix_index[key]
+            self._free.append(page)
+
+    def ensure(self, rid, total_tokens: int, adopt: list[int] | None = None) \
+            -> bool:
         """Grow request `rid`'s chain until it covers `total_tokens` tokens.
-        All-or-nothing: on exhaustion nothing is allocated and False is
-        returned (the scheduler then evicts or queues)."""
+        `adopt` (admission only — the chain must be empty) links the given
+        already-allocated SHARED prefix pages in front before topping up
+        with fresh pages. All-or-nothing: on exhaustion nothing is
+        allocated or adopted and False is returned (the scheduler then
+        evicts or queues)."""
         chain = self._chains.setdefault(rid, [])
-        need = self.pages_for(total_tokens) - len(chain)
-        if need <= 0:
-            return True
+        if adopt:
+            assert not chain, \
+                f"prefix adoption into a non-empty chain of {rid!r}"
+            for page in adopt:
+                assert page in self._holders and page != NULL_PAGE, \
+                    f"adopting unallocated page {page}"
+        # ONE exhaustion check before ANY mutation (adoption consumes no
+        # free pages, so the fresh-page shortfall is known up front):
+        # all-or-nothing needs no rollback path
+        need = (self.pages_for(total_tokens) - len(chain)
+                - (len(adopt) if adopt else 0))
         if need > len(self._free):
             if not chain:
                 del self._chains[rid]
             return False
-        for _ in range(need):
-            page = self._free.popleft()
-            assert page not in self._owner and page != NULL_PAGE, \
-                f"page {page} double-allocated"
-            self._owner[page] = rid
-            chain.append(page)
+        if adopt:
+            for page in adopt:
+                self._holders[page].add(rid)
+                chain.append(page)
+            self.prefix_matches += 1
+            self.prefix_tokens_matched += len(adopt) * self.page_size
+        for _ in range(max(need, 0)):
+            chain.append(self._alloc_one(rid))
         return True
 
     def free_request(self, rid) -> int:
-        """Return `rid`'s whole chain to the free list (request completion,
-        cancellation, or copy-free eviction). Returns the page count."""
+        """Decref `rid`'s whole chain (request completion, cancellation, or
+        copy-free eviction); pages still held by prefix sharers survive,
+        the rest return to the free list. Returns the chain length."""
         chain = self._chains.pop(rid, [])
         for page in chain:
-            owner = self._owner.pop(page, None)
-            assert owner is rid, \
-                f"page {page} freed by {rid!r} but owned by {owner!r}"
-            self._free.append(page)
+            self._release_one(page, rid)
         return len(chain)
 
     def page_table_row(self, rid, pages_per_seq: int) -> np.ndarray:
@@ -121,17 +196,102 @@ class PageAllocator:
         row[:len(chain)] = chain
         return row
 
+    # ---- prefix sharing ---------------------------------------------------
+    def match_prefix(self, tokens) -> tuple[list[int], int]:
+        """Longest indexed prefix of `tokens`, in whole committed pages:
+        returns (pages, matched_token_count). The radix walk is one index
+        probe per page_size stride, keyed by the exact token bytes."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        pages: list[int] = []
+        ps = self.page_size
+        depth = 0
+        while (depth + 1) * ps <= tokens.size:
+            page = self._prefix_index.get(_prefix_key(tokens, depth, ps))
+            if page is None:
+                break
+            pages.append(page)
+            depth += 1
+        return pages, depth * ps
+
+    def register_prefix(self, rid, tokens) -> int:
+        """Index `rid`'s chain pages that hold FULL pages of the committed
+        `tokens` (the request's context at registration). Depths already
+        indexed keep their first registrant (the matcher adopted those very
+        pages, so re-registering is a no-op). Returns newly indexed pages.
+        """
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        chain = self._chains.get(rid, ())
+        ps = self.page_size
+        new = 0
+        for depth in range(min(tokens.size // ps, len(chain))):
+            key = _prefix_key(tokens, depth, ps)
+            if key in self._prefix_index:
+                continue
+            page = chain[depth]
+            if page in self._page_prefix:       # already indexed under
+                continue                        # another (stale) prefix
+            self._prefix_index[key] = page
+            self._page_prefix[page] = key
+            new += 1
+        return new
+
+    def make_writable(self, rid, first_token: int, last_token: int) \
+            -> list[tuple[int, int]] | None:
+        """Copy-on-write: every chain page of `rid` covering token positions
+        [first_token, last_token] that is SHARED gets replaced by a fresh
+        page; returns the (src, dst) pairs the engine must copy device-side
+        (src keeps the sharers and its index entry; dst is private to
+        `rid`). Returns None on pool exhaustion with NOTHING changed (the
+        scheduler then evicts and retries) — all-or-nothing like `ensure`.
+        """
+        chain = self._chains.get(rid)
+        if not chain or last_token < first_token:
+            return []
+        ps = self.page_size
+        lo = max(first_token // ps, 0)
+        hi = min(last_token // ps, len(chain) - 1)
+        shared_idx = [i for i in range(lo, hi + 1)
+                      if self.is_shared(chain[i])]
+        if len(shared_idx) > len(self._free):
+            return None
+        copies = []
+        for i in shared_idx:
+            src = chain[i]
+            dst = self._alloc_one(rid)
+            self._release_one(src, rid)
+            chain[i] = dst
+            copies.append((src, dst))
+        self.cow_copies += len(copies)
+        return copies
+
+    # ---- invariants -------------------------------------------------------
     def check_consistency(self):
-        """Test hook: every allocated page owned by exactly one chain, free
-        list and chains partition the non-null pool."""
-        seen = {}
+        """Test hook: every allocated page refcounted by exactly the chains
+        that contain it, free list and refcounted pages partition the
+        non-null pool, the prefix index points only at allocated pages."""
+        seen: dict[int, set] = {}
         for rid, chain in self._chains.items():
             for page in chain:
                 assert page != NULL_PAGE, f"null page in chain of {rid!r}"
-                assert page not in seen, \
-                    f"page {page} aliased by {seen[page]!r} and {rid!r}"
-                seen[page] = rid
+                assert page not in seen or rid not in seen[page], \
+                    f"page {page} appears twice in chain of {rid!r}"
+                seen.setdefault(page, set()).add(rid)
+        assert seen.keys() == self._holders.keys(), \
+            "holder map out of sync with chains"
+        for page, rids in seen.items():
+            assert rids == self._holders[page], \
+                f"page {page} refcount {sorted(map(repr, self._holders[page]))} " \
+                f"!= chains holding it {sorted(map(repr, rids))}"
         free = set(self._free)
+        assert len(free) == len(self._free), "free list duplicates"
         assert not (free & set(seen)), "free list overlaps a live chain"
         assert len(free) + len(seen) == self.num_pages - 1, \
             "pages leaked or duplicated"
+        for key, page in self._prefix_index.items():
+            assert page in self._holders, \
+                f"prefix index points at freed page {page}"
+            assert self._page_prefix.get(page) == key, \
+                f"prefix backref out of sync for page {page}"
+        for page in self._page_prefix:
+            assert page in self._holders, \
+                f"prefix backref holds freed page {page}"

@@ -67,6 +67,10 @@ class Request:
     admitted_t: float = 0.0
     token_times: list = field(default_factory=list)
     evictions: int = 0
+    # tokens of req.context covered by prefix-shared pages adopted at the
+    # LAST admission: the engine's prefill starts here (0 = no match);
+    # reset on eviction, re-matched on re-admission
+    matched_tokens: int = 0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -92,7 +96,8 @@ class Request:
 
 class ContinuousBatchingScheduler:
     def __init__(self, allocator: PageAllocator, max_batch: int,
-                 max_seq_len: int, max_waiting: int = 0):
+                 max_seq_len: int, max_waiting: int = 0,
+                 prefix_sharing: bool = False, spec_k: int = 0):
         self.allocator = allocator
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq_len)
@@ -100,6 +105,15 @@ class ContinuousBatchingScheduler:
         # being recovered) bypass it, so a full queue can never deadlock
         # an eviction. 0 = unbounded.
         self.max_waiting = int(max_waiting)
+        # PR-12: admission matches the longest shared context prefix in the
+        # allocator's index and adopts those pages (prefill then covers
+        # only the tail); spec_k widens grow()'s write horizon to the
+        # speculative verify frame and turns shared-page writes into
+        # copy-on-write (pending_cow — the engine applies the device
+        # copies before its next decode/verify dispatch)
+        self.prefix_sharing = bool(prefix_sharing)
+        self.spec_k = int(spec_k)
+        self.pending_cow: list[tuple[int, int]] = []
         self.waiting: list[Request] = []
         self.running: list[Request] = []        # admission order == age
         self._by_rid: dict[int, Request] = {}
@@ -142,17 +156,29 @@ class ContinuousBatchingScheduler:
         return max(now - r.arrival_t for r in waiting)
 
     # ---- per-step policy --------------------------------------------------
-    def admissions(self) -> list[Request]:
+    def admissions(self, limit: int = 0) -> list[Request]:
         """Pop waiting requests into free decode slots while the allocator
         can back each FULL context (prompt + any pre-eviction tokens) plus
         one decode step of headroom — admitted requests must be prefilled
-        by the engine before the next decode step."""
+        by the engine before the next decode step. With prefix sharing on,
+        the longest indexed prefix of the context is adopted (refcounted
+        shared pages) instead of allocated, and the engine's prefill skips
+        it (`req.matched_tokens`). `limit` caps the pops (the engine
+        admits ONE at a time so each admission's prefill + prefix
+        registration is visible to the next — two same-step arrivals with
+        a common system prompt share its pages); 0 = fill every slot."""
         admitted = []
         while (self.waiting and
-               len(self.running) + len(admitted) < self.max_batch):
+               len(self.running) + len(admitted) < self.max_batch and
+               (not limit or len(admitted) < limit)):
             req = self.waiting[0]
-            if not self.allocator.ensure(req.rid, req.total_len + 1):
+            adopt, matched = ([], 0)
+            if self.prefix_sharing:
+                adopt, matched = self.allocator.match_prefix(req.context)
+            if not self.allocator.ensure(req.rid, req.total_len + 1,
+                                         adopt=adopt or None):
                 break                       # exhausted: keep FIFO order
+            req.matched_tokens = matched
             self.waiting.pop(0)
             req.state = RequestState.RUNNING
             req.admitted_t = time.perf_counter()
@@ -164,26 +190,47 @@ class ContinuousBatchingScheduler:
 
     def grow(self) -> list[Request]:
         """Before a decode step: every running request's chain must cover
-        its context + the token the step writes. On exhaustion, evict the
+        its context + the tokens the step writes (one for plain decode;
+        the spec_k-token verify window widens the horizon), and every
+        SHARED page inside the step's write range must be made private
+        first (copy-on-write — the (src, dst) device copies accumulate in
+        `pending_cow` for the engine to apply). On exhaustion, evict the
         YOUNGEST running request (LIFO preemption — the victim has the
         least sunk decode work) and retry; the requester itself can be the
         victim. Returns the evicted requests."""
         evicted = []
         for req in list(self.running):
-            while (req in self.running and
-                   not self.allocator.ensure(req.rid, req.total_len)):
+            while req in self.running and not self._grow_one(req):
                 victim = self.running[-1]
                 self._evict(victim)
                 evicted.append(victim)
         return evicted
 
+    def _grow_one(self, req: Request) -> bool:
+        """Chain coverage + writability for ONE request's next step; False
+        on pool exhaustion (nothing allocated — `ensure`/`make_writable`
+        are both all-or-nothing)."""
+        horizon = min(req.total_len + self.spec_k, self.max_seq_len)
+        if not self.allocator.ensure(req.rid, horizon):
+            return False
+        copies = self.allocator.make_writable(
+            req.rid, req.total_len - 1,
+            min(req.total_len - 1 + self.spec_k, self.max_seq_len - 1))
+        if copies is None:
+            return False
+        self.pending_cow.extend(copies)
+        return True
+
     def _evict(self, victim: Request):
-        """Copy-free: drop the chain, requeue at the FRONT for
-        re-prefill of prompt + generated-so-far."""
+        """Copy-free: drop the chain (prefix sharers keep their refcounted
+        pages), requeue at the FRONT for re-prefill of prompt +
+        generated-so-far (minus whatever prefix still matches the index
+        at re-admission)."""
         self.allocator.free_request(victim.rid)
         self.running.remove(victim)
         victim.state = RequestState.WAITING
         victim.evictions += 1
+        victim.matched_tokens = 0
         self.waiting.insert(0, victim)
 
     # ---- completion -------------------------------------------------------

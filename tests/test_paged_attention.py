@@ -180,3 +180,61 @@ class TestVisitCounter:
         frac = got.sum() / (len(lens) * pps)
         assert frac == sum(-(-ln // ps) for ln in lens) / (len(lens) * pps)
         assert frac < 0.45
+
+
+class TestVerifyFrame:
+    """PR 12: the [B, T, Hq, D] speculative verify frame — per-query
+    causal limits through the same scalar-prefetch page gather."""
+
+    def _case(self, rng, t, hq, hkv, lens, dtype=np.float32):
+        q3, kp, vp, pt, ln = _build_case(rng, len(lens), hq, hkv, 8, 4, 24,
+                                         6, lens, dtype)
+        q = jnp.asarray(rng.randn(len(lens), t, hq, 8).astype(dtype))
+        return q, kp, vp, pt, ln
+
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (6, 2)])
+    def test_fp32_kernel_matches_reference(self, paged_interpret, hq, hkv):
+        rng = np.random.RandomState(0)
+        q, kp, vp, pt, lens = self._case(rng, 3, hq, hkv, [9, 17, 4])
+        ker = paged_decode_attention(q, kp, vp, pt, lens)
+        ref = paged_attention_reference(q, kp, vp, pt, lens)
+        np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_bf16_gqa_kernel_matches_reference(self, paged_interpret):
+        rng = np.random.RandomState(1)
+        q, kp, vp, pt, lens = self._case(rng, 4, 8, 2, [11, 6, 20],
+                                         np.float32)
+        q, kp, vp = (x.astype(jnp.bfloat16) for x in (q, kp, vp))
+        ker = paged_decode_attention(q, kp, vp, pt, lens)
+        ref = paged_attention_reference(q, kp, vp, pt, lens)
+        np.testing.assert_allclose(np.asarray(ker, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=1e-2, rtol=1e-2)
+
+    def test_per_query_causal_limit_is_lens_plus_frame(self, paged_interpret):
+        """Frame i must equal a plain T=1 decode at context_lens + i: the
+        per-query limit is EXACTLY the plain-decode mask shifted by the
+        frame index (so accepted drafts see their own K/V, later keys
+        never leak backwards)."""
+        rng = np.random.RandomState(2)
+        q, kp, vp, pt, lens = self._case(rng, 4, 4, 2, [9, 14])
+        frame = np.asarray(paged_decode_attention(q, kp, vp, pt, lens))
+        for i in range(4):
+            one = paged_decode_attention(q[:, i], kp, vp, pt, lens + i)
+            np.testing.assert_allclose(np.asarray(one), frame[:, i],
+                                       atol=1e-6, rtol=1e-6)
+
+    def test_t1_frame_equals_decode_path(self, paged_interpret):
+        rng = np.random.RandomState(3)
+        q, kp, vp, pt, lens = self._case(rng, 1, 4, 4, [9, 17, 4])
+        a = np.asarray(paged_decode_attention(q, kp, vp, pt, lens))
+        b = np.asarray(paged_decode_attention(q[:, 0], kp, vp, pt, lens))
+        assert (a[:, 0] == b).all()
+
+    def test_inactive_rows_zero_in_frame(self, paged_interpret):
+        rng = np.random.RandomState(4)
+        q, kp, vp, pt, lens = self._case(rng, 3, 4, 2, [9, 0, 5])
+        out = np.asarray(paged_decode_attention(q, kp, vp, pt, lens))
+        assert (out[1] == 0).all()
+        assert np.isfinite(out).all()

@@ -44,13 +44,15 @@ CHAOS_POINTS = [
     "feeder.collate", "feeder.device_put", "step.grads", "store.barrier",
     "watchdog.hang",
 ]
-# the serving half of the registry (PR 11): registered at import of
-# paddle_tpu.serving.replica/router, exercised by the routed chaos matrix
-# in test_router.py — these points fire on serving traffic, so injecting
-# them into a Model.fit run would test nothing
+# the serving half of the registry (PR 11/12): registered at import of
+# paddle_tpu.serving.replica/router/engine, exercised by the routed chaos
+# matrix in test_router.py (transport points) and the speculative-decode
+# degradation test in test_serving.py (serving.spec.verify_mismatch) —
+# these points fire on serving traffic, so injecting them into a
+# Model.fit run would test nothing
 SERVING_CHAOS_POINTS = [
     "serving.dispatch.drop", "serving.replica.kill", "serving.replica.slow",
-    "serving.stream.cut",
+    "serving.spec.verify_mismatch", "serving.stream.cut",
 ]
 
 

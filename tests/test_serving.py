@@ -435,3 +435,390 @@ class TestNoPickle:
                 if needle in src:
                     offenders.append(f"{os.path.basename(path)}: {needle}")
         assert not offenders, offenders
+
+
+# ---------------------------------------------------------------------------
+# PR 12: copy-on-write shared-prefix pages (allocator level)
+# ---------------------------------------------------------------------------
+class TestPrefixSharing:
+    def test_kv_page_bytes_takes_cache_dtype(self):
+        """Satellite regression: page sizing follows the CACHE dtype, not
+        the compute dtype — an int8 KV pool halves page bytes vs bf16 (so
+        a budget buys 2x the pages), and the legacy itemsize-int spelling
+        keeps working."""
+        bf16 = kv_page_bytes(2, 2, 16, 64, dtype_bytes=jnp.bfloat16)
+        int8 = kv_page_bytes(2, 2, 16, 64, dtype_bytes=jnp.int8)
+        assert bf16 == 2 * int8 == kv_page_bytes(2, 2, 16, 64, 2)
+        assert kv_page_bytes(2, 2, 16, 64, np.float32) == 2 * bf16
+        assert pages_for_budget(10 * int8, int8) == 2 * pages_for_budget(
+            10 * int8, bf16)
+
+    def test_match_adopt_refcount(self):
+        a = PageAllocator(num_pages=16, page_size=4)
+        toks = np.arange(100, 111, dtype=np.int32)       # 11 tokens
+        assert a.ensure("a", toks.size)
+        assert a.register_prefix("a", toks) == 2         # 2 FULL pages only
+        pages, matched = a.match_prefix(toks)
+        assert matched == 8 and pages == a.chain("a")[:2]
+        # a diverging prefix matches only the common full pages
+        other = toks.copy(); other[5] += 1
+        _, m2 = a.match_prefix(other)
+        assert m2 == 4
+        assert a.ensure("b", 10, adopt=pages)
+        assert a.chain("b")[:2] == pages
+        assert all(a.ref_count(p) == 2 for p in pages)
+        assert a.ref_count(a.chain("b")[2]) == 1
+        a.check_consistency()
+        # sharers keep the pages when one holder frees
+        a.free_request("a")
+        assert all(a.ref_count(p) == 1 for p in pages)
+        _, m3 = a.match_prefix(toks)
+        assert m3 == 8                                   # still indexed
+        a.check_consistency()
+        a.free_request("b")
+        assert a.free_pages == a.num_pages - 1           # no leak
+        assert a.match_prefix(toks) == ([], 0)           # index emptied
+        a.check_consistency()
+
+    def test_adoption_all_or_nothing_on_exhaustion(self):
+        a = PageAllocator(num_pages=6, page_size=4)      # 5 usable
+        toks = np.arange(1, 9, dtype=np.int32)
+        assert a.ensure("a", 8)
+        a.register_prefix("a", toks)
+        pages, _ = a.match_prefix(toks)
+        assert a.ensure("x", 4)                          # 1 page
+        assert a.ensure("y", 8)                          # 2 pages -> 0 free
+        # adopting 2 shared + needing 2 fresh must fail atomically
+        assert not a.ensure("b", 16, adopt=pages)
+        assert a.chain("b") == []
+        assert all(a.ref_count(p) == 1 for p in pages)
+        a.check_consistency()
+
+    def test_cow_swaps_writer_only(self):
+        a = PageAllocator(num_pages=16, page_size=4)
+        toks = np.arange(1, 9, dtype=np.int32)
+        assert a.ensure("a", 8)
+        a.register_prefix("a", toks)
+        pages, _ = a.match_prefix(toks)
+        assert a.ensure("b", 9, adopt=pages)             # shares 2, owns 1
+        before_a = a.chain("a")
+        copies = a.make_writable("b", 7, 8)              # page idx 1..2
+        assert len(copies) == 1                          # only idx 1 shared
+        (src, dst), = copies
+        assert src == before_a[1] and a.chain("b")[1] == dst
+        assert a.chain("a") == before_a                  # sharer untouched
+        assert a.ref_count(src) == 1 and a.ref_count(dst) == 1
+        assert a.cow_copies == 1
+        # the index entry stays with the ORIGINAL page
+        p2, m2 = a.match_prefix(toks)
+        assert m2 == 8 and p2 == before_a[:2]
+        a.check_consistency()
+        # exhaustion: all-or-nothing None, nothing changed
+        for i in range(a.free_pages):
+            assert a.ensure(f"f{i}", 4)
+        assert a.ensure("c", 8, adopt=a.match_prefix(toks)[0])
+        assert a.make_writable("c", 0, 7) is None
+        a.check_consistency()
+
+    def test_aliasing_fuzz_with_shared_cow_chains(self):
+        """ISSUE acceptance: the PR-9 aliasing fuzz extended with prefix
+        adoption, registration and copy-on-write — check_consistency()
+        (refcounts == holding chains, free/live partition, index points
+        at live pages) must hold after EVERY op, and a full teardown
+        leaves zero allocated pages."""
+        a = PageAllocator(num_pages=48, page_size=2)
+        rng = np.random.RandomState(7)
+        live: dict[int, np.ndarray] = {}
+        corpus = [rng.randint(1, 9, 12).astype(np.int32) for _ in range(4)]
+        for step in range(400):
+            rid = int(rng.randint(10))
+            op = rng.rand()
+            if rid in live and op < 0.25:
+                a.free_request(rid)
+                del live[rid]
+            elif rid not in live:
+                base = corpus[rng.randint(len(corpus))]
+                n = int(rng.randint(2, base.size + 1))
+                toks = base[:n].copy()
+                if rng.rand() < 0.3:
+                    toks[-1] = rng.randint(1, 9)         # diverge the tail
+                pages, matched = a.match_prefix(toks)
+                if a.ensure(rid, toks.size, adopt=pages or None):
+                    live[rid] = toks
+                    a.register_prefix(rid, toks)
+            else:
+                toks = live[rid]
+                if rng.rand() < 0.5:
+                    grown = np.concatenate(
+                        [toks, rng.randint(1, 9, 2).astype(np.int32)])
+                    if a.ensure(rid, grown.size):
+                        live[rid] = grown
+                else:
+                    a.make_writable(rid, max(toks.size - 2, 0),
+                                    toks.size - 1)
+            a.check_consistency()
+        for rid in list(live):
+            a.free_request(rid)
+        a.check_consistency()
+        assert a.free_pages == a.num_pages - 1           # no page leaked
+
+
+class TestSharedChainEviction:
+    def test_evict_shared_chain_requeues_without_freeing_sharers(self):
+        """Satellite: evicting a request whose chain holds SHARED pages
+        re-queues it (front, WAITING) while every sharer keeps its pages
+        — only the victim's exclusive refs return to the free list."""
+        a = PageAllocator(num_pages=10, page_size=4)     # 9 usable
+        s = ContinuousBatchingScheduler(a, max_batch=4, max_seq_len=64,
+                                        prefix_sharing=True)
+        toks = np.arange(1, 9, dtype=np.int32)           # 2 full pages
+        holder = Request(prompt=toks, max_new_tokens=4)
+        victim = Request(prompt=toks, max_new_tokens=4)
+        s.submit(holder); s.submit(victim)
+        admitted = s.admissions(limit=1)
+        assert admitted == [holder]
+        a.register_prefix(holder.rid, toks)              # engine's step
+        s.activate(holder)
+        admitted = s.admissions(limit=1)
+        assert admitted == [victim] and victim.matched_tokens == 8
+        s.activate(victim)
+        shared = a.chain(holder.rid)[:2]
+        assert a.chain(victim.rid)[:2] == shared
+        free_before = a.free_pages
+        # exhaust the pool so grow() must evict the YOUNGEST (the sharer)
+        assert a.ensure("hog", 4 * free_before)
+        holder.generated = [1]                           # forces growth
+        evicted = s.grow()
+        assert evicted == [victim]
+        assert victim.state == RequestState.WAITING
+        assert s.waiting[0] is victim and victim.matched_tokens == 0
+        # the sharers' pages survived the eviction
+        assert a.chain(holder.rid)[:2] == shared
+        assert all(a.ref_count(p) == 1 for p in shared)
+        a.check_consistency()
+
+
+# ---------------------------------------------------------------------------
+# PR 12: speculative decoding (engine level; reference decode path)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def spec_shared(shared):
+    """ONE speculative engine (K=3, sharing on) over the module's shared
+    model — extra verify windows compile on demand and are cached per K."""
+    m, cfg, _ = shared
+    return m, cfg, _engine(m, spec_k=3, prefix_sharing=True)
+
+
+def _aligned(*engines, seq=1000):
+    """Pin the per-engine submission counters so PRNG key streams match
+    across engines (keys are keyed by submission ORDER)."""
+    for e in engines:
+        e._submit_seq = seq
+
+
+class TestSpeculativeDecoding:
+    def test_greedy_stream_bit_equal_and_multi_token_steps(self, shared,
+                                                           spec_shared):
+        """ISSUE acceptance: greedy streams with speculation + prefix
+        sharing ON are bit-equal to the PR-9 plain-decode engine, while
+        committing > 1 token per dispatch."""
+        m, cfg, base = shared
+        _, _, spec = spec_shared
+        rng = np.random.RandomState(11)
+        sysp = rng.randint(1, cfg.vocab_size, 12).astype(np.int32)
+        prompts = [np.concatenate([sysp, t]) for t in
+                   _prompts(rng, cfg, (3, 6, 5))]
+        ref = base.generate(prompts, max_new_tokens=12)
+        spec.reset_stats()
+        out = spec.generate(prompts, max_new_tokens=12)
+        assert out == ref
+        assert spec.accepted_tokens_per_step > 1.0
+        assert spec.prefix_hit_rate > 0.0                # sysp pages shared
+        spec.allocator.check_consistency()
+        assert spec.allocator.free_pages == spec.allocator.num_pages - 1
+
+    def test_temperature_stream_bit_equal(self, shared, spec_shared):
+        """Sampled (temp/top-k/top-p) streams are bit-equal too: the
+        verify frame draws position i with the KEY plain decode would
+        hold after i commits, and acceptance == sampled-token equality."""
+        m, cfg, base = shared
+        _, _, spec = spec_shared
+        rng = np.random.RandomState(12)
+        prompts = _prompts(rng, cfg, (5, 9, 7))
+        _aligned(base, spec)
+        ref = base.generate(prompts, max_new_tokens=10, temperature=0.8,
+                            top_k=24, top_p=0.9)
+        out = spec.generate(prompts, max_new_tokens=10, temperature=0.8,
+                            top_k=24, top_p=0.9)
+        assert out == ref
+
+    def test_k1_degenerate_matches_plain_decode(self, shared, spec_shared):
+        """ISSUE acceptance: K=1 (one draft + bonus) reproduces the PR-9
+        stream exactly and never over-commits past the budget."""
+        m, cfg, base = shared
+        _, _, spec = spec_shared
+        rng = np.random.RandomState(13)
+        prompts = _prompts(rng, cfg, (4, 8))
+        ref = base.generate(prompts, max_new_tokens=9)
+        spec.configure_speculation(spec_k=1)
+        try:
+            out = spec.generate(prompts, max_new_tokens=9)
+        finally:
+            spec.configure_speculation(spec_k=3)
+        assert out == ref
+        assert all(len(o) == 9 for o in out)
+
+    def test_zero_retraces_across_k(self, shared, spec_shared):
+        """ISSUE acceptance: after each verify window compiles once,
+        stepping ANY warmed K (and toggling between them) never
+        retraces — per-request windows ride the signature as arrays."""
+        m, cfg, spec = spec_shared
+        rng = np.random.RandomState(14)
+        for k in (2, 3):                                 # warm both
+            spec.configure_speculation(spec_k=k)
+            spec.generate(_prompts(rng, cfg, (5,)), max_new_tokens=6)
+        spec.mark_warmup()
+        for k in (3, 2, 3):
+            spec.configure_speculation(spec_k=k)
+            spec.generate(_prompts(rng, cfg, (6, 4)), max_new_tokens=8,
+                          temperature=0.7)
+        assert spec.decode_retraces_after_warmup == 0
+        spec.configure_speculation(spec_k=3)
+
+    def test_toggle_spec_on_mid_flight_reseeds_proposer(self, shared,
+                                                        spec_shared):
+        """Turning speculation ON while requests are live must reseed the
+        proposer from each committed stream (plain decode neither seeds
+        nor feeds it): the continued stream stays bit-equal and the live
+        request drafts from real tables, not missing state."""
+        m, cfg, base = shared
+        _, _, spec = spec_shared
+        rng = np.random.RandomState(15)
+        prompt = _prompts(rng, cfg, (7,))[0]
+        ref = base.generate([prompt], max_new_tokens=12)[0]
+        spec.configure_speculation(spec_k=0)
+        try:
+            rid = spec.submit(prompt, max_new_tokens=12)
+            for _ in range(4):                   # plain-decode opening
+                spec.step()
+            assert rid not in spec._proposer._state
+            spec.configure_speculation(spec_k=3)
+            assert rid in spec._proposer._state  # reseeded mid-flight
+            spec.run_until_idle()
+        finally:
+            spec.configure_speculation(spec_k=3)
+        out = list(spec.scheduler.get(rid).generated)
+        spec.release(rid)
+        assert out == ref
+        spec.allocator.check_consistency()
+
+    def test_cow_write_leaves_sharer_bytes_identical(self, shared):
+        """ISSUE acceptance: a full-prefix admission adopts every page;
+        its first decode rewrite triggers copy-on-write, and the
+        sharer's pages are BYTE-identical afterwards."""
+        m, cfg, _ = shared
+        eng = _engine(m, spec_k=2, prefix_sharing=True)
+        rng = np.random.RandomState(15)
+        prompt = rng.randint(1, cfg.vocab_size, 16).astype(np.int32)
+        # A outlives B (large budget) so its chain still holds the shared
+        # pages while B copy-on-writes
+        ra = eng.submit(prompt, max_new_tokens=40)
+        eng.step()                                       # admit+prefill A
+        a_pages = eng.allocator.chain(ra)[:4]
+        ck_before = np.asarray(eng._ck[:, :, a_pages])
+        cv_before = np.asarray(eng._cv[:, :, a_pages])
+        rb = eng.submit(prompt, max_new_tokens=8)        # full 4-page match
+        req_b = eng.scheduler.get(rb)
+        while not req_b.finished:
+            eng.step()
+        assert req_b.matched_tokens == 16                # prefill skipped
+        assert eng.allocator.cow_copies >= 1
+        assert eng.allocator.chain(ra)[:4] == a_pages    # A untouched
+        np.testing.assert_array_equal(
+            np.asarray(eng._ck[:, :, a_pages]), ck_before)
+        np.testing.assert_array_equal(
+            np.asarray(eng._cv[:, :, a_pages]), cv_before)
+        eng.cancel(ra)
+        eng.allocator.check_consistency()
+        # B's stream equals A's prefix (same prompt, greedy; A had the
+        # larger budget so it is the longer stream)
+        req_a = eng.scheduler.get(ra)
+        assert req_a.generated[:len(req_b.generated)] == req_b.generated
+
+    def test_verify_mismatch_chaos_degrades_to_plain_decode(self, shared,
+                                                            spec_shared):
+        """Satellite: the serving.spec.verify_mismatch fault point forces
+        FULL rejection every step — the engine must degrade to one
+        committed token per dispatch with the exact same stream, not
+        wedge."""
+        from paddle_tpu.distributed.resilience import faults
+
+        m, cfg, base = shared
+        _, _, spec = spec_shared
+        rng = np.random.RandomState(16)
+        prompts = _prompts(rng, cfg, (5, 7))
+        ref = base.generate(prompts, max_new_tokens=8)
+        spec.reset_stats()
+        faults.arm("serving.spec.verify_mismatch", mode="always")
+        try:
+            out = spec.generate(prompts, max_new_tokens=8)
+        finally:
+            faults.disarm("serving.spec.verify_mismatch")
+        assert out == ref
+        assert faults.fired("serving.spec.verify_mismatch") > 0
+        assert spec.accepted_tokens_per_step == 1.0      # plain decode rate
+
+    def test_prefix_skip_prefill_and_stats(self, shared):
+        """A second same-prompt admission adopts the registered pages:
+        prefill runs zero tail chunks, the hit rate reflects it, and
+        stats() carries the PR-12 fields the router/bench consume."""
+        m, cfg, _ = shared
+        eng = _engine(m, spec_k=0, prefix_sharing=True)
+        rng = np.random.RandomState(17)
+        prompt = rng.randint(1, cfg.vocab_size, 16).astype(np.int32)
+        eng.generate([prompt], max_new_tokens=4)
+        # second request arrives while nothing shares -> index emptied on
+        # release, so submit BOTH to overlap
+        eng.reset_stats()
+        o = eng.generate([prompt, prompt], max_new_tokens=4)
+        assert o[0] == o[1]
+        assert eng.prefix_hit_rate >= 0.4                # 16 of 32+ tokens
+        st = eng.stats()
+        for key in ("accepted_tokens_per_step", "prefix_hit_rate",
+                    "cow_copies", "spec_k", "draft_ms_total"):
+            assert key in st
+        eng.allocator.check_consistency()
+
+
+class TestSpeculativeInterpretKernel:
+    """ISSUE acceptance: speculative streams bit-equal to plain decode ON
+    THE INTERPRET KERNEL PATH (the exact TPU decode/verify kernel — the
+    paged_interpret fixture pins it; prefill keeps the engine's normal
+    dispatch), fp32 + bf16 GQA. Small engines bound the interpret grid."""
+
+    def _run(self, dtype, kv_heads, paged_on):
+        m, cfg = _model(num_key_value_heads=kv_heads)
+        if dtype == "bfloat16":
+            m.to(dtype="bfloat16")
+        kw = dict(page_size=4, num_pages=24, decode_batch=2,
+                  prefill_chunk=8, max_seq_len=16)
+        rng = np.random.RandomState(21)
+        prompts = _prompts(rng, cfg, (5, 7))
+        base = ServingEngine(m, ServingConfig(**kw, spec_k=0,
+                                              prefix_sharing=False))
+        spec = ServingEngine(m, ServingConfig(**kw, spec_k=2,
+                                              prefix_sharing=True))
+        _aligned(base, spec)
+        ref = base.generate(prompts, max_new_tokens=5, temperature=0.5,
+                            top_k=16)
+        out = spec.generate(prompts, max_new_tokens=5, temperature=0.5,
+                            top_k=16)
+        assert out == ref
+        assert spec.decode_traces >= 1
+
+    def test_fp32(self, paged_interpret):
+        self._run("float32", 4, True)
+
+    @pytest.mark.slow
+    def test_bf16_gqa(self, paged_interpret):
+        self._run("bfloat16", 2, True)
