@@ -57,6 +57,7 @@ from paddle_tpu.regularizer import L1Decay, L2Decay  # noqa: F401
 from paddle_tpu import metric  # noqa: F401
 from paddle_tpu import nn  # noqa: F401
 from paddle_tpu import optimizer  # noqa: F401
+from paddle_tpu import observability  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import static  # noqa: F401
 from paddle_tpu import utils  # noqa: F401

@@ -122,6 +122,12 @@ define_flag("metrics_sync_every", 1,
             "read the loss to host every k steps (1 = every step, the "
             "synchronous default; larger k keeps JAX async dispatch "
             "unbroken between reads)", type=int)
+define_flag("step_telemetry", False,
+            "honest per-step training telemetry: the compiled step returns "
+            "a small metrics side-pytree (fp32 loss, global grad-norm, "
+            "found_inf/skip flag, fp8 amax watermark) settled lazily on "
+            "the host — docs/observability.md; consulted when "
+            "CompiledTrainStep(collect_metrics=None)")
 define_flag("zero3_gather", "ahead",
             "ZeRO-3 sharded-weights gather schedule in the scan layer loop: "
             "'ahead' = double-buffered gather of layer k+1 while layer k "

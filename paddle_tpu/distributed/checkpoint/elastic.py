@@ -560,10 +560,21 @@ class CheckpointManager:
                 "re-entrant checkpoint save on the same thread (signal "
                 "handler during a sync save?) — the in-progress save "
                 "already covers this state")
+        from paddle_tpu.observability import events as _events
+        from paddle_tpu.observability import tracing as _tracing
+
         with self._write_lock:
             self._write_tls.writing = True
             try:
-                return self._write_snapshot_locked(snapshot)
+                # the "checkpoint commit" phase span of the training-step
+                # timeline (docs/observability.md) — the writer thread's
+                # work lands on the same exported trace as the train loop
+                with _tracing.span("ckpt.commit", component="ckpt",
+                                   step=int(snapshot.step)):
+                    out = self._write_snapshot_locked(snapshot)
+                _events.emit("ckpt", "commit", step=int(snapshot.step),
+                             root=self.root)
+                return out
             finally:
                 self._write_tls.writing = False
 

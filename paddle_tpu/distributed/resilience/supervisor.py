@@ -91,6 +91,20 @@ class IncidentLog:
         if self._f is not None:
             self._f.write(json.dumps(rec, default=str) + "\n")
             self._f.flush()
+        # every incident also lands in the unified event journal
+        # (paddle_tpu.observability.events — ONE schema across resilience
+        # and serving, docs/observability.md), with a severity mapped from
+        # the event class
+        from paddle_tpu.observability import events as _events
+
+        severity = ("error" if event in ("halt", "hang", "ckpt_save_failed")
+                    else "warn" if event in ("anomaly", "rollback",
+                                             "quarantine", "feeder_crash",
+                                             "feeder_retry", "restart")
+                    else "info")
+        _events.emit("resilience", event, severity=severity,
+                     **{k: v for k, v in fields.items()
+                        if k not in ("ts", "component", "severity")})
         return rec
 
     def close(self):

@@ -1,5 +1,8 @@
+from paddle_tpu.hapi.callbacks import (  # noqa: F401
+    Callback, MetricsCallback,
+)
 from paddle_tpu.hapi.model import (  # noqa: F401
-    Callback, EarlyStopping, LRScheduler, Model, ModelCheckpoint,
+    AutoCheckpoint, EarlyStopping, LRScheduler, Model, ModelCheckpoint,
     ProgBarLogger, ReduceLROnPlateau,
 )
 from paddle_tpu.utils.log_writer import VisualDLCallback  # noqa: F401

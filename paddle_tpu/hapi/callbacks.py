@@ -1,5 +1,183 @@
-from paddle_tpu.hapi.model import (  # noqa: F401
-    AutoCheckpoint, Callback, EarlyStopping, LRScheduler, ModelCheckpoint,
-    ProgBarLogger, ReduceLROnPlateau,
-)
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py).
+
+This module owns the REAL `Callback` base (the full hook protocol
+`Model.fit`/`evaluate` drive) and the observability-plane callback
+(`MetricsCallback`); the concrete training-loop callbacks that are
+coupled to Model internals (ProgBarLogger, ModelCheckpoint,
+AutoCheckpoint, EarlyStopping, LRScheduler, ReduceLROnPlateau) live in
+`hapi.model` and re-export from here lazily, so
+``from paddle_tpu.hapi.callbacks import ModelCheckpoint`` works without
+an import cycle.
+"""
+from __future__ import annotations
+
+import time
+
 from paddle_tpu.utils.log_writer import VisualDLCallback  # noqa: F401
+
+__all__ = [
+    "Callback", "MetricsCallback", "VisualDLCallback",
+    # lazily re-exported from hapi.model (see __getattr__)
+    "ProgBarLogger", "ModelCheckpoint", "AutoCheckpoint", "EarlyStopping",
+    "LRScheduler", "ReduceLROnPlateau",
+]
+
+
+class Callback:
+    """The hapi callback protocol: every hook `Model.fit`/`evaluate` calls,
+    as no-ops. Subclass and override what you need; `self.model` (the hapi
+    Model) and `self.params` ({"steps", "epochs", "verbose"}) are set
+    before `on_train_begin`."""
+
+    model = None
+    params = None
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class MetricsCallback(Callback):
+    """Stream honest per-step training telemetry into the unified
+    observability plane (docs/observability.md):
+
+    * per batch: numeric fit logs PLUS the compiled step's settled
+      metrics side-pytree (loss / global grad-norm / skip flag / fp8
+      amax — `CompiledTrainStep(collect_metrics=True)`, enabled via the
+      `step_telemetry` flag or per-step kwarg) land as
+
+        - `train/<key>` scalars in a `utils.LogWriter` JSONL run dir
+          (when `logdir` is given), and
+        - `train_<key>` gauges + a `train_steps_total` counter in the
+          metrics registry (scraped by ``GET /metrics``);
+
+    * on_train_end: mean host step time, steps/sec, and — when
+      `peak_flops_per_s` is given and the dist path ran — an **MFU gauge
+      derived from ``compiled.cost_analysis()`` FLOPs** (`train_mfu`),
+      not a hand-counted formula. The cost-analysis lowering is a one-off
+      OFF the training loop.
+    """
+
+    def __init__(self, logdir=None, registry=None, peak_flops_per_s=None,
+                 tag_prefix="train"):
+        from paddle_tpu.observability import metrics as _metrics
+
+        self.registry = registry if registry is not None \
+            else _metrics.registry()
+        self.prefix = tag_prefix
+        self.peak_flops_per_s = peak_flops_per_s
+        self.writer = None
+        if logdir is not None:
+            from paddle_tpu.utils.log_writer import LogWriter
+
+            self.writer = LogWriter(logdir)
+        self._global_step = 0
+        self._t0 = None
+        self._steps_at_t0 = 0
+        self.last = {}
+
+    def _step_obj(self):
+        dm = getattr(self.model, "_dist_model", None)
+        return getattr(dm, "_step", None) if dm is not None else None
+
+    def _record(self, key: str, value: float):
+        self.last[key] = value
+        self.registry.gauge(
+            f"{self.prefix}_{key}",
+            f"latest per-step training telemetry: {key}").set(value)
+        if self.writer is not None:
+            self.writer.add_scalar(f"{self.prefix}/{key}", value,
+                                   self._global_step)
+
+    def on_train_begin(self, logs=None):
+        self._t0 = time.perf_counter()
+        self._steps_at_t0 = self._global_step
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        self.registry.counter(
+            f"{self.prefix}_steps_total", "training batches completed").inc()
+        vals = {}
+        for k, v in (logs or {}).items():
+            v = v[0] if isinstance(v, (list, tuple)) else v
+            if isinstance(v, (int, float)):
+                vals[k] = float(v)
+        st = self._step_obj()
+        if st is not None and getattr(st, "collects_metrics", False):
+            md = st.last_metrics()
+            if md:
+                # telemetry wins over the fit-loop logs on key collisions
+                # (e.g. "loss"): it is the in-program value, and recording
+                # both would double every series point
+                vals.update({k: float(v) for k, v in md.items()
+                             if k != "step"})
+        for k, v in vals.items():
+            self._record(k, v)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.writer is not None:
+            self.writer.flush()
+
+    def on_train_end(self, logs=None):
+        st = self._step_obj()
+        if st is not None:
+            st.drain()   # settle the run-ahead tail before the summary
+        steps = self._global_step - self._steps_at_t0
+        dt = max(time.perf_counter() - (self._t0 or time.perf_counter()),
+                 1e-9)
+        if steps > 0:
+            self._record("steps_per_sec", steps / dt)
+            self._record("host_step_ms_mean", dt / steps * 1e3)
+        if (self.peak_flops_per_s and st is not None and steps > 0):
+            try:
+                flops = st.flops_per_step()
+            except RuntimeError:
+                flops = 0.0
+            if flops > 0:
+                # MFU from XLA's OWN cost model of the compiled step — the
+                # honest numerator (hand formulas drift as the program
+                # changes; cost_analysis is derived FROM the program)
+                self._record(
+                    "mfu", flops * (steps / dt) / float(self.peak_flops_per_s))
+        if self.writer is not None:
+            self.writer.close()
+
+
+_MODEL_EXPORTS = ("ProgBarLogger", "ModelCheckpoint", "AutoCheckpoint",
+                  "EarlyStopping", "LRScheduler", "ReduceLROnPlateau")
+
+
+def __getattr__(name):
+    # the concrete loop callbacks live in hapi.model (they reach into
+    # Model/DistModel internals); lazy re-export avoids the import cycle
+    if name in _MODEL_EXPORTS:
+        from paddle_tpu.hapi import model as _model
+
+        return getattr(_model, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -9,43 +9,14 @@ import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
+# the Callback BASE lives in hapi.callbacks (the protocol home); the
+# concrete loop callbacks below re-export from there lazily
+from paddle_tpu.hapi.callbacks import Callback
 from paddle_tpu.io import DataLoader, Dataset
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
            "AutoCheckpoint", "EarlyStopping", "LRScheduler",
            "ReduceLROnPlateau"]
-
-
-class Callback:
-    def set_params(self, params):
-        self.params = params
-
-    def set_model(self, model):
-        self.model = model
-
-    def on_train_begin(self, logs=None):
-        pass
-
-    def on_train_end(self, logs=None):
-        pass
-
-    def on_epoch_begin(self, epoch, logs=None):
-        pass
-
-    def on_epoch_end(self, epoch, logs=None):
-        pass
-
-    def on_train_batch_begin(self, step, logs=None):
-        pass
-
-    def on_train_batch_end(self, step, logs=None):
-        pass
-
-    def on_eval_begin(self, logs=None):
-        pass
-
-    def on_eval_end(self, logs=None):
-        pass
 
 
 class ProgBarLogger(Callback):

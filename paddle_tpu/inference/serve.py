@@ -152,7 +152,8 @@ def build_http_server(port: int, run_fn=None, generate_fn=None, *,
                       timeout_s: float = DEFAULT_TIMEOUT_S,
                       max_body_bytes: int = DEFAULT_MAX_BODY_MB << 20,
                       host: str = "127.0.0.1",
-                      admit_fn=None, health_fn=None, stats_fn=None):
+                      admit_fn=None, health_fn=None, stats_fn=None,
+                      metrics_fn=None):
     """The serving HTTP front-end, dependency-injected so this module stays
     frontend-free (it imports no paddle_tpu):
 
@@ -171,6 +172,11 @@ def build_http_server(port: int, run_fn=None, generate_fn=None, *,
                           never need a generate call. GETs bypass the
                           bounded POST queue: a saturated engine must still
                           answer its probes, that's the whole point.
+      * GET /metrics   -> metrics_fn() string served as Prometheus text
+                          exposition (format 0.0.4) — the observability
+                          plane's scrape endpoint
+                          (paddle_tpu.observability.metrics). Same
+                          queue-bypass rule as the other probes.
 
     ``admit_fn(payload) -> None | dict`` is consulted BEFORE the 200 of a
     /generate: returning ``{"status": 503, "retry_after": 1.0, "message":
@@ -243,6 +249,15 @@ def build_http_server(port: int, run_fn=None, generate_fn=None, *,
                     self._json_reply(h, 200 if h.get("ok", True) else 503)
                 elif self.path == "/stats" and stats_fn is not None:
                     self._json_reply(dict(stats_fn()))
+                elif self.path == "/metrics" and metrics_fn is not None:
+                    data = str(metrics_fn()).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                 else:
                     self.send_error(404)
             except Exception as e:

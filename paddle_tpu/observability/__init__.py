@@ -1,0 +1,38 @@
+"""paddle_tpu.observability — the unified observability plane.
+
+One instrument for every subsystem (docs/observability.md):
+
+* `metrics`  — process-wide registry of labeled counters/gauges/
+  histograms with lock-striped updates, scrape-time collectors,
+  snapshot(), Prometheus text exposition (served as ``GET /metrics`` on
+  the serve.py chassis) and JSONL export through `utils.LogWriter`.
+* `tracing`  — cross-component spans carrying a trace id that propagates
+  router -> replica -> engine -> scheduler -> decode step and training-
+  step phase spans, exported (merged with optional `jax.profiler` device
+  traces) as one Chrome/Perfetto file.
+* `events`   — the structured event journal: one schema for resilience/
+  serving lifecycle events (rollback, quarantine, failover, breaker
+  transitions, page eviction, drain), ring-buffered + optional JSONL.
+
+Training-side honest telemetry (per-step loss / grad-norm / skip flags /
+fp8 amax, MFU from ``compiled.cost_analysis()`` FLOPs) lives on
+`parallel.CompiledTrainStep(collect_metrics=True)` and streams through
+`hapi.MetricsCallback` into all three surfaces.
+"""
+from paddle_tpu.observability import events, metrics, tracing  # noqa: F401
+from paddle_tpu.observability.events import EventJournal, journal
+from paddle_tpu.observability.metrics import (Counter, Gauge, Histogram,
+                                              MetricsRegistry, registry)
+from paddle_tpu.observability.tracing import (current_trace_id,
+                                              export_chrome, new_trace_id,
+                                              span, start_tracing,
+                                              stop_tracing, trace_context,
+                                              tracing_active)
+
+__all__ = [
+    "metrics", "tracing", "events",
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "registry",
+    "span", "start_tracing", "stop_tracing", "tracing_active",
+    "trace_context", "current_trace_id", "new_trace_id", "export_chrome",
+    "EventJournal", "journal",
+]

@@ -64,6 +64,19 @@ def _nan_poison(vals):
     return vals, False
 
 
+def _abstractify(x):
+    """ShapeDtypeStruct mirror of one step argument leaf (sharding kept
+    when present) — concrete arrays are donated per step, so the abstract
+    mirror is what `CompiledTrainStep.cost_analysis()` lowers against."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        sh = getattr(x, "sharding", None)
+        try:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+        except TypeError:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return x
+
+
 def _innermost_opt(opt):
     """Walk wrapper chains (HybridParallelOptimizer etc.) to the optimizer
     whose _state/_step_count feed state_dict()."""
@@ -391,6 +404,16 @@ class CompiledTrainStep:
       host-side loss spikes (rolling median+MAD) and records/escalates per
       its policy; the resilience supervisor or Model.fit(resilience=) act
       on the escalations.
+    collect_metrics: honest per-step telemetry (docs/observability.md):
+      the step additionally returns a small metrics side-pytree — fp32
+      loss, GLOBAL grad-norm (post-unscale), the found_inf/skip flag, and
+      (with fp8) the amax watermark — as replicated device scalars that
+      settle lazily on the host (`last_metrics()`, `settle_metrics()`);
+      run-ahead is never broken by collection, and the output structure is
+      stable so enabling it costs ONE compile, zero retraces. None reads
+      the `step_telemetry` flag. `cost_analysis()`/`flops_per_step()`
+      expose XLA's own cost model for the compiled step (what MFU gauges
+      derive from).
     scan_layers: stack the model's `scan_group()` layer parameters along a
       leading layer axis OUTSIDE the program and run the stack as one
       `lax.scan` — HLO size and compile time become O(1) in depth. None reads
@@ -408,7 +431,7 @@ class CompiledTrainStep:
                  dispatch_window: int | None = None,
                  zero3_gather: str | None = None,
                  fp8_policy: str | None = None, grad_scaler=None,
-                 anomaly_detector=None):
+                 anomaly_detector=None, collect_metrics: bool | None = None):
         from paddle_tpu.amp.fp8 import normalize_fp8_policy
         from paddle_tpu.core.flags import flag
         from paddle_tpu.io.device_feed import DispatchWindow
@@ -453,6 +476,22 @@ class CompiledTrainStep:
             # non-finite STREAK the scaler can't break is a real anomaly
             self._anomaly_det.nonfinite_tolerance = 2
         self._pending_health: list = []
+        # honest step telemetry (docs/observability.md): the step returns a
+        # metrics side-pytree; settled LAZILY like the health scalar, so
+        # collection never breaks step_async run-ahead. None reads the
+        # step_telemetry flag.
+        self._telemetry = bool(flag("step_telemetry")
+                               if collect_metrics is None
+                               else collect_metrics)
+        # layout of the packed per-step metrics vector (one readback/step)
+        self._metric_keys = (["loss", "grad_norm", "skipped"]
+                             + (["fp8_amax_max"]
+                                if self.fp8_policy != "none" else []))
+        self._pending_metrics: list = []
+        self._last_metrics: dict | None = None
+        self._prev_metric_wall: float | None = None
+        self._abstract_args = None       # captured on the first dispatch
+        self._cost_analysis_cache = None
         self._layer_capable = bool(getattr(model, "layer_remat_capable", False))
         if scan_layers is None:
             scan_layers = bool(flag("scan_layers"))
@@ -805,6 +844,28 @@ class CompiledTrainStep:
                 lambda old, new: jnp.where(found_inf, old, new),
                 fp8_in, list(new_fp8))
 
+        step_metrics = None
+        if self._telemetry:
+            # the honest per-step side output: tiny fp32 scalars riding the
+            # program's outputs (no second dispatch, no host sync — readers
+            # settle them lazily via settle_metrics), PACKED into one
+            # [len(metric_keys)] vector so the host pays a single readback
+            # per step, not one per metric. grad_norm is the GLOBAL norm
+            # over every trainable leaf, post-unscale.
+            gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                              for g in grads))
+            parts = [
+                loss.astype(jnp.float32),
+                gn,
+                (found_inf.astype(jnp.float32) if found_inf is not None
+                 else jnp.zeros((), jnp.float32)),
+            ]
+            if fp8_on:
+                leaves = jax.tree_util.tree_leaves(new_fp8)
+                parts.append(
+                    jnp.max(jnp.stack([jnp.max(l) for l in leaves]))
+                    if leaves else jnp.zeros((), jnp.float32))
+            step_metrics = jnp.stack(parts)
         new_params = list(param_vals)
         new_states = list(opt_states) if opt_states is not None else None
         if self.optimizer is not None:
@@ -849,7 +910,12 @@ class CompiledTrainStep:
         if fp8_on or scaling or self._anomaly:
             flag_out = (found_inf.astype(jnp.float32) if found_inf is not None
                         else jnp.zeros((), jnp.float32))
+            if step_metrics is not None:
+                return (loss, new_params, new_states, list(new_fp8),
+                        flag_out, step_metrics)
             return loss, new_params, new_states, list(new_fp8), flag_out
+        if step_metrics is not None:
+            return loss, new_params, new_states, step_metrics
         return loss, new_params, new_states
 
     def _build(self):
@@ -860,23 +926,32 @@ class CompiledTrainStep:
             pshard = [NamedSharding(mesh, s) for s in self._param_specs]
             sshard = self._state_shardings
             repl = NamedSharding(mesh, PartitionSpec())
+            # the telemetry side output is ONE packed fp32 vector — always
+            # replicated (its layout is static per configuration)
+            mshard = repl if self._telemetry else None
             if extended:
                 # amax histories are tiny ([H] / [L, H]) — they ride
                 # replicated next to their (possibly sharded) stack column
                 fshard = jax.tree_util.tree_map(
                     lambda _: repl, self._fp8_states or [])
+                outs = (repl, pshard, sshard, fshard, repl)
+                if mshard is not None:
+                    outs = outs + (mshard,)
                 self._jitted = jax.jit(
                     self._step_fn,
                     in_shardings=(pshard, sshard, None, None, None, None,
                                   fshard, None),
-                    out_shardings=(repl, pshard, sshard, fshard, repl),
+                    out_shardings=outs,
                     donate_argnums=(0, 1, 6) if self._donate else (),
                 )
             else:
+                outs = (repl, pshard, sshard)
+                if mshard is not None:
+                    outs = outs + (mshard,)
                 self._jitted = jax.jit(
                     self._step_fn,
                     in_shardings=(pshard, sshard, None, None, None, None),
-                    out_shardings=(repl, pshard, sshard),
+                    out_shardings=outs,
                     donate_argnums=(0, 1) if self._donate else (),
                 )
         else:
@@ -930,18 +1005,34 @@ class CompiledTrainStep:
                 lr = jnp.asarray(float("nan"), jnp.float32)
         extended = (self.fp8_policy != "none" or self._scaler is not None
                     or self._anomaly)
-        with RecordEvent("CompiledTrainStep::dispatch"):
+        with RecordEvent("CompiledTrainStep::dispatch",
+                         attrs={"step": self._step_i}):
             if extended:
                 scale_arr = jnp.asarray(
                     self._scaler._scale if self._scaler is not None else 1.0,
                     jnp.float32)
+                args = (self._param_vals, self._opt_states, vals, sub, lr,
+                        jnp.asarray(self._step_i, jnp.int32),
+                        self._fp8_states if self._fp8_states is not None
+                        else [],
+                        scale_arr)
+            else:
+                args = (self._param_vals, self._opt_states, vals, sub, lr,
+                        jnp.asarray(self._step_i, jnp.int32))
+            if self._abstract_args is None:
+                # abstract (shape, dtype, sharding) mirror of the step's
+                # arguments — what cost_analysis() lowers against later
+                # (the concrete arrays are about to be donated)
+                self._abstract_args = jax.tree_util.tree_map(
+                    _abstractify, args)
+            outs = self._jitted(*args)
+            step_metrics = None
+            if self._telemetry:
+                step_metrics = outs[-1]
+                outs = outs[:-1]
+            if extended:
                 (loss, self._param_vals, self._opt_states, new_fp8,
-                 found) = self._jitted(
-                    self._param_vals, self._opt_states, vals, sub, lr,
-                    jnp.asarray(self._step_i, jnp.int32),
-                    self._fp8_states if self._fp8_states is not None else [],
-                    scale_arr,
-                )
+                 found) = outs
                 if self.fp8_policy != "none":
                     self._fp8_states = new_fp8
                 if self._scaler is not None:
@@ -957,10 +1048,16 @@ class CompiledTrainStep:
                     self._pending_health.append((self._step_i, loss, found))
                     self.settle_anomalies(block=False)
             else:
-                loss, self._param_vals, self._opt_states = self._jitted(
-                    self._param_vals, self._opt_states, vals, sub, lr,
-                    jnp.asarray(self._step_i, jnp.int32),
-                )
+                loss, self._param_vals, self._opt_states = outs
+            if step_metrics is not None:
+                # same lazy contract as health/found_inf: the dict's device
+                # scalars settle once ready (drain() settles all); the wall
+                # time stamps host-side dispatch pacing
+                import time as _time
+
+                self._pending_metrics.append(
+                    (self._step_i, step_metrics, _time.perf_counter()))
+                self.settle_metrics(block=False)
         # bounded run-ahead: block on the loss of step N-window before
         # returning, so at most `window` compiled steps are queued on-device
         self._window.admit(loss)
@@ -987,13 +1084,76 @@ class CompiledTrainStep:
 
     def drain(self):
         """Block until every dispatched step has executed (and, with a
-        grad_scaler / anomaly detector, fold every outstanding found_inf
-        and health flag into them)."""
+        grad_scaler / anomaly detector / telemetry, fold every outstanding
+        found_inf, health flag and metrics pytree into their consumers)."""
         self._window.drain()
         if self._scaler is not None:
             self._settle_scaler(block=True)
         if self._anomaly:
             self.settle_anomalies(block=True)
+        if self._telemetry:
+            self.settle_metrics(block=True)
+
+    # -- honest step telemetry (docs/observability.md) -----------------------
+    def settle_metrics(self, block: bool = False):
+        """Fold finished steps' metrics side-pytrees into `last_metrics`,
+        in dispatch order. block=False only consumes values whose buffers
+        are already ready — the non-blocking path runs after every
+        dispatch, so step_async run-ahead is never broken by telemetry."""
+        while self._pending_metrics:
+            step_i, md, wall = self._pending_metrics[0]
+            if not block:
+                ready = getattr(md, "is_ready", None)
+                if ready is not None and not ready():
+                    break
+            self._pending_metrics.pop(0)
+            vals = np.asarray(md)  # ONE readback for the whole vector
+            rec = dict(zip(self._metric_keys, (float(v) for v in vals)))
+            rec["step"] = step_i
+            # host-side pacing: wall time between consecutive dispatches
+            # (the end-to-end step time a training loop actually feels,
+            # input pipeline included — distinct from device step time)
+            if self._prev_metric_wall is not None:
+                rec["host_step_ms"] = round(
+                    (wall - self._prev_metric_wall) * 1e3, 3)
+            self._prev_metric_wall = wall
+            self._last_metrics = rec
+
+    def last_metrics(self) -> dict | None:
+        """The most recent SETTLED step's telemetry: {step, loss,
+        grad_norm, skipped[, fp8_amax_max][, host_step_ms]} — None before
+        the first settled step or with telemetry off."""
+        if self._telemetry:
+            self.settle_metrics(block=False)
+        return self._last_metrics
+
+    @property
+    def collects_metrics(self) -> bool:
+        return self._telemetry
+
+    def cost_analysis(self) -> dict:
+        """XLA's own cost model for ONE compiled step (flops, bytes
+        accessed, ...) — the honest FLOP count MFU derives from, replacing
+        hand-counted formulas. Lowers + compiles a second AOT executable
+        from the captured abstract arguments (one-off, cached; call OFF
+        the hot path). Needs at least one executed step."""
+        if self._cost_analysis_cache is not None:
+            return self._cost_analysis_cache
+        if self._jitted is None or self._abstract_args is None:
+            raise RuntimeError(
+                "cost_analysis() needs at least one executed step (the "
+                "abstract argument signature is captured at first dispatch)")
+        compiled = self._jitted.lower(*self._abstract_args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        self._cost_analysis_cache = dict(ca)
+        return self._cost_analysis_cache
+
+    def flops_per_step(self) -> float:
+        """Total XLA-reported FLOPs of one step program (0.0 when the
+        backend does not report them)."""
+        return float(self.cost_analysis().get("flops", 0.0) or 0.0)
 
     # -- anomaly detection ---------------------------------------------------
     @property

@@ -20,6 +20,11 @@ __all__ = [
     "export_chrome_tracing", "SummaryView",
 ]
 
+try:  # the tracing mirror (dependency-free host code; see RecordEvent)
+    from paddle_tpu.observability import tracing as _tracing
+except ImportError:  # pragma: no cover - partial installs
+    _tracing = None
+
 
 class ProfilerTarget(Enum):
     CPU = 0
@@ -51,13 +56,23 @@ class _Collector:
 
 
 _collector = _Collector()
+_PID = os.getpid()
 
 
 class RecordEvent:
-    """Host event annotation (reference: platform/profiler/event_tracing.h)."""
+    """Host event annotation (reference: platform/profiler/event_tracing.h).
 
-    def __init__(self, name: str, event_type=None):
+    Doubles as the span primitive of the unified observability plane:
+    when `paddle_tpu.observability.tracing` has an active collection
+    window, every RecordEvent mirrors in there too — carrying the
+    thread's current trace id (`tracing.trace_context`), so existing
+    annotations (CompiledTrainStep::place/dispatch, DeviceFeeder spans)
+    correlate with router/engine request spans in ONE exported file
+    without any call-site change."""
+
+    def __init__(self, name: str, event_type=None, attrs: dict | None = None):
         self.name = name
+        self.attrs = attrs
         self._begin = None
 
     def begin(self):
@@ -66,13 +81,21 @@ class RecordEvent:
     def end(self):
         if self._begin is None:
             return
+        now = time.perf_counter_ns()
         if _collector.active:
+            # os.getpid() is a syscall per call (tens of µs in sandboxed
+            # kernels) — the cached module value is identical
             ev = {"name": self.name, "ts": self._begin / 1000.0,
-                  "dur": (time.perf_counter_ns() - self._begin) / 1000.0,
-                  "ph": "X", "pid": os.getpid(),
+                  "dur": (now - self._begin) / 1000.0,
+                  "ph": "X", "pid": _PID,
                   "tid": threading.get_ident()}
+            if self.attrs:
+                ev["args"] = dict(self.attrs)
             with _collector.lock:
                 _collector.events.append(ev)
+        if _tracing is not None and _tracing.tracing_active():
+            _tracing.record_span(self.name, self._begin, now - self._begin,
+                                 self.attrs)
         self._begin = None
 
     def __enter__(self):

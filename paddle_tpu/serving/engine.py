@@ -50,6 +50,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import tracing as obs_tracing
 from paddle_tpu.serving.drafts import NGramProposer
 from paddle_tpu.serving.kv_cache import (PageAllocator, kv_page_bytes,
                                          pages_for_budget)
@@ -98,6 +100,51 @@ class ServingConfig:
                    if self.prefix_sharing is None else self.prefix_sharing)
         return (int(ps), int(batch), int(chunk), int(smax), int(budget),
                 int(pages), int(waiting), int(spec_k), bool(sharing))
+
+
+import itertools as _itertools
+
+_engine_seq = _itertools.count()
+
+# engine stats() fields exposed as gauges (label: engine=<seq>) — the
+# /metrics view of the SAME numbers /stats serves (byte-compatible /stats
+# stays the probe surface; Prometheus scrapes these)
+_ENGINE_GAUGES = (
+    "queue_depth", "oldest_wait_age_s", "in_flight", "slot_fill",
+    "decode_retraces_after_warmup", "free_pages", "spec_k",
+    "accepted_tokens_per_step", "prefix_hit_rate", "cow_copies",
+)
+_ENGINE_COUNTERS = {
+    # monotonic engine totals mirrored at scrape time
+    "committed_tokens": "_committed_tokens",
+    "decode_steps": "_decode_steps",
+    "prefix_matched_tokens": "_prefix_matched_tokens",
+}
+
+
+def _register_engine_metrics(engine: "ServingEngine"):
+    import weakref
+
+    ref = weakref.ref(engine)
+
+    def collect(reg):
+        eng = ref()
+        if eng is None:
+            return
+        st = eng.stats()
+        for k in _ENGINE_GAUGES:
+            reg.gauge(f"serving_engine_{k}",
+                      f"ServingEngine.stats()['{k}']",
+                      labels=("engine",)).labels(
+                engine=eng._metrics_id).set(float(st.get(k, 0) or 0))
+        for name, attr in _ENGINE_COUNTERS.items():
+            reg.counter(f"serving_engine_{name}_total",
+                        f"monotonic engine total: {name}",
+                        labels=("engine",)).labels(
+                engine=eng._metrics_id)._set_total(
+                float(getattr(eng, attr)))
+
+    obs_metrics.registry().add_collector(collect, owner=engine)
 
 
 def _buckets(lo: int, hi: int) -> list[int]:
@@ -215,6 +262,11 @@ class ServingEngine:
         self._http_lock = threading.Lock()
         self._http_stop = False
         self._http_error: str | None = None
+        # observability: register a SCRAPE-TIME collector mapping stats()
+        # into the process registry — the decode hot path pays nothing,
+        # and the weakref owner unhooks a collected engine automatically
+        self._metrics_id = str(next(_engine_seq))
+        _register_engine_metrics(self)
 
     def _ctx_cap(self) -> int:
         return self.pages_per_seq * self.page_size
@@ -405,6 +457,13 @@ class ServingEngine:
     # the serving loop
     # ------------------------------------------------------------------
     def _run_prefill(self, req: Request):
+        with obs_tracing.span("engine.prefill", component="engine",
+                              trace_id=(req.trace_id or None), rid=req.rid,
+                              tokens=int(req.context.size),
+                              matched=int(req.matched_tokens)):
+            self._run_prefill_inner(req)
+
+    def _run_prefill_inner(self, req: Request):
         ctx = req.context
         total = int(ctx.size)
         row = jnp.asarray(self.allocator.page_table_row(
@@ -602,7 +661,21 @@ class ServingEngine:
                     f"with {self.allocator.free_pages} free pages and "
                     f"nothing left to evict")
             return False
-        if self.spec_k > 0:
+        if obs_tracing.tracing_active():
+            # one span per packed dispatch, carrying EVERY active request's
+            # trace id — the decode-step end of the router->...->decode
+            # correlation chain (attr cost only paid while tracing)
+            name = ("engine.verify_step" if self.spec_k > 0
+                    else "engine.decode_step")
+            with obs_tracing.span(
+                    name, component="engine", slots=len(running),
+                    trace_ids=[r.trace_id for r in running if r.trace_id],
+                    rids=[r.rid for r in running]):
+                if self.spec_k > 0:
+                    self._verify_once(running, self.scheduler.finish)
+                else:
+                    self._decode_once(running, self.scheduler.finish)
+        elif self.spec_k > 0:
             self._verify_once(running, self.scheduler.finish)
         else:
             self._decode_once(running, self.scheduler.finish)
@@ -700,6 +773,9 @@ class ServingEngine:
                 rid = None
             else:
                 req = self.scheduler.get(rid)
+                # the trace id rides the request object like sampling
+                # knobs: spans from prefill down to the decode step carry it
+                req.trace_id = str(payload.get("trace") or "")
         if rid is None:
             from paddle_tpu.core.flags import flag
 
@@ -805,7 +881,8 @@ class ServingEngine:
             timeout_s=float(flag("serving_request_timeout_s")),
             max_body_bytes=int(flag("serving_max_body_mb")) << 20,
             admit_fn=self._http_admit, health_fn=self._http_health,
-            stats_fn=self.stats)
+            stats_fn=self.stats,
+            metrics_fn=lambda: obs_metrics.registry().prometheus_text())
         self._http_stop = False
         driver = threading.Thread(target=self._drive_http,
                                   name="paddle_tpu.serving.driver",

@@ -39,6 +39,8 @@ import time
 import numpy as np
 
 from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.observability import events as obs_events
+from paddle_tpu.observability import tracing as obs_tracing
 
 __all__ = ["ReplicaError", "ReplicaDead", "StreamGap", "StreamCut",
            "InProcessReplica", "ReplicaStream"]
@@ -185,6 +187,8 @@ class InProcessReplica:
     def _mark_dead(self, cause: str):
         self.dead_cause = cause
         self._stop.set()
+        obs_events.emit("serving", "replica_dead", severity="error",
+                        replica=self.replica_id, cause=cause)
         if self._heartbeat is not None:
             # no tombstone: the heartbeat key goes STALE, so dead_peers()
             # names this replica a corpse (vs close()'s clean exit)
@@ -209,16 +213,25 @@ class InProcessReplica:
             raise ReplicaDead(
                 f"replica {self.replica_id} is dead: {self.dead_cause}")
         q = queue_mod.Queue()
-        with self._lock:
-            rid = self.engine.submit(
-                np.asarray(payload["prompt_ids"], np.int32),
-                max_new_tokens=int(payload.get("max_new_tokens", 16)),
-                temperature=float(payload.get("temperature", 0.0)),
-                top_k=int(payload.get("top_k", 0)),
-                top_p=float(payload.get("top_p", 1.0)),
-                eos_id=payload.get("eos_id"),
-                stream_cb=lambda req, tok: q.put(tok))
-            req = self.engine.scheduler.get(rid)
+        with obs_tracing.span(
+                "replica.open_stream", component="replica",
+                trace_id=(str(payload.get("trace")) if payload.get("trace")
+                          else None),
+                replica=self.replica_id):
+            with self._lock:
+                rid = self.engine.submit(
+                    np.asarray(payload["prompt_ids"], np.int32),
+                    max_new_tokens=int(payload.get("max_new_tokens", 16)),
+                    temperature=float(payload.get("temperature", 0.0)),
+                    top_k=int(payload.get("top_k", 0)),
+                    top_p=float(payload.get("top_p", 1.0)),
+                    eos_id=payload.get("eos_id"),
+                    stream_cb=lambda req, tok: q.put(tok))
+                req = self.engine.scheduler.get(rid)
+                # the trace id rides the Request like the sampling knobs:
+                # engine spans (prefill -> scheduler.admit -> decode step)
+                # correlate with the router's without any signature change
+                req.trace_id = str(payload.get("trace") or "")
         return ReplicaStream(self, req, q)
 
     # ---- lifecycle ---------------------------------------------------------
@@ -230,6 +243,8 @@ class InProcessReplica:
         self.dead_cause = cause
         self._stop.set()
         self._thread.join(timeout=5.0)
+        obs_events.emit("serving", "replica_dead", severity="error",
+                        replica=self.replica_id, cause=cause)
         if self._heartbeat is not None:
             self._heartbeat.stop(mark_clean=False)
 

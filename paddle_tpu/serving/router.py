@@ -54,6 +54,9 @@ import time
 from dataclasses import dataclass, field
 
 from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.observability import events as obs_events
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import tracing as obs_tracing
 from paddle_tpu.serving.replica import ReplicaError, StreamGap
 from paddle_tpu.serving.scheduler import QueueFull
 
@@ -136,6 +139,53 @@ class RouterConfig:
                                "router_retry_after_s", float))
 
 
+_ROUTER_COUNTERS = ("accepted", "completed", "failed", "refused",
+                    "failovers", "sheds", "drained")
+_CIRCUIT_CODE = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+
+def _register_router_metrics(router: "Router"):
+    """Scrape-time collector: the router's monotonic counters mirror into
+    `router_<name>_total` counters and each replica's breaker state into
+    the `router_replica_circuit` gauge (0 closed / 1 half-open / 2 open)
+    — the /metrics view of the SAME numbers stats() serves."""
+    import weakref
+
+    ref = weakref.ref(router)
+
+    def collect(reg):
+        r = ref()
+        if r is None:
+            return
+        with r._lock:
+            counts = {k: getattr(r, k) for k in _ROUTER_COUNTERS}
+            circuits = {s.rid: (s.circuit, s.draining, s.dispatches)
+                        for s in r._slots.values()}
+            inflight = len(r._inflight)
+        for k, v in counts.items():
+            reg.counter(f"router_{k}_total",
+                        f"router lifetime total: {k}")._default_child() \
+                ._set_total(float(v))
+        reg.gauge("router_in_flight",
+                  "requests currently in flight through the router").set(
+            float(inflight))
+        for rid, (circuit, draining, dispatches) in circuits.items():
+            reg.gauge("router_replica_circuit",
+                      "replica breaker state: 0 closed, 1 half-open, "
+                      "2 open", labels=("replica",)).labels(
+                replica=str(rid)).set(_CIRCUIT_CODE[circuit])
+            reg.gauge("router_replica_draining",
+                      "1 while the replica is draining for maintenance",
+                      labels=("replica",)).labels(
+                replica=str(rid)).set(1.0 if draining else 0.0)
+            reg.gauge("router_replica_dispatches",
+                      "router-side in-flight dispatches on the replica",
+                      labels=("replica",)).labels(
+                replica=str(rid)).set(float(dispatches))
+
+    obs_metrics.registry().add_collector(collect, owner=router)
+
+
 @dataclass
 class _Slot:
     """Per-replica router state: the circuit breaker + last probe view."""
@@ -203,6 +253,7 @@ class Router:
         self.drained = 0
         self.monitor_errors: list[str] = []
         self._stop = threading.Event()
+        _register_router_metrics(self)
         self._monitor_thread = None
         if start_monitor:
             self._monitor_thread = threading.Thread(
@@ -245,11 +296,18 @@ class Router:
                     self._trip(slot,
                                f"heartbeat stale ({d['age_s']}s)")
         for slot in list(self._slots.values()):
+            went_half_open = False
             with self._lock:
                 if slot.circuit == "open":
                     if now - slot.opened_t < self.cfg.breaker_cooldown_s:
                         continue            # still cooling: no probe
                     slot.circuit = "half_open"
+                    went_half_open = True
+            if went_half_open:
+                # journal emits stay OUTSIDE the router lock (a slow
+                # durable sink must not stall dispatch/admission)
+                obs_events.emit("router", "circuit_half_open",
+                                replica=slot.rid)
             try:
                 p = dict(slot.transport.probe())
                 if not p.get("ok", True):
@@ -268,12 +326,17 @@ class Router:
                                 >= self.cfg.failure_threshold):
                             self._trip(slot, slot.probe_err)
                 continue
+            closed_now = False
             with self._lock:
                 slot.probe = p
                 slot.probe_err = None
                 slot.consecutive_failures = 0
                 if slot.circuit == "half_open":
                     slot.circuit = "closed"   # trial succeeded: recovered
+                    closed_now = True
+            if closed_now:
+                obs_events.emit("router", "circuit_close",
+                                replica=slot.rid)
 
     def _record_failure(self, slot: _Slot, cause: str):
         """A dispatch-path failure counts against the same breaker as a
@@ -291,6 +354,8 @@ class Router:
             slot.trips += 1
             slot.last_cause = cause
             self._drain_slot(slot, cause)
+        obs_events.emit("router", "circuit_open", severity="error",
+                        replica=slot.rid, cause=cause, trips=slot.trips)
 
     def _drain_slot(self, slot: _Slot, why: str) -> list:
         """Signal every in-flight dispatch bound to `slot`, OLDEST FIRST
@@ -315,6 +380,8 @@ class Router:
         slot = self._slots[int(replica_id)]
         with self._lock:
             slot.draining = True
+        obs_events.emit("router", "drain", severity="warn",
+                        replica=slot.rid, why=why)
         return self._drain_slot(slot, why)
 
     def undrain(self, replica_id: int):
@@ -404,6 +471,11 @@ class Router:
             yield rejected
             return
         payload = dict(payload)
+        # the router MINTS the request's trace id (unless the caller sent
+        # one): it rides payload["trace"] -> Request.trace_id -> every
+        # replica/engine/scheduler/decode-step span (docs/observability.md)
+        trace = str(payload.get("trace") or "") or obs_tracing.new_trace_id()
+        payload["trace"] = trace
         shed = False
         if self._aggregate_depth() > cfg.shed_queue_depth:
             if int(payload.get("max_new_tokens", 16)) > cfg.shed_max_new_tokens:
@@ -411,6 +483,21 @@ class Router:
                 shed = True
                 with self._lock:
                     self.sheds += 1
+        # span covers the request's whole router residence (dispatches,
+        # failovers, relay) — wall time as the CALLER experiences it.
+        # bind=False: the generator suspends inside this `with`, and owning
+        # the consumer thread's trace context across suspensions would
+        # misattribute unrelated spans (and restore non-LIFO under
+        # interleaved streams); the id still rides the span + payload.
+        with obs_tracing.span("router.stream", component="router",
+                              trace_id=trace, bind=False,
+                              session=str(payload.get("session") or "")):
+            yield from self._relay(payload, ctx, deadline, shed)
+
+    def _relay(self, payload, ctx, deadline, shed):
+        """The dispatch/failover relay loop of one accepted request (the
+        body of `stream()` — split out so the tracing span wraps it)."""
+        cfg = self.cfg
         key = payload.get("session")
         delays = backoff_delays(cfg.dispatch_attempts, cfg.backoff_initial_s,
                                 cfg.backoff_max_s)
@@ -534,6 +621,11 @@ class Router:
                     return
                 with self._lock:
                     self.failovers += 1
+                obs_events.emit(
+                    "router", "failover", severity="warn",
+                    replica=slot.rid, attempt=attempts,
+                    trace_id=str(payload.get("trace") or ""),
+                    cause=f"{type(err).__name__}: {err}" if err else "")
                 # responsive backoff: a drain wakes it
                 ctx.abort.wait(delays[attempts - 1])
         finally:
@@ -599,7 +691,8 @@ class Router:
             timeout_s=float(flag("serving_request_timeout_s")),
             max_body_bytes=int(flag("serving_max_body_mb")) << 20,
             host=host, admit_fn=self.admission_check,
-            health_fn=self.health, stats_fn=self.stats)
+            health_fn=self.health, stats_fn=self.stats,
+            metrics_fn=lambda: obs_metrics.registry().prometheus_text())
         self._http_server = srv
         return srv
 

@@ -21,6 +21,8 @@ from enum import Enum
 
 import numpy as np
 
+from paddle_tpu.observability import events as obs_events
+from paddle_tpu.observability import tracing as obs_tracing
 from paddle_tpu.serving.kv_cache import PageAllocator
 
 __all__ = ["Request", "RequestState", "ContinuousBatchingScheduler",
@@ -71,6 +73,10 @@ class Request:
     # LAST admission: the engine's prefill starts here (0 = no match);
     # reset on eviction, re-matched on re-admission
     matched_tokens: int = 0
+    # observability: the request's trace id, riding the request object
+    # like sampling knobs (router mints it, replica/engine attach it,
+    # every span down to the decode step carries it — docs/observability.md)
+    trace_id: str = ""
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -172,6 +178,8 @@ class ContinuousBatchingScheduler:
                len(self.running) + len(admitted) < self.max_batch and
                (not limit or len(admitted) < limit)):
             req = self.waiting[0]
+            t0 = (time.perf_counter_ns()
+                  if obs_tracing.tracing_active() else None)
             adopt, matched = ([], 0)
             if self.prefix_sharing:
                 adopt, matched = self.allocator.match_prefix(req.context)
@@ -183,6 +191,12 @@ class ContinuousBatchingScheduler:
             req.state = RequestState.RUNNING
             req.admitted_t = time.perf_counter()
             admitted.append(req)
+            if t0 is not None:
+                obs_tracing.record_span(
+                    "scheduler.admit", t0, time.perf_counter_ns() - t0,
+                    {"component": "scheduler", "rid": req.rid,
+                     "matched_tokens": matched,
+                     **({"trace_id": req.trace_id} if req.trace_id else {})})
         return admitted
 
     def activate(self, req: Request):
@@ -232,6 +246,11 @@ class ContinuousBatchingScheduler:
         victim.evictions += 1
         victim.matched_tokens = 0
         self.waiting.insert(0, victim)
+        obs_events.emit("serving", "page_eviction", severity="warn",
+                        rid=victim.rid, evictions=victim.evictions,
+                        context_tokens=victim.total_len,
+                        **({"trace_id": victim.trace_id}
+                           if victim.trace_id else {}))
 
     # ---- completion -------------------------------------------------------
     def finish(self, req: Request, state: RequestState = RequestState.FINISHED):

@@ -10,13 +10,41 @@ into any dashboard.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import threading
 import time
+import weakref
 
 import numpy as np
 
 __all__ = ["LogWriter", "LogReader", "VisualDLCallback"]
+
+# durability: every live writer flushes at interpreter exit, so a
+# short-lived run (a bench arm, a crashed script) never drops the tail of
+# its buffered JSONL events. Weak set — registration must not keep
+# writers (and their open files) alive.
+_LIVE_WRITERS: "weakref.WeakSet[LogWriter]" = weakref.WeakSet()
+_atexit_lock = threading.Lock()
+_atexit_installed = False
+
+
+def _flush_live_writers():
+    for w in list(_LIVE_WRITERS):
+        try:
+            w.flush()
+        except (OSError, ValueError):
+            continue  # a closed/broken file at exit is not worth a raise
+
+
+def _register_for_atexit(writer: "LogWriter"):
+    global _atexit_installed
+    with _atexit_lock:
+        if not _atexit_installed:
+            atexit.register(_flush_live_writers)
+            _atexit_installed = True
+        _LIVE_WRITERS.add(writer)
 
 
 class LogWriter:
@@ -33,6 +61,7 @@ class LogWriter:
         self._max_queue = max_queue
         self._flush_secs = flush_secs
         self._last_flush = time.time()
+        _register_for_atexit(self)
 
     def _emit(self, record: dict):
         record["wall_time"] = time.time()
@@ -70,8 +99,10 @@ class LogWriter:
         self._last_flush = time.time()
 
     def close(self):
-        self.flush()
-        self._f.close()
+        if not self._f.closed:
+            self.flush()
+            self._f.close()
+        _LIVE_WRITERS.discard(self)
 
     def __enter__(self):
         return self
@@ -103,6 +134,18 @@ class LogReader:
         """[(step, value)] for a scalar tag, step-ordered."""
         out = [(e["step"], e["value"]) for e in self._events()
                if e["kind"] == "scalar" and e["tag"] == tag]
+        return sorted(out)
+
+    def last(self, tag: str):
+        """The highest-step (step, value) of a scalar tag, or None."""
+        series = self.scalars(tag)
+        return series[-1] if series else None
+
+    def texts(self, tag: str):
+        """[(step, text)] for a text tag, step-ordered (e.g. the metrics
+        registry's histogram exports)."""
+        out = [(e["step"], e["text"]) for e in self._events()
+               if e["kind"] == "text" and e["tag"] == tag]
         return sorted(out)
 
 

@@ -68,6 +68,19 @@ def _faults_hygiene():
 
 
 @pytest.fixture(autouse=True)
+def _observability_hygiene():
+    """A test that starts a tracing window or fills the event journal must
+    not leak spans/events into the rest of the suite (the metrics registry
+    is additive-only and stays — module-scoped engines keep their
+    scrape-time collectors alive across tests)."""
+    yield
+    from paddle_tpu.observability import events, tracing
+
+    tracing.reset()
+    events.journal().clear()
+
+
+@pytest.fixture(autouse=True)
 def _thread_hygiene():
     """Tier-1 guard: DataLoader/DeviceFeeder prefetch threads, the
     elastic-checkpoint writer, store heartbeats, AND the serving fleet's

@@ -1,0 +1,642 @@
+"""Unified observability plane (docs/observability.md), tier-1 core:
+metrics registry (Prometheus exposition golden text, concurrent-update
+exactness, collectors), cross-component tracing (trace-id propagation
+router -> replica -> scheduler/engine asserted on a two-replica in-process
+run), honest step telemetry (bit-equal losses with collection on,
+cost_analysis FLOPs), LogWriter durability, the structured event journal,
+and the /metrics HTTP endpoint + zero-retrace guard on a real engine."""
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import events as obs_events
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import tracing as obs_tracing
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+class TestMetricsRegistry:
+    def test_prometheus_exposition_golden(self):
+        """The exact text-format 0.0.4 output — HELP/TYPE lines, label
+        rendering + escaping, histogram cumulative buckets with the +Inf
+        terminal, _sum/_count ordering, trailing newline."""
+        r = MetricsRegistry()
+        c = r.counter("http_requests_total", "total requests",
+                      labels=("route", "code"))
+        c.labels(route="/generate", code="200").inc(3)
+        c.labels(route='/we"ird\npath', code="503").inc()
+        r.gauge("queue_depth", "waiting requests").set(7)
+        h = r.histogram("latency_ms", "per-token latency",
+                        buckets=(1, 5, 10))
+        for v in (0.5, 3.0, 7.0, 100.0):
+            h.observe(v)
+        expected = "\n".join([
+            '# HELP http_requests_total total requests',
+            '# TYPE http_requests_total counter',
+            'http_requests_total{code="200",route="/generate"} 3',
+            'http_requests_total{code="503",route="/we\\"ird\\npath"} 1',
+            '# HELP latency_ms per-token latency',
+            '# TYPE latency_ms histogram',
+            'latency_ms_bucket{le="1"} 1',
+            'latency_ms_bucket{le="5"} 2',
+            'latency_ms_bucket{le="10"} 3',
+            'latency_ms_bucket{le="+Inf"} 4',
+            'latency_ms_sum 110.5',
+            'latency_ms_count 4',
+            '# HELP queue_depth waiting requests',
+            '# TYPE queue_depth gauge',
+            'queue_depth 7',
+        ]) + "\n"
+        assert r.prometheus_text() == expected
+
+    def test_type_and_label_conflicts_raise(self):
+        r = MetricsRegistry()
+        r.counter("x_total", "c")
+        with pytest.raises(TypeError):
+            r.gauge("x_total", "g")
+        g = r.gauge("g", "g", labels=("a",))
+        with pytest.raises(ValueError):
+            g.labels(b="1")
+        with pytest.raises(ValueError):
+            r.counter("neg", "c").inc(-1)
+
+    def test_concurrent_updates_exact(self):
+        """Lock-striped updates lose nothing: N threads hammering shared
+        counter/histogram children produce exact totals."""
+        r = MetricsRegistry()
+        c = r.counter("ops_total", "", labels=("worker",))
+        h = r.histogram("obs_ms", "", buckets=(1, 10, 100))
+        g = r.gauge("acc", "")
+        N_THREADS, N_OPS = 8, 2000
+        barrier = threading.Barrier(N_THREADS)
+
+        def work(i):
+            child = c.labels(worker=str(i % 2))  # 2 shared children
+            barrier.wait()
+            for k in range(N_OPS):
+                child.inc()
+                h.observe(float(k % 150))
+                g.inc(1.0)
+
+        ts = [threading.Thread(target=work, args=(i,))
+              for i in range(N_THREADS)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        total = sum(child.value for _, child in c.samples())
+        assert total == N_THREADS * N_OPS
+        hc = h._default_child()
+        assert hc.count == N_THREADS * N_OPS
+        assert g.value == N_THREADS * N_OPS
+        # cumulative buckets are consistent: monotonic, terminal == count
+        cum = hc.cumulative()
+        assert [n for _, n in cum] == sorted(n for _, n in cum)
+        assert cum[-1][1] == hc.count
+
+    def test_histogram_quantiles(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat", "", buckets=(10, 20, 50, 100))
+        for v in range(100):  # uniform 0..99
+            h.observe(float(v))
+        assert 40 <= h.quantile(0.5) <= 60
+        assert h.quantile(0.99) >= 90
+
+    def test_collector_weakref_owner(self):
+        r = MetricsRegistry()
+
+        class Owner:
+            pass
+
+        owner = Owner()
+        calls = []
+        r.add_collector(lambda reg: calls.append(1), owner=owner)
+        r.snapshot()
+        assert calls == [1]
+        del owner
+        import gc
+
+        gc.collect()
+        r.snapshot()
+        assert calls == [1]  # dead-owner collector dropped, not called
+
+    def test_snapshot_json_safe_and_export_jsonl(self, tmp_path):
+        from paddle_tpu.utils.log_writer import LogReader, LogWriter
+
+        r = MetricsRegistry()
+        r.gauge("train_loss", "").set(1.5)
+        h = r.histogram("lat", "", buckets=(1.0,))
+        h.observe(0.5)
+        h.observe(2.0)
+        snap = r.snapshot()
+        json.loads(json.dumps(snap))  # +Inf bucket must serialize strictly
+        assert snap["lat"]["samples"][0]["buckets"][-1][0] == "+Inf"
+        with LogWriter(str(tmp_path)) as w:
+            r.export_jsonl(w, step=3)
+        reader = LogReader(str(tmp_path))
+        assert reader.scalars("train_loss") == [(3, 1.5)]
+        (step, text), = reader.texts("lat")
+        assert step == 3 and json.loads(text)["count"] == 2
+
+    def test_counter_mirror_reset_semantics(self):
+        r = MetricsRegistry()
+        c = r.counter("m_total", "")
+        child = c._default_child()
+        child._set_total(10)
+        child._set_total(3)  # source reset (Prometheus counter reset)
+        assert c.value == 3
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+class TestTracing:
+    def test_span_and_context_inheritance(self):
+        obs_tracing.start_tracing()
+        try:
+            with obs_tracing.span("outer", component="router",
+                                  trace_id="t123"):
+                with obs_tracing.span("inner", component="engine"):
+                    pass
+        finally:
+            evs = obs_tracing.stop_tracing()
+        by_name = {e["name"]: e for e in evs}
+        assert by_name["outer"]["args"]["trace_id"] == "t123"
+        assert by_name["inner"]["args"]["trace_id"] == "t123"  # inherited
+        assert by_name["inner"]["args"]["component"] == "engine"
+        assert by_name["inner"]["dur"] <= by_name["outer"]["dur"]
+
+    def test_record_event_mirrors_into_trace(self):
+        from paddle_tpu.profiler import RecordEvent
+
+        obs_tracing.start_tracing()
+        try:
+            with obs_tracing.trace_context("abc"):
+                with RecordEvent("CompiledTrainStep::place"):
+                    pass
+        finally:
+            evs = obs_tracing.stop_tracing()
+        (ev,) = [e for e in evs if e["name"] == "CompiledTrainStep::place"]
+        assert ev["args"]["trace_id"] == "abc"
+
+    def test_unbound_span_leaves_thread_context_alone(self):
+        """bind=False (the generator-wrapping mode the router uses): two
+        interleaved generator spans on one thread must neither leak their
+        trace id into the thread context nor restore it non-LIFO."""
+        obs_tracing.start_tracing()
+        try:
+            def gen(tid):
+                with obs_tracing.span("router.stream", component="router",
+                                      trace_id=tid, bind=False):
+                    yield 1
+                    yield 2
+
+            a, b = gen("ta"), gen("tb")
+            next(a)
+            next(b)
+            assert obs_tracing.current_trace_id() is None
+            a.close()        # finishes A while B is still live
+            assert obs_tracing.current_trace_id() is None
+            b.close()
+            assert obs_tracing.current_trace_id() is None
+        finally:
+            evs = obs_tracing.stop_tracing()
+        assert {e["args"]["trace_id"] for e in evs} == {"ta", "tb"}
+
+    def test_inactive_tracing_records_nothing(self):
+        with obs_tracing.span("x", component="c"):
+            pass
+        assert obs_tracing.events_snapshot() == []
+
+    def test_export_chrome(self, tmp_path):
+        obs_tracing.start_tracing()
+        with obs_tracing.span("a", component="c"):
+            pass
+        obs_tracing.stop_tracing()
+        path = str(tmp_path / "trace.json")
+        summary = obs_tracing.export_chrome(
+            path, extra_events=[{"name": "dev", "ph": "X", "ts": 0,
+                                 "dur": 1, "pid": 9, "tid": 9}])
+        assert summary["host_events"] == 1
+        with open(path) as f:
+            doc = json.load(f)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert names == {"a", "dev"}
+
+
+# ---------------------------------------------------------------------------
+# event journal
+# ---------------------------------------------------------------------------
+class TestEventJournal:
+    def test_schema_and_sinks(self, tmp_path):
+        from paddle_tpu.observability.events import EventJournal
+
+        j = EventJournal(maxlen=4)
+        path = str(tmp_path / "events.jsonl")
+        j.attach(path)
+        rec = j.emit("router", "circuit_open", severity="error", replica=2)
+        assert set(("ts", "component", "event", "severity")) <= set(rec)
+        with pytest.raises(ValueError):
+            j.emit("x", "y", severity="fatal")
+        with pytest.raises(ValueError):
+            j.emit("x", "y", ts=123.0)   # schema fields are reserved
+        for i in range(6):
+            j.emit("serving", "page_eviction", rid=i)
+        assert len(j.recent()) == 4                       # bounded ring
+        assert j.recent(component="router") == []         # rotated out
+        assert j.emitted == 7
+        with open(path) as f:
+            lines = [json.loads(l) for l in f if l.strip()]
+        assert len(lines) == 7                            # sink keeps all
+        assert lines[0]["event"] == "circuit_open"
+        j.close()
+
+    def test_broken_sink_never_crashes_the_emitter(self, tmp_path):
+        """Journal emits sit on recovery paths (rollback incidents) and
+        under component locks: a full-disk/closed sink must be recorded,
+        not raised."""
+        from paddle_tpu.observability.events import EventJournal
+
+        j = EventJournal()
+        path = str(tmp_path / "e.jsonl")
+        j.attach(path)
+        j._files[path].close()               # simulate a dead sink
+        with pytest.warns(UserWarning, match="journal sink failed"):
+            rec = j.emit("resilience", "rollback", severity="warn", step=1)
+        assert rec["event"] == "rollback"
+        assert j.recent(event="rollback")    # ring still has it
+        assert j.sink_errors
+        j.emit("resilience", "rollback", step=2)   # warns once, never raises
+
+    def test_help_text_escaping_keeps_quotes_literal(self):
+        r = MetricsRegistry()
+        r.gauge("g", 'the "p99" gate\nline2').set(1)
+        text = r.prometheus_text()
+        assert '# HELP g the "p99" gate\\nline2' in text
+
+    def test_emit_feeds_metrics_counter(self):
+        before = obs_events.journal().emitted
+        obs_events.emit("testcomp", "tick")
+        reg = obs_metrics.registry()
+        c = reg.counter("events_total", "", labels=("component", "event"))
+        assert c.labels(component="testcomp", event="tick").value >= 1
+        assert obs_events.journal().emitted == before + 1
+
+    def test_incident_log_bridges_to_journal(self, tmp_path):
+        from paddle_tpu.distributed.resilience.supervisor import IncidentLog
+
+        log = IncidentLog()
+        log.emit("rollback", step=7, cause="anomaly:nan")
+        recent = obs_events.journal().recent(component="resilience",
+                                             event="rollback")
+        assert recent and recent[-1]["step"] == 7
+        assert recent[-1]["severity"] == "warn"
+
+
+# ---------------------------------------------------------------------------
+# LogWriter durability satellites
+# ---------------------------------------------------------------------------
+class TestLogWriterDurability:
+    def test_atexit_flush_covers_unflushed_writers(self, tmp_path):
+        from paddle_tpu.utils import log_writer as lw
+
+        w = lw.LogWriter(str(tmp_path), max_queue=10_000, flush_secs=10_000)
+        w.add_scalar("loss", 1.0, 0)
+        # buffered: nothing on disk yet (large queue + flush interval)
+        assert os.path.getsize(w._path) == 0
+        lw._flush_live_writers()   # what the atexit hook runs
+        assert os.path.getsize(w._path) > 0
+        w.close()
+        w.close()                  # idempotent
+        assert w not in lw._LIVE_WRITERS
+
+    def test_reader_last_and_texts(self, tmp_path):
+        from paddle_tpu.utils.log_writer import LogReader, LogWriter
+
+        with LogWriter(str(tmp_path)) as w:
+            w.add_scalar("loss", 3.0, 1)
+            w.add_scalar("loss", 2.0, 5)
+            w.add_text("note", "hello", 2)
+        r = LogReader(str(tmp_path))
+        assert r.last("loss") == (5, 2.0)
+        assert r.last("missing") is None
+        assert r.texts("note") == [(2, "hello")]
+
+
+# ---------------------------------------------------------------------------
+# honest step telemetry
+# ---------------------------------------------------------------------------
+def _tiny_step(collect, seed=0):
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+    from paddle_tpu.parallel import CompiledTrainStep
+
+    cfg = llama_tiny_config(num_hidden_layers=2, vocab_size=128,
+                            hidden_size=32, intermediate_size=64,
+                            max_position_embeddings=32)
+    paddle.seed(seed)
+    m = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    st = CompiledTrainStep(m, lambda o, l: o, opt, collect_metrics=collect,
+                           metrics_every=0)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (4, 32)).astype(np.int64))
+    return st, ids
+
+
+# ONE telemetry-on and ONE telemetry-off compiled step shared by the
+# class (each CompiledTrainStep costs a full XLA compile; tier-1 runs at
+# its wall-clock budget). Tests only step them FORWARD — assertions are
+# relative to step_count, never absolute state.
+@pytest.fixture(scope="module")
+def tele_steps():
+    st_off, ids = _tiny_step(False)
+    st_on, _ = _tiny_step(True)
+    return st_off, st_on, ids
+
+
+class TestStepTelemetry:
+    def test_losses_bit_equal_and_metrics_settle(self, tele_steps):
+        st_off, st_on, ids = tele_steps
+        for _ in range(4):
+            l_off = st_off(ids, ids, ids)
+            l_on = st_on(ids, ids, ids)
+        st_off.drain()
+        st_on.drain()
+        assert float(l_off) == float(l_on)   # telemetry cannot move the math
+        md = st_on.last_metrics()
+        assert md is not None
+        assert md["step"] == st_on.step_count
+        assert md["loss"] == float(l_on)
+        assert md["grad_norm"] > 0 and np.isfinite(md["grad_norm"])
+        assert md["skipped"] == 0.0
+        assert "host_step_ms" in md
+        assert st_off.last_metrics() is None  # off = no collection at all
+
+    def test_async_runahead_not_broken_by_collection(self, tele_steps):
+        _, st, ids = tele_steps
+        futures = [st.step_async(ids, ids, ids) for _ in range(4)]
+        st.drain()
+        assert all(np.isfinite(float(f)) for f in futures)
+        assert st.last_metrics()["step"] == st.step_count
+        assert st._pending_metrics == []      # drain settles everything
+
+    def test_cost_analysis_flops(self, tele_steps):
+        from paddle_tpu.models.llama import LlamaForCausalLM, \
+            llama_tiny_config
+        from paddle_tpu.parallel import CompiledTrainStep
+
+        fresh = CompiledTrainStep(
+            LlamaForCausalLM(llama_tiny_config(num_hidden_layers=1)),
+            lambda o, l: o, collect_metrics=True)
+        with pytest.raises(RuntimeError):
+            fresh.cost_analysis()             # needs one executed step
+        _, st, ids = tele_steps
+        st(ids, ids, ids)
+        st.drain()
+        flops = st.flops_per_step()
+        assert flops > 0
+        assert st.cost_analysis() is st.cost_analysis()   # cached
+
+    def test_metrics_callback_streams_to_registry_and_jsonl(
+            self, tele_steps, tmp_path):
+        from paddle_tpu.hapi import MetricsCallback
+        from paddle_tpu.utils.log_writer import LogReader
+
+        _, st, ids = tele_steps
+
+        class FakeDist:
+            _step = st
+
+        class FakeModel:
+            _dist_model = FakeDist()
+
+        reg = MetricsRegistry()
+        cb = MetricsCallback(logdir=str(tmp_path), registry=reg,
+                             peak_flops_per_s=1e12)
+        cb.set_model(FakeModel())
+        cb.on_train_begin()
+        for i in range(3):
+            loss = st(ids, ids, ids)
+            st.drain()
+            cb.on_train_batch_end(i, {"loss": float(loss)})
+        cb.on_train_end()
+        snap = reg.snapshot()
+        assert snap["train_steps_total"]["samples"][0]["value"] == 3
+        assert snap["train_loss"]["samples"][0]["value"] == float(loss)
+        assert snap["train_grad_norm"]["samples"][0]["value"] > 0
+        # the MFU gauge derives from compiled.cost_analysis() FLOPs
+        assert 0 < snap["train_mfu"]["samples"][0]["value"] < 1e6
+        series = LogReader(str(tmp_path)).scalars("train/loss")
+        assert len(series) == 3
+
+
+# ---------------------------------------------------------------------------
+# trace-id propagation on a two-replica in-process run
+# ---------------------------------------------------------------------------
+class _HostEngine:
+    """test_router's FakeEngine pattern: REAL scheduler + allocator behind
+    the transport seam, deterministic tokens — router/replica/scheduler
+    span machinery runs for real without per-engine XLA compiles."""
+
+    def __init__(self):
+        from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                        PageAllocator)
+
+        self.allocator = PageAllocator(64, 4)
+        self.scheduler = ContinuousBatchingScheduler(self.allocator, 4, 64)
+        self.decode_retraces_after_warmup = 0
+
+    def submit(self, prompt, max_new_tokens=16, temperature=0.0, top_k=0,
+               top_p=1.0, eos_id=None, stream_cb=None):
+        from paddle_tpu.serving import Request
+
+        req = Request(prompt=np.asarray(prompt, np.int32),
+                      max_new_tokens=int(max_new_tokens),
+                      stream_cb=stream_cb)
+        return self.scheduler.submit(req)
+
+    def step(self):
+        from paddle_tpu.serving import RequestState
+
+        for req in self.scheduler.admissions():
+            self.scheduler.activate(req)
+        self.scheduler.grow()
+        for req in list(self.scheduler.running):
+            tok = (int(np.sum(req.prompt)) * 31
+                   + 7 * len(req.generated)) % 997
+            req.generated.append(tok)
+            if req.stream_cb is not None:
+                req.stream_cb(req, tok)
+            if len(req.generated) >= req.max_new_tokens:
+                self.scheduler.finish(req, RequestState.FINISHED)
+
+    def stats(self):
+        return {"queue_depth": self.scheduler.queue_depth,
+                "oldest_wait_age_s": 0.0, "in_flight": 0, "slot_fill": 0.0,
+                "decode_retraces_after_warmup": 0, "free_pages": 10}
+
+    def cancel(self, rid):
+        return self.scheduler.cancel(rid)
+
+    def release(self, rid):
+        self.scheduler.release(rid)
+
+
+class TestTracePropagation:
+    def test_router_to_engine_trace_ids_two_replicas(self):
+        """The acceptance path: trace ids minted at the router correlate
+        spans from router -> replica -> scheduler (the engine-side
+        admission) across a TWO-replica in-process fleet, and the exported
+        Chrome file carries them."""
+        from paddle_tpu.serving import InProcessReplica, Router, RouterConfig
+
+        reps = [InProcessReplica(_HostEngine(), replica_id=i)
+                for i in range(2)]
+        router = Router(reps, RouterConfig(probe_interval_s=0.05,
+                                           gap_timeout_s=5.0))
+        obs_tracing.start_tracing()
+        try:
+            for s in range(4):   # sessions spread over both replicas
+                toks, term = router.generate(
+                    {"prompt_ids": [1 + s, 2, 3], "max_new_tokens": 4,
+                     "session": f"s{s}"})
+                assert term.get("done"), term
+                assert len(toks) == 4
+        finally:
+            evs = obs_tracing.stop_tracing()
+            router.close(close_transports=True)
+        by_trace = {}
+        replicas_used = set()
+        for e in evs:
+            args = e.get("args", {})
+            t = args.get("trace_id")
+            if t:
+                by_trace.setdefault(t, set()).add(args.get("component"))
+            if e["name"] == "replica.open_stream":
+                replicas_used.add(args.get("replica"))
+        full = [t for t, comps in by_trace.items()
+                if {"router", "replica", "scheduler"} <= comps]
+        assert len(full) == 4, by_trace   # every request fully correlated
+        assert replicas_used == {0, 1}    # genuinely two replicas
+
+
+# ---------------------------------------------------------------------------
+# real engine: /metrics endpoint, engine spans, zero-retrace guard
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def real_engine():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny_config())
+    m.eval()
+    return ServingEngine(m, ServingConfig(page_size=4, num_pages=64,
+                                          decode_batch=4, prefill_chunk=8,
+                                          max_seq_len=64))
+
+
+class TestRealEngineObservability:
+    def test_engine_spans_and_zero_retrace_under_instrumentation(
+            self, real_engine):
+        """Decode-step metrics collection + tracing + scrapes add NO new
+        compilations, and the engine emits prefill/decode spans carrying
+        the request trace id."""
+        eng = real_engine
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 256, n).astype(np.int32) for n in (5, 9)]
+        eng.generate(prompts, max_new_tokens=4)      # warm every bucket
+        eng.mark_warmup()
+        reg = obs_metrics.registry()
+        obs_tracing.start_tracing()
+        try:
+            rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            for rid in rids:   # the trace id rides the request object
+                eng.scheduler.get(rid).trace_id = f"tr{rid}"
+            while not eng.scheduler.idle:
+                eng.step()
+                reg.prometheus_text()                # scrape mid-decode
+            for rid in rids:
+                eng.release(rid)
+        finally:
+            evs = obs_tracing.stop_tracing()
+        assert eng.decode_retraces_after_warmup == 0
+        prefills = [e for e in evs if e["name"] == "engine.prefill"]
+        decodes = [e for e in evs if e["name"] == "engine.decode_step"]
+        assert {e["args"]["trace_id"] for e in prefills} == {
+            f"tr{r}" for r in rids}
+        assert decodes
+        traced = set()
+        for e in decodes:
+            traced.update(e["args"].get("trace_ids", []))
+        assert traced == {f"tr{r}" for r in rids}
+
+    def test_metrics_endpoint_alongside_healthz_and_stats(self, real_engine):
+        eng = real_engine
+        srv = eng.serve_http(0, block=False)
+        accept = threading.Thread(target=srv.serve_forever, daemon=True)
+        accept.start()
+        try:
+            port = srv.server_port
+
+            def get(path):
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=10)
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                body = resp.read()
+                ct = resp.getheader("Content-Type")
+                conn.close()
+                return resp.status, ct, body
+
+            status, ct, body = get("/metrics")
+            assert status == 200
+            assert ct.startswith("text/plain; version=0.0.4")
+            text = body.decode()
+            assert "# TYPE serving_engine_queue_depth gauge" in text
+            assert "serving_engine_committed_tokens_total" in text
+            # /healthz and /stats stay byte-compatible JSON
+            status, ct, body = get("/healthz")
+            assert status == 200 and ct == "application/json"
+            assert json.loads(body)["ok"] is True
+            status, ct, body = get("/stats")
+            assert status == 200
+            st = json.loads(body)
+            assert set(eng.stats()) == set(st)
+        finally:
+            eng.shutdown_http()
+
+    def test_page_eviction_emits_journal_event(self):
+        from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                        PageAllocator, Request)
+
+        alloc = PageAllocator(6, 4)                  # 5 usable pages
+        sched = ContinuousBatchingScheduler(alloc, 2, 64)
+        before = len(obs_events.journal().recent(component="serving",
+                                                 event="page_eviction"))
+        r1 = Request(prompt=np.arange(1, 9, dtype=np.int32))   # 2 pages
+        r2 = Request(prompt=np.arange(1, 9, dtype=np.int32))
+        for r in (r1, r2):
+            sched.submit(r)
+        for r in sched.admissions():
+            sched.activate(r)
+        # grow both requests until the pool exhausts -> youngest evicted
+        while not any(r.evictions for r in (r1, r2)):
+            for r in list(sched.running):
+                r.generated.append(1)
+            sched.grow()
+        recs = obs_events.journal().recent(component="serving",
+                                           event="page_eviction")
+        assert len(recs) > before
+        assert recs[-1]["severity"] == "warn"

@@ -34,6 +34,16 @@ def run_bench() -> dict:
     raise SystemExit("bench.py produced no JSON line")
 
 
+def _snapshot_value(report: dict, key: str, fallback):
+    """Read a gate number from the observability metrics snapshot the
+    bench embeds (detail.metrics_snapshot — the registry's own view of
+    tokens/sec, MFU and serving p99), falling back to the legacy ad-hoc
+    field for reports recorded before the snapshot existed."""
+    snap = (report.get("detail") or {}).get("metrics_snapshot") or {}
+    v = snap.get(key)
+    return float(v) if v is not None else fallback
+
+
 def main():
     cur = run_bench()
     platform = cur["detail"]["platform"]
@@ -56,9 +66,30 @@ def main():
     loss = cur["detail"]["loss"]
     if not (loss == loss and abs(loss) < 1e4):
         raise SystemExit(f"bench loss not finite/sane: {loss}")
-    ratio = cur["value"] / base["value"]
-    print(f"throughput: {cur['value']:.1f} vs baseline {base['value']:.1f} "
+    # primary numbers come from the metrics snapshot (the observability
+    # plane IS the instrument); legacy fields remain the fallback so old
+    # baselines stay comparable
+    cur_tps = _snapshot_value(cur, "bench_tokens_per_sec_per_chip",
+                              cur["value"])
+    base_tps = _snapshot_value(base, "bench_tokens_per_sec_per_chip",
+                               base["value"])
+    ratio = cur_tps / base_tps
+    print(f"throughput: {cur_tps:.1f} vs baseline {base_tps:.1f} "
           f"({ratio:.3f}x)")
+    mfu = _snapshot_value(cur, "bench_mfu",
+                          (cur["detail"] or {}).get("mfu"))
+    if mfu is not None:
+        print(f"mfu: {mfu:.4f} "
+              f"(source: {(cur['detail'].get('metrics_snapshot') or {}) .get('mfu_source', 'analytic')})")
+    p99 = _snapshot_value(cur, "bench_serving_p99_ms", None)
+    base_p99 = _snapshot_value(base, "bench_serving_p99_ms", None)
+    if p99 is not None:
+        print(f"serving p99: {p99:.1f} ms"
+              + (f" vs baseline {base_p99:.1f} ms" if base_p99 else ""))
+        if base_p99 and p99 > base_p99 * 2.0:
+            raise SystemExit(
+                f"SERVING REGRESSION: p99 {p99:.1f} ms is more than 2x "
+                f"the recorded {base_p99:.1f} ms baseline")
     if platform != "cpu" and not cur["detail"].get("flash_on_hot_path", False):
         raise SystemExit("flash kernel fell off the hot path")
     pipe = cur["detail"].get("pipeline") or {}
@@ -73,6 +104,23 @@ def main():
     if ratio < 1 - TOLERANCE:
         raise SystemExit(
             f"REGRESSION: {ratio:.3f}x is below the {1 - TOLERANCE:.2f} gate")
+    obs = (cur["detail"] or {}).get("observability") or {}
+    tr, sv = obs.get("train") or {}, obs.get("serving") or {}
+    if tr or sv:
+        print(f"observability overhead: train "
+              f"{tr.get('overhead_frac')} serving {sv.get('overhead_frac')} "
+              f"(gates <2%: {tr.get('overhead_lt_2pct')}/"
+              f"{sv.get('overhead_lt_2pct')}); losses_bit_equal="
+              f"{tr.get('losses_bit_equal')} retraces="
+              f"{sv.get('decode_retraces_after_warmup')}")
+        if tr.get("losses_bit_equal") is False:
+            raise SystemExit(
+                "OBSERVABILITY REGRESSION: step telemetry changed the "
+                "training losses")
+        if sv.get("decode_retraces_after_warmup"):
+            raise SystemExit(
+                "OBSERVABILITY REGRESSION: instrumented decode recompiled "
+                "after warmup")
     print("bench regression gate passed")
 
 

@@ -28,15 +28,22 @@ assert not xla_bridge._backends, "import paddle_tpu initialized the XLA backend"
 print("ok: lazy backend")
 EOF
 
+echo "== [1b] observability plane (not slow) =="
+# the instrument every other gate reads from is verified FIRST: metrics
+# registry exposition, trace-id propagation, step telemetry, event journal
+python -m pytest tests/test_observability.py -q -m "not slow"
+
 if [ "$TIER" = "quick" ]; then
   echo "== [2] unit suite (quick tier) =="
-  python -m pytest tests/ -q -m "not slow"
+  # [1b] already ran the observability module; don't pay its two XLA
+  # compiles twice per CI run
+  python -m pytest tests/ -q -m "not slow" --ignore=tests/test_observability.py
   echo "CI QUICK TIER PASSED"
   exit 0
 fi
 
 echo "== [2] unit suite (full) =="
-python -m pytest tests/ -q
+python -m pytest tests/ -q --ignore=tests/test_observability.py
 
 echo "== [3] multichip gate =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
