@@ -868,6 +868,214 @@ def _packing_probe():
     return None
 
 
+MOE_PROBE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import json, time
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.mesh import set_mesh
+from paddle_tpu.incubate.distributed.models.moe import MoELayer
+from paddle_tpu.incubate.distributed.models.moe.moe_layer import _route
+from paddle_tpu.incubate.distributed.models.moe.dropless import (
+    _dropless_moe, ragged_layout)
+from paddle_tpu.ops.pallas.grouped_matmul import (
+    expected_visit_counts, grouped_matmul_visit_counts, pick_block_rows)
+
+# SKEWED routing corpus: ~45% of the tokens lie along the gate's
+# expert-0 direction, so one expert absorbs almost half the load —
+# exactly where fixed-capacity dispatch must choose between padding
+# waste (cf sized for the hot expert) and silent drops (cf=1.25).
+# N/d/h sized so the expert matmuls dominate the dispatch bookkeeping.
+N, D, H, E, K = 4096, 256, 512, 8, 2
+SKEW_FRAC, SKEW_MAG = 0.45, 4.0
+ITERS, WARM = 5, 2
+set_mesh(None)
+rs = np.random.RandomState(0)
+x_np = rs.randn(N, D).astype(np.float32)
+
+
+def mk(dispatch, cf):
+    paddle.seed(0)
+    m = MoELayer(d_model=D, num_expert=E, d_hidden=H, top_k=K,
+                 capacity_factor=cf, gate="naive", dispatch=dispatch)
+    m.eval()
+    return m
+
+
+# every arm is seeded identically, so the probe layer's gate weights ARE
+# each arm's gate weights; push part of the corpus along expert 0's
+# gate direction to create the imbalance
+_gw0 = np.array(mk("dropless", 1.25).gate.gate_weight._value)[:, 0]
+_gw0 = _gw0 / max(float(np.linalg.norm(_gw0)), 1e-6)
+_hot = rs.rand(N) < SKEW_FRAC
+x_np[_hot] += (SKEW_MAG * _gw0).astype(np.float32)
+
+
+def timed(fn, x):
+    out = jax.block_until_ready(fn(x))
+    for _ in range(WARM - 1):
+        jax.block_until_ready(fn(x))
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = jax.block_until_ready(fn(x))
+    dt = (time.perf_counter() - t0) / ITERS
+    return N / dt, out
+
+
+def layer_fn(m):
+    return jax.jit(lambda xv: m(Tensor(xv))._value)
+
+
+# routing stats of the skewed corpus (drive capacity sizing honestly)
+probe = mk("dropless", 1.25)
+logits = np.asarray(probe.gate(Tensor(jnp.asarray(x_np)))._value)
+_, topi, _ = _route(jnp.asarray(logits, jnp.float32), jax.random.key(0),
+                    k=K, routing=(("kind", "naive"),))
+counts = np.bincount(np.asarray(topi).reshape(-1), minlength=E)
+max_share = counts.max() / counts.sum()
+# capacity factor that fits the hottest expert => ZERO drops (the
+# apples-to-apples same-quality baseline): C >= max_count
+cf_dropfree = float(np.ceil(counts.max() * E / (K * N) * 100) / 100) + 0.01
+
+arms = {}
+m_drop = mk("dropless", 1.25)
+tps, _ = timed(layer_fn(m_drop), jnp.asarray(x_np))
+m_drop(Tensor(jnp.asarray(x_np)))  # eager: publish stats/registry
+arms["dropless"] = {
+    "tokens_per_sec": round(tps, 1),
+    "dropped_tokens": float(m_drop.tokens_dropped),
+    "expert_tokens": [float(c) for c in np.asarray(m_drop.expert_counts._value)],
+    "aux_loss": float(m_drop.l_aux),
+}
+
+m_capf = mk("capacity", cf_dropfree)
+tps, _ = timed(layer_fn(m_capf), jnp.asarray(x_np))
+m_capf(Tensor(jnp.asarray(x_np)))
+arms["capacity_dropfree"] = {
+    "tokens_per_sec": round(tps, 1),
+    "capacity_factor": cf_dropfree,
+    "dropped_tokens": float(m_capf.tokens_dropped),
+}
+
+m_cap = mk("capacity", 1.25)
+tps, _ = timed(layer_fn(m_cap), jnp.asarray(x_np))
+m_cap(Tensor(jnp.asarray(x_np)))
+arms["capacity_1.25"] = {
+    "tokens_per_sec": round(tps, 1),
+    "dropped_tokens": float(m_cap.tokens_dropped),
+    "dropped_frac": round(float(m_cap.tokens_dropped) / (N * K), 4),
+}
+
+# FLOP-matched dense baseline: one MLP with k*H hidden (the FLOPs a top-k
+# token actually receives), same d_model
+paddle.seed(0)
+w1 = jnp.asarray(rs.randn(D, K * H).astype(np.float32) * 0.02)
+w2 = jnp.asarray(rs.randn(K * H, D).astype(np.float32) * 0.02)
+dense = jax.jit(lambda xv: jax.nn.gelu(xv @ w1) @ w2)
+tps, _ = timed(dense, jnp.asarray(x_np))
+arms["dense_flop_matched"] = {"tokens_per_sec": round(tps, 1)}
+
+# block-visit sparsity: the grouped-matmul kernels visit exactly the
+# (row-block, expert) tiles the shared predicate admits
+bm = pick_block_rows(N * K, E)
+gids = jnp.where(topi.reshape(-1) >= 0, topi.reshape(-1), E).astype(jnp.int32)
+_, _, _, gbuf, _ = ragged_layout(gids, E, bm)
+vc = np.asarray(grouped_matmul_visit_counts(gbuf, E, bm, interpret=True))
+ev = expected_visit_counts(np.asarray(gbuf), E, bm)
+blocks = gbuf.shape[0] // bm
+visit = {
+    "block_rows": bm,
+    "blocks": int(blocks),
+    "visited_tiles": int(vc.sum()),
+    "total_tiles": int(blocks * E),
+    "visited_frac": round(float(vc.sum()) / (blocks * E), 4),
+    "counts_match_predicate": bool(np.array_equal(vc, ev)),
+}
+
+# gradient parity: dropless path vs an eager dense-masked MoE reference
+# (every expert over every token, one-hot combined) on a small problem
+n2, d2, h2, e2 = 256, 32, 64, 4
+x2 = jnp.asarray(rs.randn(n2, d2).astype(np.float32))
+g2 = jnp.asarray(rs.randn(n2, e2).astype(np.float32))
+w1s = jnp.asarray(rs.randn(e2, d2, h2).astype(np.float32) * 0.05)
+b1s = jnp.zeros((e2, 1, h2), jnp.float32)
+w2s = jnp.asarray(rs.randn(e2, h2, d2).astype(np.float32) * 0.05)
+b2s = jnp.zeros((e2, 1, d2), jnp.float32)
+key_bits = jax.random.key_data(jax.random.key(0))
+
+
+def f_dropless(w1v):
+    out, _, _, _ = _dropless_moe(
+        x2, g2, key_bits, w1v, b1s, w2s, b2s, E=e2, k=2, act="gelu",
+        ep=1, ep_axis=None, token_axes=(), other_axes=(),
+        routing=(("kind", "naive"),))
+    return jnp.sum(jnp.sin(out))
+
+
+def f_dense(w1v):
+    topv, topi_, _ = _route(g2, jax.random.key(0), k=2,
+                            routing=(("kind", "naive"),))
+    hh = jax.nn.gelu(jnp.einsum("nd,edh->neh", x2, w1v) + b1s[:, 0])
+    yy = jnp.einsum("neh,ehd->ned", hh, w2s) + b2s[:, 0]
+    oh = jax.nn.one_hot(topi_, e2) * topv[..., None]
+    out = jnp.einsum("nke,ned->nd", oh, yy)
+    return jnp.sum(jnp.sin(out))
+
+
+gd = jax.grad(f_dropless)(w1s)
+gr = jax.grad(f_dense)(w1s)
+gerr = float(jnp.max(jnp.abs(gd - gr)))
+grads = {"dw1_max_err_vs_dense_masked": gerr, "parity": bool(gerr < 1e-4)}
+
+speedup_vs_capacity = round(arms["dropless"]["tokens_per_sec"]
+                            / arms["capacity_dropfree"]["tokens_per_sec"], 3)
+et = np.asarray(arms["dropless"]["expert_tokens"], np.float64)
+out = {
+    "geometry": {"tokens": N, "d_model": D, "d_hidden": H, "experts": E,
+                 "top_k": K},
+    "skew": {"max_expert_share": round(float(max_share), 4),
+             "routed_counts": [int(c) for c in counts]},
+    "arms": arms,
+    "dropless_speedup_vs_dropfree_capacity": speedup_vs_capacity,
+    "load_balance": {
+        "imbalance_max_over_mean": round(float(et.max() / et.mean()), 3),
+        "aux_loss": arms["dropless"]["aux_loss"],
+    },
+    "block_visits": visit,
+    "grads": grads,
+}
+print("MOE_JSON " + json.dumps(out))
+"""
+
+
+def _moe_probe():
+    """Dropless-MoE probe on CPU: dropless vs capacity (drop-free sized and
+    cf=1.25) vs FLOP-matched dense tokens/sec on a skewed routing corpus,
+    load-balance stats, grouped-matmul block-visit sparsity cross-checked
+    against the shared predicate, and grads parity vs a dense-masked
+    reference (MOE_JSON)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        res = subprocess.run([sys.executable, "-c", MOE_PROBE],
+                             capture_output=True, text=True, timeout=600,
+                             env=env)
+        for line in res.stdout.splitlines():
+            if line.startswith("MOE_JSON "):
+                return json.loads(line[len("MOE_JSON "):])
+        print(f"moe probe produced no result; stderr tail:\n"
+              f"{res.stderr[-800:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"moe probe failed: {e!r}", file=sys.stderr)
+    return None
+
+
 def _input_pipeline_probe():
     """Feeder/async-dispatch probe on CPU: steady-state step time with the
     DeviceFeeder + deferred loss reads must be ~max(compute, host) instead of
@@ -2478,6 +2686,7 @@ def main():
     pipe = _pipeline_overhead()
     input_pipe = _input_pipeline_probe()
     packing = _packing_probe()
+    moe = _moe_probe()
     zero3 = _zero3_probe()
     lowp = _low_precision_probe()
     ckpt = _checkpointing_probe()
@@ -2520,11 +2729,41 @@ def main():
             reg.gauge("bench_serving_p99_ms",
                       "continuous-batching per-token p99 from true "
                       "arrival").set(float(p99))
+    if moe:
+        # the MoE arm's numbers land in the registry like every other
+        # bench instrument; the snapshot is what bench_regression gates
+        arms_m = moe["arms"]
+        reg.gauge("bench_moe_dropless_tokens_per_sec",
+                  "dropless-dispatch MoE forward throughput on the "
+                  "skewed bench corpus").set(
+            arms_m["dropless"]["tokens_per_sec"])
+        reg.gauge("bench_moe_capacity_tokens_per_sec",
+                  "capacity-dispatch (drop-free sized) MoE forward "
+                  "throughput on the same corpus").set(
+            arms_m["capacity_dropfree"]["tokens_per_sec"])
+        reg.gauge("bench_moe_dropless_dropped_tokens",
+                  "tokens dropped by the dropless arm (must be 0)").set(
+            arms_m["dropless"]["dropped_tokens"])
+        reg.gauge("bench_moe_block_visit_frac",
+                  "fraction of (row-block, expert) tiles the grouped "
+                  "matmul visits").set(moe["block_visits"]["visited_frac"])
+        reg.gauge("bench_moe_imbalance_max_over_mean",
+                  "per-expert load imbalance of the skewed corpus").set(
+            moe["load_balance"]["imbalance_max_over_mean"])
+        reg.gauge("bench_moe_aux_loss", "load-balance aux loss (bench arm)").set(
+            moe["load_balance"]["aux_loss"])
     snap = reg.snapshot()
     metrics_snapshot = {
         name: snap[name]["samples"][0]["value"]
         for name in ("bench_tokens_per_sec_per_chip", "bench_mfu",
-                     "bench_serving_p99_ms") if name in snap}
+                     "bench_serving_p99_ms",
+                     "bench_moe_dropless_tokens_per_sec",
+                     "bench_moe_capacity_tokens_per_sec",
+                     "bench_moe_dropless_dropped_tokens",
+                     "bench_moe_block_visit_frac",
+                     "bench_moe_imbalance_max_over_mean",
+                     "bench_moe_aux_loss")
+        if name in snap}
     metrics_snapshot["mfu_source"] = mfu_source
 
     print(json.dumps({
@@ -2551,6 +2790,7 @@ def main():
                    "pipeline": pipe,
                    "input_pipeline": input_pipe,
                    "packing": packing,
+                   "moe": moe,
                    "zero3_sharding": zero3,
                    "low_precision": lowp,
                    "checkpointing": ckpt,

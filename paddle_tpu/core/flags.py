@@ -107,6 +107,21 @@ define_flag("use_fused_head_loss", True,
 define_flag("fused_ce_chunk_tokens", 0, "fused-CE token chunk override (0 = auto ~4M-element tiles)", type=int)
 define_flag("fused_ce_chunk_vocab", 0, "fused-CE vocab chunk override (0 = auto)", type=int)
 define_flag("fused_ce_variant", "auto", "fused-CE strategy: auto|tokens|vocab|pallas")
+define_flag("moe_dispatch", "capacity",
+            "default MoELayer dispatch mode, consulted when the layer is "
+            "constructed with dispatch=None: 'capacity' (fixed [E, C, d] "
+            "buckets, overflow tokens dropped and counted) or 'dropless' "
+            "(sort-based ragged dispatch through the Pallas grouped "
+            "matmul — no capacity, no drops; docs/moe.md)")
+define_flag("moe_block_rows", 0,
+            "grouped-matmul row-block size of the dropless MoE dispatch "
+            "(0 = auto: 128 stepping down for tiny problems); expert "
+            "bucket starts are aligned to this, so it is also the "
+            "per-expert padding granularity", type=int)
+define_flag("moe_gmm_backend", "auto",
+            "grouped-matmul backend: auto|pallas|xla — auto runs the "
+            "Pallas kernel on TPU (or under force_interpret()) and the "
+            "block-gather XLA fallback elsewhere")
 define_flag("scan_layers", False,
             "run homogeneous decoder stacks as ONE lax.scan over layer-stacked "
             "params (O(1)-in-depth HLO size and compile time)")

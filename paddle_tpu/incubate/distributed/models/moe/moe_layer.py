@@ -6,15 +6,29 @@ with `MoEScatter`/`MoEGather` PyLayers (:99/:149) and gates
 `global_scatter`/`global_gather` (distributed/utils/moe_utils.py:20, CUDA ops
 fluid/operators/collective/global_scatter_op.cu).
 
-TPU-native design: SPARSE fixed-capacity dispatch. Tokens are scatter-added
-into per-expert capacity buckets ([E, C, d] — O(E*C*d) memory, never the
-[N, E, C] one-hot dispatch mask), exchanged with the expert owners via
-`lax.all_to_all` over the "ep" mesh axis inside shard_map (the reference's
-global_scatter/global_gather), run through the BATCHED expert FFNs (weights
-[E_local, d, h], one einsum on the MXU), and returned by the inverse
-all_to_all + gather-combine. Capacities stay static for XLA; overflow tokens
-are dropped and counted (`tokens_dropped`). Aux (load-balance) loss follows
-GShard.
+TPU-native design, two dispatch modes:
+
+* ``dispatch="capacity"`` — SPARSE fixed-capacity dispatch. Tokens are
+  scatter-added into per-expert capacity buckets ([E, C, d] — O(E*C*d)
+  memory, never the [N, E, C] one-hot dispatch mask), exchanged with the
+  expert owners via `lax.all_to_all` over the "ep" mesh axis inside
+  shard_map (the reference's global_scatter/global_gather), run through the
+  BATCHED expert FFNs (weights [E_local, d, h], one einsum on the MXU), and
+  returned by the inverse all_to_all + gather-combine. Capacities stay
+  static for XLA; overflow tokens are dropped and COUNTED
+  (`tokens_dropped`, the `moe_dropped_tokens_total` registry counter).
+* ``dispatch="dropless"`` — sort-based capacity-free dispatch (dropless.py,
+  docs/moe.md): argsort tokens by expert into block-aligned ragged buckets,
+  run the Pallas grouped matmul over exactly the routed rows, unpermute and
+  combine with the gate weights in fp32. No capacity, no drops, zero
+  retraces across load shifts; supports token-choice and expert-choice
+  routing (``router=``) and a dense shared-expert branch scheduled to
+  overlap the ep all_to_alls (``shared_expert_hidden=``).
+
+Aux (load-balance) loss follows GShard. Per-expert token counts, the aux
+loss and the dropped-token count are published to the observability
+registry after every eager forward (`last_stats`); compiled steps surface
+the same numbers through CompiledTrainStep's step telemetry.
 """
 from __future__ import annotations
 
@@ -177,7 +191,7 @@ def _sparse_moe(xv, gv, rng, w1, b1, w2, b2, *, E, k, cf, act,
     lax.all_to_all over `ep_axis` to/from the expert owners (reference
     global_scatter/global_gather). `routing`/`cap_rate` carry the gate's
     semantics (see _route / NaiveGate.cap_rate).
-    Returns (out [N, d], l_aux, dropped)."""
+    Returns (out [N, d], l_aux, dropped, counts [E])."""
     N, d = xv.shape
     C = max(1, int(math.ceil(cf * k * N / E)))
 
@@ -202,6 +216,10 @@ def _sparse_moe(xv, gv, rng, w1, b1, w2, b2, *, E, k, cf, act,
         limit = min(C, max(1, int(math.ceil(cap_rate * N))))
     valid = chosen & (pos >= 0) & (pos < limit)
     dropped = jnp.sum((chosen & ~valid).astype(jnp.float32))
+    # per-expert PROCESSED token counts (valid selections only) — the
+    # load-balance telemetry the registry/bench surface
+    counts = jnp.zeros((E,), jnp.float32).at[jnp.clip(flat_e, 0, E - 1)].add(
+        valid.astype(jnp.float32))
     dest = (jnp.clip(flat_e, 0, E - 1) * C
             + jnp.clip(pos, 0, C - 1))                              # [N*k]
 
@@ -235,21 +253,23 @@ def _sparse_moe(xv, gv, rng, w1, b1, w2, b2, *, E, k, cf, act,
     yp = ybuf[dest] * w[:, None]                                    # [N*k, d]
     out = jnp.sum(yp.reshape(N, k, d), axis=1)
 
-    # GShard load-balance aux loss over this rank's tokens
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.float32), axis=1), axis=0)
-    l_aux = jnp.sum(me * ce) * E
+    # GShard load-balance aux loss over this rank's tokens + the shared
+    # stat-reduction convention (dropless.py — ONE implementation, the
+    # dropless==capacity parity contract depends on it)
+    from paddle_tpu.incubate.distributed.models.moe.dropless import (
+        _gshard_aux, _reduce_stats)
 
-    if token_axes:
-        dropped = jax.lax.psum(dropped, token_axes)
-        l_aux = jax.lax.pmean(l_aux, token_axes)
-    if other_axes:
-        dropped = jax.lax.pmean(dropped, other_axes)
-        l_aux = jax.lax.pmean(l_aux, other_axes)
-    return out, l_aux.astype(xv.dtype), dropped
+    l_aux, dropped, counts = _reduce_stats(_gshard_aux(probs, topi, E),
+                                           dropped, counts,
+                                           token_axes, other_axes)
+    return out, l_aux.astype(xv.dtype), dropped, counts
 
 
 from paddle_tpu.distributed.mesh import shard_map_compat as _shard_map  # noqa: E402
+
+import itertools as _itertools  # noqa: E402
+
+_LAYER_SEQ = _itertools.count()
 
 
 class MoELayer(Layer):
@@ -262,9 +282,22 @@ class MoELayer(Layer):
 
     def __init__(self, d_model, experts=None, gate=None, moe_group=None, mp_group=None,
                  recompute_interval=0, num_expert=None, d_hidden=None, top_k=2,
-                 capacity_factor=1.25, **kwargs):
+                 capacity_factor=1.25, dispatch=None, router="token",
+                 shared_expert_hidden=0, **kwargs):
         super().__init__()
+        from paddle_tpu.core.flags import flag
+
         self.d_model = d_model
+        self.dispatch = dispatch or flag("moe_dispatch")
+        if self.dispatch not in ("capacity", "dropless"):
+            raise ValueError(
+                f"dispatch={self.dispatch!r}: 'capacity' or 'dropless'")
+        if router not in ("token", "expert"):
+            raise ValueError(f"router={router!r}: 'token' or 'expert'")
+        if router == "expert" and self.dispatch != "dropless":
+            raise ValueError("expert-choice routing requires the dropless "
+                             "dispatch (it has no capacity buckets)")
+        self.router = router
         if isinstance(experts, ExpertFFN):
             self.experts = experts
             num_expert = experts.num_expert
@@ -288,8 +321,35 @@ class MoELayer(Layer):
             self.top_k = 1
         else:
             self.gate = gate
+        # dense shared-expert branch (applied to EVERY token, scheduled to
+        # overlap the ep all_to_all in the dropless body — docs/moe.md)
+        self.shared_expert_hidden = int(shared_expert_hidden)
+        if self.shared_expert_hidden:
+            hs = self.shared_expert_hidden
+            self.shared_w1 = self.create_parameter(
+                [d_model, hs], None, default_initializer=I.XavierNormal())
+            self.shared_b1 = self.create_parameter([hs], None, is_bias=True)
+            self.shared_w2 = self.create_parameter(
+                [hs, d_model], None, default_initializer=I.XavierNormal())
+            self.shared_b2 = self.create_parameter([d_model], None,
+                                                   is_bias=True)
         self.l_aux = None
         self.tokens_dropped = None
+        self.expert_counts = None
+        # stable per-process tag so models with several MoE blocks report
+        # distinct registry series instead of overwriting one another
+        self._layer_tag = str(next(_LAYER_SEQ))
+        import threading
+
+        self._last_stats = None
+        self._pending = None        # (l_aux, counts) device arrays
+        # per-forward drop scalars queued AS-IS (device arrays from
+        # different forwards may live on different shardings — never add
+        # them to each other) and folded to host at materialize; the lock
+        # serializes forwards against concurrent /metrics scrapes
+        self._pending_drops = []
+        self._stats_lock = threading.Lock()
+        self._collector_registered = False
         self._spmd_cache = {}
 
     def _dispatch_plan(self, n_tokens):
@@ -329,11 +389,42 @@ class MoELayer(Layer):
             cap_rate = self.gate.cap_rate(training)
         return routing, cap_rate
 
+    def _body_fn(self, *, E, k, ep, tok_axes, other_axes, routing, cap_rate,
+                 rng_axes=None):
+        """The dispatch body for the configured mode, partial-applied with
+        every static. All three bodies share one signature and the
+        (out, l_aux, dropped, counts) return contract."""
+        from paddle_tpu.incubate.distributed.models.moe.dropless import (
+            _dropless_moe, _expert_choice_moe)
+
+        common = dict(E=E, k=k, act=self.experts.act, ep=ep,
+                      ep_axis=EP_AXIS if ep > 1 else None,
+                      token_axes=tok_axes, other_axes=other_axes,
+                      routing=routing, rng_axes=rng_axes)
+        if self.dispatch == "dropless":
+            body = (_expert_choice_moe if self.router == "expert"
+                    else _dropless_moe)
+            return partial(body, **common)
+        return partial(_sparse_moe, cf=self.capacity_factor,
+                       cap_rate=cap_rate, **common)
+
+    def _shared_vals(self):
+        if not self.shared_expert_hidden:
+            return ()
+        return (self.shared_w1, self.shared_b1, self.shared_w2,
+                self.shared_b2)
+
     def _spmd_fn(self, mesh, ep, tok_axes, n_tokens, E, k, routing, cap_rate):
         """Build (and cache) the jitted shard_map dispatch program — rebuilt
         per forward it would retrace every step."""
+        from paddle_tpu.core.flags import flag
+
+        # the dropless body reads these flags at TRACE time, so they are
+        # part of the cached program's identity
         key = (mesh, ep, tok_axes, n_tokens, E, k, self.capacity_factor,
-               routing, cap_rate)
+               routing, cap_rate, self.dispatch, self.router,
+               self.shared_expert_hidden, int(flag("moe_block_rows")),
+               flag("moe_gmm_backend"))
         cached = self._spmd_cache.get(key)
         if cached is not None:
             return cached
@@ -341,15 +432,19 @@ class MoELayer(Layer):
         from jax.sharding import PartitionSpec as P
 
         other = tuple(a for a in mesh.axis_names if a not in tok_axes)
-        body = partial(_sparse_moe, E=E, k=k, cf=self.capacity_factor,
-                       act=self.experts.act, ep=ep, ep_axis=EP_AXIS,
-                       token_axes=tok_axes, other_axes=other,
-                       routing=routing, cap_rate=cap_rate)
+        body = self._body_fn(E=E, k=k, ep=ep, tok_axes=tok_axes,
+                             other_axes=other, routing=routing,
+                             cap_rate=cap_rate)
         tok_spec = P(tok_axes, None)
         w_spec = P(EP_AXIS, None, None)
         in_specs = (tok_spec, P(tok_axes, None), P(), w_spec, w_spec, w_spec,
                     w_spec)
-        out_specs = (tok_spec, P(), P())
+        if self.shared_expert_hidden and self.dispatch == "dropless":
+            # the shared-expert MLP is replicated (every rank runs the
+            # dense branch over its own tokens, inside the body so it
+            # overlaps the a2a)
+            in_specs = in_specs + (P(), P(), P(), P())
+        out_specs = (tok_spec, P(), P(), P())
         smapped = jax.jit(_shard_map(body, mesh, in_specs, out_specs))
 
         def fn(*vals):
@@ -387,18 +482,118 @@ class MoELayer(Layer):
             from paddle_tpu.distributed.collective import _bound_axes
             rng_axes = (_bound_axes(("dp", "sharding", "sep", EP_AXIS))
                         if mode == "bound" else ())
-            fn = partial(_sparse_moe, E=E, k=k,
-                         cf=self.capacity_factor, act=self.experts.act,
-                         ep=ep_eff, ep_axis=EP_AXIS if ep_eff > 1 else None,
-                         token_axes=(), other_axes=(),
-                         routing=routing, cap_rate=cap_rate,
-                         rng_axes=rng_axes)
+            fn = self._body_fn(E=E, k=k, ep=ep_eff, tok_axes=(),
+                               other_axes=(), routing=routing,
+                               cap_rate=cap_rate, rng_axes=rng_axes)
 
-        out, l_aux, dropped = apply_op(
+        shared = (self._shared_vals()
+                  if self.dispatch == "dropless" else ())
+        out, l_aux, dropped, counts = apply_op(
             fn, x2, logits, rng_bits,
-            self.experts.w1, self.experts.b1, self.experts.w2, self.experts.b2,
+            self.experts.w1, self.experts.b1, self.experts.w2,
+            self.experts.b2, *shared,
             name="moe_dispatch", rng_args=(2,),
         )
+        if self.shared_expert_hidden and self.dispatch == "capacity":
+            # capacity path: the dense shared branch rides outside the
+            # dispatch program (no a2a in eager scope to overlap with)
+            h = F.linear(x2, self.shared_w1) + self.shared_b1
+            h = F.gelu(h) if self.experts.act == "gelu" else F.relu(h)
+            out = out + (F.linear(h, self.shared_w2) + self.shared_b2)
         self.l_aux = l_aux
         self.tokens_dropped = dropped
+        self.expert_counts = counts
+        self._publish_stats(l_aux, dropped, counts)
         return out.reshape(orig_shape)
+
+    def _publish_stats(self, l_aux, dropped, counts):
+        """Queue per-expert load-balance telemetry for the observability
+        registry (docs/observability.md) — eager forwards only: under jit
+        the values are tracers and the numbers instead ride
+        CompiledTrainStep's step-telemetry vector. NO host sync here: the
+        device arrays are held (drops accumulate with one async device
+        add) and materialize at scrape time via a registry collector (the
+        PR-13 hot-path-pays-nothing idiom) or on `last_stats` reads."""
+        vals = [getattr(v, "_value", v) for v in (l_aux, dropped, counts)]
+        if any(isinstance(v, jax.core.Tracer) for v in vals):
+            return
+        with self._stats_lock:
+            self._pending = (vals[0], vals[2])
+            self._pending_drops.append(vals[1])
+            if len(self._pending_drops) >= 256:
+                # bound the queue on scrape-free runs: fold to one host
+                # float (the amortized 1/256 sync)
+                import numpy as np
+
+                total = float(sum(float(np.asarray(v))
+                                  for v in self._pending_drops))
+                self._pending_drops = [total]
+        if not self._collector_registered:
+            import weakref
+
+            from paddle_tpu.observability import metrics as obs_metrics
+
+            # close over a weakref (a bound method would pin the layer
+            # alive in the registry forever); the owner weakref drops the
+            # collector when the layer dies
+            wself = weakref.ref(self)
+
+            def _collect(reg):
+                s = wself()
+                if s is not None:
+                    s._materialize(reg)
+
+            obs_metrics.registry().add_collector(_collect, owner=self)
+            self._collector_registered = True
+
+    def _materialize(self, reg):
+        """Fold the pending device stats into the registry (scrape time /
+        last_stats reads). The read-and-clear runs under the stats lock so
+        a /metrics scrape racing a last_stats read can neither double-count
+        drops nor discard a concurrent forward's pending batch."""
+        import numpy as np
+
+        with self._stats_lock:
+            if self._pending is None and not self._pending_drops:
+                return
+            aux_dev, counts_dev = self._pending or (None, None)
+            dropped_v = float(sum(float(np.asarray(v))
+                                  for v in self._pending_drops))
+            self._pending = None
+            self._pending_drops = []
+        tag = self._layer_tag
+        reg.counter("moe_dropped_tokens_total",
+                    "tokens dropped by capacity-bucketed MoE dispatch "
+                    "(identically 0 on the dropless path)").inc(dropped_v)
+        if aux_dev is None:
+            return
+        aux_v = float(np.asarray(aux_dev))
+        counts_v = np.asarray(counts_dev, dtype=np.float64)
+        mean = float(counts_v.mean()) or 1.0
+        imbalance = float(counts_v.max()) / mean
+        reg.gauge("moe_aux_loss",
+                  "GShard load-balance aux loss of the last eager MoE "
+                  "forward", labels=("layer",)).labels(layer=tag).set(aux_v)
+        reg.gauge("moe_load_imbalance",
+                  "max/mean per-expert processed-token count of the last "
+                  "eager MoE forward",
+                  labels=("layer",)).labels(layer=tag).set(imbalance)
+        g = reg.gauge("moe_expert_tokens",
+                      "processed tokens per expert (last eager MoE "
+                      "forward)", labels=("layer", "expert"))
+        for e, c in enumerate(counts_v):
+            g.labels(layer=tag, expert=str(e)).set(float(c))
+        self._last_stats = {
+            "aux_loss": aux_v, "dropped_tokens": dropped_v,
+            "expert_tokens": counts_v.tolist(),
+            "imbalance_max_over_mean": imbalance,
+        }
+
+    @property
+    def last_stats(self):
+        """Stats dict of the most recent eager forward (materializes any
+        pending device values — the only place the host blocks)."""
+        from paddle_tpu.observability import metrics as obs_metrics
+
+        self._materialize(obs_metrics.registry())
+        return self._last_stats

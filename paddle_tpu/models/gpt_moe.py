@@ -30,6 +30,14 @@ class GptMoeConfig:
     max_position_embeddings: int = 2048
     moe_aux_loss_weight: float = 0.01
     dropout: float = 0.0
+    # dispatch mode: None reads FLAGS_moe_dispatch; "dropless" runs the
+    # sort-based ragged dispatch + Pallas grouped matmul (docs/moe.md)
+    moe_dispatch: str | None = None
+    # "token" (top-k gates) or "expert" (expert-choice routing)
+    moe_router: str = "token"
+    # >0 adds a dense shared-expert MLP per block, scheduled to overlap
+    # the ep all_to_all in the dropless body
+    shared_expert_hidden: int = 0
 
 
 def gpt_moe_tiny_config(**kw) -> GptMoeConfig:
@@ -56,7 +64,11 @@ class GptMoeBlock(nn.Layer):
         self.attn = LlamaAttention(attn_cfg)
         self.ln2 = nn.LayerNorm(config.hidden_size)
         self.moe = MoELayer(config.hidden_size, num_expert=config.num_experts,
-                            d_hidden=config.expert_hidden_size, top_k=config.top_k)
+                            d_hidden=config.expert_hidden_size,
+                            top_k=config.top_k,
+                            dispatch=config.moe_dispatch,
+                            router=config.moe_router,
+                            shared_expert_hidden=config.shared_expert_hidden)
 
     def forward(self, x):
         x = x + self.attn(self.ln1(x))

@@ -2,3 +2,6 @@ from paddle_tpu.ops.pallas.rmsnorm_kernel import rmsnorm  # noqa: F401
 from paddle_tpu.ops.pallas.fused_ce import (  # noqa: F401
     fused_linear_cross_entropy_loss, softmax_cross_entropy_loss,
 )
+from paddle_tpu.ops.pallas.grouped_matmul import (  # noqa: F401
+    expected_visit_counts, grouped_matmul, grouped_matmul_visit_counts,
+)

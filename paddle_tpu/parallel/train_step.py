@@ -487,6 +487,20 @@ class CompiledTrainStep:
         self._metric_keys = (["loss", "grad_norm", "skipped"]
                              + (["fp8_amax_max"]
                                 if self.fp8_policy != "none" else []))
+        # MoE models additionally report the summed load-balance aux loss
+        # and dropped-token count through the same packed vector (the
+        # layers' in-trace stats are read after the forward; under the
+        # legacy whole-loss remat region those tracers are scoped to the
+        # checkpoint, so collection is limited to remat-off steps)
+        self._moe_layers = []
+        if self._telemetry and not self.remat:
+            from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+            self._moe_layers = [
+                l for l in getattr(model, "sublayers", lambda: [])()
+                if isinstance(l, MoELayer)]
+        if self._moe_layers:
+            self._metric_keys += ["moe_aux", "moe_dropped"]
         self._pending_metrics: list = []
         self._last_metrics: dict | None = None
         self._prev_metric_wall: float | None = None
@@ -793,23 +807,38 @@ class CompiledTrainStep:
 
         trainable_idx = [i for i, t in enumerate(self._trainable) if t]
 
+        def moe_stats():
+            # summed MoE stats over the layers' freshly-set in-trace
+            # attributes (valid tracers of THIS forward)
+            aux = jnp.zeros((), jnp.float32)
+            dropped = jnp.zeros((), jnp.float32)
+            for l in self._moe_layers:
+                if l.l_aux is not None:
+                    aux = aux + l.l_aux._value.astype(jnp.float32)
+                if l.tokens_dropped is not None:
+                    dropped = (dropped
+                               + l.tokens_dropped._value.astype(jnp.float32))
+            return jnp.stack([aux, dropped])
+
         def loss_all(train_vals, fp8_s):
             full = list(param_vals)
             for i, v in zip(trainable_idx, train_vals):
                 full[i] = v
             loss = run_loss(full, fp8_s)
+            moe_vec = moe_stats() if self._moe_layers else None
             # float16 loss scaling happens INSIDE the differentiated fn so
             # the whole backward benefits; the aux output reports the
             # unscaled loss
             if scaling:
-                return loss * scaler_scale.astype(loss.dtype), loss
-            return loss, loss
+                return loss * scaler_scale.astype(loss.dtype), (loss,
+                                                                moe_vec)
+            return loss, (loss, moe_vec)
 
         train_vals = [param_vals[i] for i in trainable_idx]
         # the gradient of the loss w.r.t. the fp8 amax histories IS their
         # updated value (the fp8_dot custom-vjp's state-as-gradient
         # contract), so new_fp8 below is next step's state pytree
-        (_, loss), (grads, new_fp8) = jax.value_and_grad(
+        (_, (loss, moe_vec)), (grads, new_fp8) = jax.value_and_grad(
             loss_all, argnums=(0, 1), has_aux=True)(train_vals, fp8_in)
 
         found_inf = None
@@ -865,6 +894,8 @@ class CompiledTrainStep:
                 parts.append(
                     jnp.max(jnp.stack([jnp.max(l) for l in leaves]))
                     if leaves else jnp.zeros((), jnp.float32))
+            if self._moe_layers:
+                parts.extend([moe_vec[0], moe_vec[1]])
             step_metrics = jnp.stack(parts)
         new_params = list(param_vals)
         new_states = list(opt_states) if opt_states is not None else None

@@ -1,0 +1,327 @@
+"""Dropless (capacity-free) MoE dispatch: sort-based ragged buckets +
+grouped matmul (docs/moe.md).
+
+Covers: dispatch permutation round-trip (sort -> expert -> unsort is the
+identity on payloads), dropless-vs-capacity loss equality when nothing
+overflows, output/grads parity vs an eager dense-masked MoE reference,
+the zero-retrace guard across batches with different expert loads,
+expert-choice routing, the shared-expert branch, the per-expert telemetry
+satellites, and (slow) the ep=4 shard_map a2a path + CompiledTrainStep
+composition with zero_axis and step telemetry.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+from paddle_tpu.incubate.distributed.models.moe import MoELayer
+from paddle_tpu.incubate.distributed.models.moe.dropless import (
+    ragged_layout,
+)
+from paddle_tpu.incubate.distributed.models.moe.moe_layer import _route
+from paddle_tpu.observability import metrics as obs_metrics
+
+
+@pytest.fixture(autouse=True)
+def _no_mesh():
+    set_mesh(None)
+    yield
+    set_mesh(None)
+
+
+def _mk(dispatch="dropless", E=8, d=32, h=64, k=2, cf=16.0, gate="naive",
+        **kw):
+    paddle.seed(0)
+    return MoELayer(d_model=d, num_expert=E, d_hidden=h, top_k=k,
+                    capacity_factor=cf, gate=gate, dispatch=dispatch, **kw)
+
+
+def _x(n=64, d=32, seed=0):
+    return np.random.RandomState(seed).randn(n, d).astype(np.float32)
+
+
+def _dense_masked_forward(moe, xv):
+    """Eager dense-masked MoE reference: EVERY expert over EVERY token,
+    one-hot combined with the renormalized top-k gate weights."""
+    logits = jnp.asarray(
+        np.asarray(moe.gate(Tensor(jnp.asarray(xv)))._value), jnp.float32)
+    topv, topi, _ = _route(logits, jax.random.key(0), k=moe.top_k,
+                           routing=(("kind", "naive"),))
+    w1 = moe.experts.w1._value
+    b1 = moe.experts.b1._value
+    w2 = moe.experts.w2._value
+    b2 = moe.experts.b2._value
+    hh = jax.nn.gelu(jnp.einsum("nd,edh->neh", jnp.asarray(xv), w1)
+                     + b1[:, 0])
+    yy = jnp.einsum("neh,ehd->ned", hh, w2) + b2[:, 0]
+    oh = jax.nn.one_hot(topi, moe.num_expert) * topv[..., None]
+    return jnp.einsum("nke,ned->nd", oh, yy)
+
+
+class TestRaggedLayout:
+    def test_round_trip_is_identity_on_payloads(self):
+        """sort -> scatter into ragged buckets -> gather -> unsort must
+        return every routed payload exactly."""
+        rs = np.random.RandomState(0)
+        E, bm, Nk = 6, 8, 96
+        gids = jnp.asarray(rs.randint(0, E + 1, Nk), jnp.int32)
+        order, rank, dest, gbuf, counts = ragged_layout(gids, E, bm)
+        payload = jnp.asarray(rs.randn(Nk, 4), jnp.float32)
+        buf = jnp.zeros((gbuf.shape[0], 4), jnp.float32).at[dest].set(
+            jnp.take(payload, order, axis=0))
+        back = jnp.zeros_like(payload).at[order].set(
+            jnp.take(buf, dest, axis=0))
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(payload))
+
+    def test_buckets_are_block_aligned_and_counted(self):
+        rs = np.random.RandomState(1)
+        E, bm, Nk = 4, 8, 64
+        gids_np = rs.randint(0, E, Nk).astype(np.int32)
+        order, rank, dest, gbuf, counts = ragged_layout(
+            jnp.asarray(gids_np), E, bm)
+        np.testing.assert_array_equal(
+            np.asarray(counts), np.bincount(gids_np, minlength=E))
+        # every block holds rows of ONE group (gid or padding)
+        gb = np.asarray(gbuf).reshape(-1, bm)
+        for row in gb:
+            real = row[row < E]
+            assert np.unique(real).size <= 1
+        # sorted buffer ids are non-decreasing over real rows
+        flat = np.asarray(gbuf)
+        real = flat[flat < E]
+        assert (np.diff(real) >= 0).all()
+
+
+class TestDroplessParity:
+    def test_equals_capacity_when_nothing_overflows(self):
+        """With capacity high enough that the capacity path drops nothing,
+        the two dispatch modes compute the same function."""
+        x = _x(4 * 16).reshape(4, 16, 32)
+        mc = _mk("capacity")
+        md = _mk("dropless")
+        oc = np.asarray(mc(paddle.to_tensor(x))._value)
+        od = np.asarray(md(paddle.to_tensor(x))._value)
+        assert float(mc.tokens_dropped) == 0
+        assert float(md.tokens_dropped) == 0
+        np.testing.assert_allclose(od, oc, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(float(md.l_aux), float(mc.l_aux),
+                                   rtol=1e-5)
+
+    def test_matches_dense_masked_reference(self):
+        moe = _mk()
+        x = _x()
+        out = np.asarray(moe(paddle.to_tensor(x))._value)
+        ref = np.asarray(_dense_masked_forward(moe, x))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_dense_masked_reference(self):
+        x = _x()
+        moe = _mk()
+        xt = paddle.to_tensor(x, stop_gradient=False)
+        moe(xt).sum().backward()
+        got_w1 = np.asarray(moe.experts.w1.grad._value)
+        got_x = np.asarray(xt.grad._value)
+
+        ref = _mk()
+
+        def loss(w1v, xv):
+            logits = xv @ ref.gate.gate_weight._value
+            topv, topi, _ = _route(logits.astype(jnp.float32),
+                                   jax.random.key(0), k=2,
+                                   routing=(("kind", "naive"),))
+            hh = jax.nn.gelu(jnp.einsum("nd,edh->neh", xv, w1v)
+                             + ref.experts.b1._value[:, 0])
+            yy = (jnp.einsum("neh,ehd->ned", hh, ref.experts.w2._value)
+                  + ref.experts.b2._value[:, 0])
+            oh = jax.nn.one_hot(topi, 8) * topv[..., None]
+            return jnp.sum(jnp.einsum("nke,ned->nd", oh, yy))
+
+        dw1, dx = jax.grad(loss, (0, 1))(ref.experts.w1._value,
+                                         jnp.asarray(x))
+        np.testing.assert_allclose(got_w1, np.asarray(dw1),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got_x, np.asarray(dx),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bf16_close_to_fp32(self):
+        moe = _mk()
+        x = _x()
+        o32 = np.asarray(moe(paddle.to_tensor(x))._value)
+        ob = moe(Tensor(jnp.asarray(x, jnp.bfloat16)))
+        assert ob._value.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(ob._value, dtype=np.float32), o32,
+            rtol=1e-1, atol=1e-1)
+
+    def test_zero_retrace_across_varying_expert_loads(self):
+        """Every shape in the dropless program is static — batches with
+        wildly different routing distributions must share ONE trace."""
+        moe = _mk()
+        traces = [0]
+
+        def fwd(xv):
+            traces[0] += 1
+            return moe(Tensor(xv))._value
+
+        jf = jax.jit(fwd)
+        rs = np.random.RandomState(1)
+        for i in range(5):
+            xi = (rs.randn(64, 32) * (1 + i) + 3 * i).astype(np.float32)
+            jf(xi).block_until_ready()
+        assert traces[0] == 1
+
+    def test_gshard_random_routing_rides_trash_bucket(self):
+        """GShard's random second-expert drop (-1 selections) must flow
+        through the dropless layout as zero-weight trash rows."""
+        moe = _mk(gate="gshard", cf=16.0)
+        moe.train()
+        paddle.seed(7)
+        out = moe(paddle.to_tensor(_x()))
+        assert np.isfinite(np.asarray(out._value)).all()
+        # routing drops are intentional, NOT capacity drops
+        assert float(moe.tokens_dropped) == 0
+
+
+class TestExpertChoice:
+    def test_balanced_by_construction(self):
+        moe = _mk(router="expert")
+        out = moe(paddle.to_tensor(_x()))
+        assert tuple(out.shape) == (64, 32)
+        counts = np.asarray(moe.expert_counts._value)
+        assert (counts == counts[0]).all()  # every expert exactly C tokens
+        assert float(moe.tokens_dropped) == 0
+        assert float(moe.l_aux) == 0.0  # balanced: no aux needed
+
+    def test_grads_flow(self):
+        moe = _mk(router="expert")
+        xt = paddle.to_tensor(_x(), stop_gradient=False)
+        moe(xt).sum().backward()
+        assert float(np.abs(np.asarray(moe.experts.w1.grad._value)).sum()) > 0
+        assert float(np.abs(np.asarray(
+            moe.gate.gate_weight.grad._value)).sum()) > 0
+
+    def test_requires_dropless(self):
+        with pytest.raises(ValueError, match="expert-choice"):
+            _mk("capacity", router="expert")
+
+
+class TestSharedExpert:
+    def test_changes_output_and_gets_grads(self):
+        x = _x()
+        base = _mk()
+        withsh = _mk(shared_expert_hidden=16)
+        ob = np.asarray(base(paddle.to_tensor(x))._value)
+        xt = paddle.to_tensor(x, stop_gradient=False)
+        osh = withsh(xt)
+        assert np.abs(np.asarray(osh._value) - ob).max() > 1e-6
+        osh.sum().backward()
+        assert float(np.abs(np.asarray(
+            withsh.shared_w1.grad._value)).sum()) > 0
+
+    def test_capacity_path_supports_shared_branch_too(self):
+        moe = _mk("capacity", shared_expert_hidden=16)
+        out = moe(paddle.to_tensor(_x()))
+        assert np.isfinite(np.asarray(out._value)).all()
+
+
+class TestTelemetry:
+    def test_eager_forward_publishes_registry_stats(self):
+        reg = obs_metrics.registry()
+        reg.reset()
+        moe = _mk()
+        moe(paddle.to_tensor(_x()))
+        snap = reg.snapshot()
+        assert snap["moe_dropped_tokens_total"]["samples"][0]["value"] == 0
+        aux_s = snap["moe_aux_loss"]["samples"][0]
+        assert aux_s["value"] > 0
+        # per-layer tag: several MoE blocks must not overwrite one series
+        assert aux_s["labels"]["layer"] == moe._layer_tag
+        per_expert = {s["labels"]["expert"]: s["value"]
+                      for s in snap["moe_expert_tokens"]["samples"]}
+        assert len(per_expert) == 8
+        assert sum(per_expert.values()) == 64 * 2  # every copy processed
+        assert snap["moe_load_imbalance"]["samples"][0]["value"] >= 1.0
+        assert moe.last_stats["dropped_tokens"] == 0
+        reg.reset()
+
+    def test_capacity_overflow_counts_into_registry(self):
+        """Satellite: the capacity gates' dropped tokens are no longer
+        silent — the layer reports them and the registry counter sees
+        them."""
+        reg = obs_metrics.registry()
+        reg.reset()
+        moe = _mk("capacity", E=4, cf=0.25)
+        moe(paddle.to_tensor(_x(64)))
+        dropped = float(moe.tokens_dropped)
+        assert dropped > 0
+        assert moe.last_stats["dropped_tokens"] == dropped
+        snap = reg.snapshot()
+        assert (snap["moe_dropped_tokens_total"]["samples"][0]["value"]
+                == dropped)
+        # a second overflowing forward ACCUMULATES (counter semantics)
+        moe(paddle.to_tensor(_x(64, seed=3)))
+        snap = reg.snapshot()
+        assert (snap["moe_dropped_tokens_total"]["samples"][0]["value"]
+                > dropped)
+        reg.reset()
+
+
+@pytest.mark.slow
+class TestExpertParallel:
+    def test_ep4_matches_local(self):
+        moe = _mk()
+        x = _x(4 * 16).reshape(4, 16, 32)
+        out_local = np.asarray(moe(paddle.to_tensor(x))._value)
+        build_mesh({"dp": 2, "ep": 4})
+        mode, ep, _, _ = moe._dispatch_plan(4 * 16)
+        assert mode == "spmd" and ep == 4
+        out_ep = np.asarray(moe(paddle.to_tensor(x))._value)
+        np.testing.assert_allclose(out_ep, out_local, rtol=2e-5, atol=2e-5)
+
+    def test_ep_grads_flow(self):
+        build_mesh({"dp": 2, "ep": 4})
+        moe = _mk(shared_expert_hidden=16)
+        xt = paddle.to_tensor(_x(4 * 16).reshape(4, 16, 32),
+                              stop_gradient=False)
+        out = moe(xt)
+        (out.sum() + moe.l_aux).backward()
+        assert float(np.abs(np.asarray(moe.experts.w1.grad._value)).sum()) > 0
+        assert float(np.abs(np.asarray(
+            moe.shared_w1.grad._value)).sum()) > 0
+
+    def test_expert_choice_ep_runs(self):
+        build_mesh({"dp": 2, "ep": 4})
+        moe = _mk(router="expert")
+        out = moe(paddle.to_tensor(_x(4 * 16).reshape(4, 16, 32)))
+        assert np.isfinite(np.asarray(out._value)).all()
+
+    def test_compiled_step_with_zero_axis_and_telemetry(self):
+        """Dropless GPT-MoE through CompiledTrainStep on a dp x ep mesh
+        with ZeRO-1 sharding and the moe step-telemetry columns."""
+        from paddle_tpu.distributed.mesh import get_mesh
+        from paddle_tpu.models import GptMoeForCausalLM, gpt_moe_tiny_config
+        from paddle_tpu.parallel import CompiledTrainStep
+
+        build_mesh({"dp": 2, "ep": 4})
+        paddle.seed(0)
+        model = GptMoeForCausalLM(gpt_moe_tiny_config(
+            moe_dispatch="dropless", shared_expert_hidden=32))
+        model.train()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = CompiledTrainStep(model, lambda out, lab: out, optimizer=opt,
+                                 mesh=get_mesh(), zero_axis="dp",
+                                 collect_metrics=True)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 256, (4, 16)).astype(np.int64))
+        l0 = float(step(ids, ids, ids))
+        l1 = float(step(ids, ids, ids))
+        step.drain()
+        assert np.isfinite([l0, l1]).all()
+        m = step.last_metrics()
+        assert m["moe_dropped"] == 0.0
+        assert m["moe_aux"] > 0

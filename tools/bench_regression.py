@@ -44,6 +44,55 @@ def _snapshot_value(report: dict, key: str, fallback):
     return float(v) if v is not None else fallback
 
 
+def _moe_gates(cur: dict):
+    """Dropless-MoE self-consistency gates (docs/moe.md): the dropless arm
+    must beat the drop-free-sized capacity baseline on the skewed corpus,
+    drop nothing, record its kernel block-visit sparsity with the counter
+    agreeing with the shared skip predicate, and hold grads parity vs the
+    dense-masked reference."""
+    moe = (cur["detail"] or {}).get("moe") or {}
+    if not moe:
+        # fail CLOSED: the arm goes missing exactly when the MoE probe
+        # crashed, which is when these gates matter most
+        raise SystemExit(
+            "MOE REGRESSION: the MOE_JSON arm is missing from the bench "
+            "report (probe failed?) — the dropless gates cannot run")
+    arms = moe.get("arms") or {}
+    d_tps = _snapshot_value(cur, "bench_moe_dropless_tokens_per_sec",
+                            (arms.get("dropless") or {})
+                            .get("tokens_per_sec"))
+    c_tps = _snapshot_value(cur, "bench_moe_capacity_tokens_per_sec",
+                            (arms.get("capacity_dropfree") or {})
+                            .get("tokens_per_sec"))
+    dropped = _snapshot_value(cur, "bench_moe_dropless_dropped_tokens",
+                              (arms.get("dropless") or {})
+                              .get("dropped_tokens"))
+    visits = moe.get("block_visits") or {}
+    print(f"moe: dropless {d_tps:.1f} vs drop-free capacity "
+          f"{c_tps:.1f} tok/s ({d_tps / c_tps:.2f}x), dropped="
+          f"{dropped}, visited_frac={visits.get('visited_frac')}")
+    if d_tps < c_tps:
+        raise SystemExit(
+            f"MOE REGRESSION: dropless {d_tps:.1f} tok/s below the "
+            f"capacity baseline {c_tps:.1f}")
+    if dropped != 0:
+        raise SystemExit(
+            f"MOE REGRESSION: dropless arm dropped {dropped} tokens "
+            f"(must be 0 by construction)")
+    if visits.get("visited_frac") is None:
+        raise SystemExit(
+            "MOE REGRESSION: block-visit sparsity missing from the "
+            "MOE_JSON arm")
+    if not visits.get("counts_match_predicate", False):
+        raise SystemExit(
+            "MOE REGRESSION: grouped-matmul visit-count kernel "
+            "disagrees with the shared skip predicate")
+    if not (moe.get("grads") or {}).get("parity", False):
+        raise SystemExit(
+            "MOE REGRESSION: dropless grads diverged from the "
+            "dense-masked reference")
+
+
 def main():
     cur = run_bench()
     platform = cur["detail"]["platform"]
@@ -53,6 +102,10 @@ def main():
         print(f"baseline updated for {platform}: {cur['value']} {cur['unit']}")
         return
 
+    # self-consistency gates first: they compare arms WITHIN this run, so
+    # they hold on any platform, baseline recorded or not
+    _moe_gates(cur)
+
     if not os.path.exists(BASELINE):
         raise SystemExit(f"no {BASELINE}; record one with --update")
     with open(BASELINE) as f:
@@ -60,7 +113,7 @@ def main():
     base = base_all.get(platform)
     if base is None:
         print(f"no recorded baseline for platform '{platform}' — run "
-              f"--update on this platform first; skipping gate")
+              f"--update on this platform first; skipping baseline gate")
         return
 
     loss = cur["detail"]["loss"]
