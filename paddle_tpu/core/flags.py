@@ -265,6 +265,21 @@ define_flag("serving_prefix_sharing", 1,
             "and one physical page backs every sharer of a common system "
             "prompt; writes into shared pages copy-on-write. 0 = off",
             type=int)
+define_flag("serving_kv_cache_dtype", "model",
+            "KV page-pool storage dtype: 'model' stores pages in the "
+            "weight dtype (PR-9/12 behavior), 'int8'/'fp8' store quantized "
+            "codes with per-slot-per-head absmax scales in a float32 side "
+            "pool and dequantize INSIDE the paged kernel — int8 halves/"
+            "quarters page bytes so pages_for_budget admits ~2x/~4x the "
+            "sequences at the same HBM budget ('fp8' falls back to int8 "
+            "when the platform lacks float8)")
+define_flag("serving_host_cache_mb", 0,
+            "host-RAM cold tier for committed KV pages: when > 0, pages "
+            "whose refcount drops to zero but remain in the prefix index "
+            "are DEMOTED to a pinned-host pool of this many MB instead of "
+            "freed, and a later radix hit restores them via one compiled "
+            "H2D copy; 0 = off (cold pages stay in HBM until reclaimed)",
+            type=int)
 define_flag("serving_waiting_queue_limit", 128,
             "bound on the scheduler's WAITING queue (distinct from the "
             "HTTP handler queue): submissions past this many queued "
@@ -314,3 +329,16 @@ define_flag("router_shed_max_new_tokens", 32,
 define_flag("router_retry_after_s", 1.0,
             "Retry-After seconds advertised on admission-control 503s",
             type=float)
+define_flag("router_placement", "session",
+            "replica placement key: 'session' rendezvous-hashes the "
+            "session id (PR-11 behavior — one user sticks to one replica), "
+            "'prefix' rendezvous-hashes a bounded digest of the prompt's "
+            "first router_prefix_tokens ids (session id as tiebreak when "
+            "no prompt is present), so requests sharing a system prompt "
+            "land where its KV pages already live and the per-replica "
+            "prefix-hit rate becomes a fleet-wide property")
+define_flag("router_prefix_tokens", 64,
+            "prompt-prefix digest length (tokens) for "
+            "router_placement=prefix: long enough to separate distinct "
+            "system prompts, short enough that a shared preamble maps all "
+            "its requests to one digest", type=int)

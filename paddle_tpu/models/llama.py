@@ -221,8 +221,11 @@ class LlamaAttention(nn.Layer):
         the T-token SPECULATIVE VERIFY frame through the same paged kernel
         with per-query causal limits (query i at absolute position
         context_lens-1+i). `cache` is the raw
-        {"k","v": [L, Hkv, P, page_size, D]} pool pair; this layer reads
-        and functionally updates stack row `layer_idx`. position_ids
+        {"k","v": [L, Hkv, P, page_size, D]} pool pair — plus
+        {"k_scale","v_scale": [L, Hkv, P, page_size] float32} when the
+        pools are quantized (int8/fp8): writes then quantize through the
+        absmax observer and reads dequantize inside the paged kernel;
+        this layer reads and functionally updates stack row `layer_idx`. position_ids
         [B, T] are ABSOLUTE positions (index the hoisted RoPE buffer);
         context_lens [B] counts valid cache tokens INCLUDING this chunk
         (for verify: committed context incl. the frame's rewrite token
@@ -257,20 +260,43 @@ class LlamaAttention(nn.Layer):
         slot = position_ids % ps                                   # [B, T]
         # index tuple (int, :, [B,T], [B,T]): the advanced dims land in
         # FRONT position, so the updates keep their natural [B, T, Hkv, D]
-        ck = ck.at[layer_idx, :, pidx, slot].set(kv.astype(ck.dtype))
-        cv = cv.at[layer_idx, :, pidx, slot].set(vv.astype(cv.dtype))
-        cache = {"k": ck, "v": cv}
+        if "k_scale" in cache:
+            # quantized pool: quantize-on-write through the SAME observer
+            # math training quantization uses (per-slot-per-head absmax);
+            # codes land in the int8/fp8 pool, scales in the f32 side pool
+            from paddle_tpu.quantization import AbsmaxChannelWiseObserver
+            qmax = 127.0 if ck.dtype == jnp.int8 else 448.0
+            sck = AbsmaxChannelWiseObserver.kv_page_scales(kv, qmax=qmax)
+            scv = AbsmaxChannelWiseObserver.kv_page_scales(vv, qmax=qmax)
+            kq = kv.astype(jnp.float32) / sck[..., None]
+            vq = vv.astype(jnp.float32) / scv[..., None]
+            if ck.dtype == jnp.int8:
+                kq = jnp.clip(jnp.round(kq), -127, 127)
+                vq = jnp.clip(jnp.round(vq), -127, 127)
+            ck = ck.at[layer_idx, :, pidx, slot].set(kq.astype(ck.dtype))
+            cv = cv.at[layer_idx, :, pidx, slot].set(vq.astype(cv.dtype))
+            cks = cache["k_scale"].at[layer_idx, :, pidx, slot].set(sck)
+            cvs = cache["v_scale"].at[layer_idx, :, pidx, slot].set(scv)
+            cache = {"k": ck, "v": cv, "k_scale": cks, "v_scale": cvs}
+            k_sc, v_sc = cks[layer_idx], cvs[layer_idx]
+        else:
+            ck = ck.at[layer_idx, :, pidx, slot].set(kv.astype(ck.dtype))
+            cv = cv.at[layer_idx, :, pidx, slot].set(vv.astype(cv.dtype))
+            cache = {"k": ck, "v": cv}
+            k_sc = v_sc = None
 
         if t == 1:
             out = paged_attention(qv[:, 0], ck[layer_idx], cv[layer_idx],
-                                  page_table, context_lens)[:, None]
+                                  page_table, context_lens,
+                                  k_scales=k_sc, v_scales=v_sc)[:, None]
         elif verify:
             # the [B, T, Hq, D] query frame rides the SAME scalar-prefetch
             # page gather as plain decode; per-query causal limits live in
             # the kernel (query i sees keys < context_lens + i, which
             # includes the draft K/V scattered just above)
             out = paged_attention(qv, ck[layer_idx], cv[layer_idx],
-                                  page_table, context_lens)
+                                  page_table, context_lens,
+                                  k_scales=k_sc, v_scales=v_sc)
         else:
             # chunked prefill: gather the full context (pages cover the
             # chunk itself too — just scattered above) and run the SAME
@@ -288,6 +314,13 @@ class LlamaAttention(nn.Layer):
                                   0, 2).astype(qv.dtype)           # [B,S,Hkv,D]
             v_full = jnp.moveaxis(cv[layer_idx][:, pidx_f, slot_f],
                                   0, 2).astype(qv.dtype)
+            if k_sc is not None:
+                # dequant the gathered context (prefill runs the flash
+                # path over bf16 activations; the pool stays quantized)
+                ksf = jnp.moveaxis(k_sc[:, pidx_f, slot_f], 0, 2)  # [B,S,Hkv]
+                vsf = jnp.moveaxis(v_sc[:, pidx_f, slot_f], 0, 2)
+                k_full = k_full * ksf[..., None].astype(qv.dtype)
+                v_full = v_full * vsf[..., None].astype(qv.dtype)
             q_full = jnp.zeros((b, ctx_pad) + qv.shape[2:], qv.dtype)
             bidx = jnp.arange(b)[:, None]
             q_full = q_full.at[bidx, position_ids].set(qv)

@@ -100,9 +100,16 @@ def _interpret_mode() -> bool:
 # decode kernel
 # ---------------------------------------------------------------------------
 
-def _decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, page_size: int, scale: float,
-                   pages_per_seq: int, q_len: int, group: int):
+def _decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref, *rest,
+                   page_size: int, scale: float, pages_per_seq: int,
+                   q_len: int, group: int, quantized: bool = False):
+    # quantized pools ride two extra per-page scale blocks (the in-kernel
+    # dequant of PR-16: bf16 K/V never materialize in HBM); the trailing
+    # refs are always (o, m, l, acc)
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     p = pl.program_id(2)
 
@@ -128,6 +135,11 @@ def _decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0].astype(jnp.float32) * scale      # [T*G, D]
         k = k_ref[0, 0].astype(jnp.float32)               # [PS, D]
         v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            # fused dequant: per-slot-per-head absmax scales stream in
+            # alongside the page; the bf16 values exist only in VMEM
+            k = k * ks_ref[0, 0][:, None]
+            v = v * vs_ref[0, 0][:, None]
         g = q.shape[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [T*G, PS]
@@ -184,7 +196,8 @@ def _check_shapes(q, k_pages, v_pages, page_table, context_lens):
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, context_lens,
                            scale: float | None = None,
-                           interpret: bool | None = None):
+                           interpret: bool | None = None,
+                           k_scales=None, v_scales=None):
     """Attention over the paged KV cache (the Pallas kernel). q is either
     ``[B, Hq, D]`` (one query token per sequence — plain decode) or
     ``[B, T, Hq, D]`` (a speculative VERIFY frame: query i of row b sits at
@@ -193,9 +206,24 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, context_lens,
     k_pages/v_pages: [Hkv, P, page_size, D]; page_table:
     [B, pages_per_seq] int32; context_lens: [B] int32 counts committed
     context INCLUDING the frame's first (rewrite) token. Returns q's shape.
+
+    Quantized pools: when ``k_scales``/``v_scales`` (``[Hkv, P, page_size]``
+    float32 per-slot-per-head absmax scales) are given, k/v pages hold
+    int8/fp8 codes and the kernel dequantizes INSIDE the grid step — the
+    scale block streams alongside its page via the same index-map gather,
+    so bf16 values exist only in VMEM, never in HBM.
     """
     b, hq, hkv, ps, d = _check_shapes(q, k_pages, v_pages, page_table,
                                       context_lens)
+    quantized = k_scales is not None
+    if quantized and v_scales is None:
+        raise ValueError("k_scales given without v_scales")
+    if quantized:
+        want = (hkv, k_pages.shape[1], ps)
+        if tuple(k_scales.shape) != want or tuple(v_scales.shape) != want:
+            raise ValueError(
+                f"k/v scales must be [Hkv, P, page_size]={want}, got "
+                f"{tuple(k_scales.shape)} and {tuple(v_scales.shape)}")
     squeeze = q.ndim == 3
     if squeeze:
         q = q[:, None]
@@ -216,20 +244,32 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, context_lens,
           .reshape(b, hkv, tg, d))
     kernel = functools.partial(_decode_kernel, page_size=ps, scale=scale,
                                pages_per_seq=pages_per_seq, q_len=t,
-                               group=group)
+                               group=group, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, 1, tg, d),
+                     lambda bb, h, p, lens, pt: (bb, h, 0, 0)),
+        # the page gather IS the index map: scalar-prefetched page-table
+        # entries pick which pool page streams into VMEM this grid step
+        pl.BlockSpec((1, 1, ps, d),
+                     lambda bb, h, p, lens, pt: (h, pt[bb, p], 0, 0)),
+        pl.BlockSpec((1, 1, ps, d),
+                     lambda bb, h, p, lens, pt: (h, pt[bb, p], 0, 0)),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quantized:
+        # each page's scale block rides the same gather as the page itself
+        in_specs += [
+            pl.BlockSpec((1, 1, ps),
+                         lambda bb, h, p, lens, pt: (h, pt[bb, p], 0)),
+            pl.BlockSpec((1, 1, ps),
+                         lambda bb, h, p, lens, pt: (h, pt[bb, p], 0)),
+        ]
+        operands += [jnp.asarray(k_scales, jnp.float32),
+                     jnp.asarray(v_scales, jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hkv, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((1, 1, tg, d),
-                         lambda bb, h, p, lens, pt: (bb, h, 0, 0)),
-            # the page gather IS the index map: scalar-prefetched page-table
-            # entries pick which pool page streams into VMEM this grid step
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda bb, h, p, lens, pt: (h, pt[bb, p], 0, 0)),
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda bb, h, p, lens, pt: (h, pt[bb, p], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, tg, d),
                                lambda bb, h, p, lens, pt: (bb, h, 0, 0)),
         scratch_shapes=[
@@ -245,7 +285,7 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, context_lens,
             out_shape=jax.ShapeDtypeStruct((b, hkv, tg, d), q.dtype),
             interpret=interpret,
         )(jnp.asarray(context_lens, jnp.int32),
-          jnp.asarray(page_table, jnp.int32), qg, k_pages, v_pages)
+          jnp.asarray(page_table, jnp.int32), *operands)
     out = (out.reshape(b, hkv, t, group, d).transpose(0, 2, 1, 3, 4)
            .reshape(b, t, hq, d))
     return out[:, 0] if squeeze else out
@@ -256,12 +296,16 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, context_lens,
 # ---------------------------------------------------------------------------
 
 def paged_attention_reference(q, k_pages, v_pages, page_table, context_lens,
-                              scale: float | None = None):
+                              scale: float | None = None,
+                              k_scales=None, v_scales=None):
     """jnp gather + masked-softmax reference of `paged_decode_attention` —
     the XLA fallback the serving engine uses off-TPU (fast under jit on
     CPU, where interpret-mode Pallas would run the grid in Python).
     Accepts the same [B, Hq, D] decode and [B, T, Hq, D] verify-frame
-    query layouts with identical per-query causal semantics."""
+    query layouts with identical per-query causal semantics, and the same
+    optional ``k_scales``/``v_scales`` ``[Hkv, P, page_size]`` dequant
+    contract as the kernel (scales applied after the f32 cast, so CPU
+    tier-1 runs the exact quantized semantics)."""
     b, hq, hkv, ps, d = _check_shapes(q, k_pages, v_pages, page_table,
                                       context_lens)
     squeeze = q.ndim == 3
@@ -277,6 +321,13 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, context_lens,
     # [Hkv, B, Pmax, PS, D] -> [B, Hkv, S, D]
     k = jnp.moveaxis(k_pages[:, pt], 1, 0).reshape(b, hkv, s_max, d)
     v = jnp.moveaxis(v_pages[:, pt], 1, 0).reshape(b, hkv, s_max, d)
+    if k_scales is not None:
+        ks = jnp.moveaxis(jnp.asarray(k_scales, jnp.float32)[:, pt],
+                          1, 0).reshape(b, hkv, s_max)
+        vs = jnp.moveaxis(jnp.asarray(v_scales, jnp.float32)[:, pt],
+                          1, 0).reshape(b, hkv, s_max)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     qg = q.reshape(b, t, hkv, group, d).astype(jnp.float32) * scale
     s = jnp.einsum("bthgd,bhsd->bthgs", qg, k.astype(jnp.float32))
     pos = jnp.arange(s_max, dtype=jnp.int32)
@@ -298,16 +349,20 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, context_lens,
 
 
 def paged_attention(q, k_pages, v_pages, page_table, context_lens,
-                    scale: float | None = None):
+                    scale: float | None = None,
+                    k_scales=None, v_scales=None):
     """Dispatching entry point (what the model's decode path calls): the
     Pallas kernel on TPU or under force_interpret(); the XLA reference
     elsewhere — the same routing contract as
-    F.scaled_dot_product_attention."""
+    F.scaled_dot_product_attention. ``k_scales``/``v_scales`` flow to
+    whichever path runs (in-kernel dequant of quantized pools)."""
     if _HAS_PLTPU and (_on_tpu() or interpret_forced()):
         return paged_decode_attention(q, k_pages, v_pages, page_table,
-                                      context_lens, scale=scale)
+                                      context_lens, scale=scale,
+                                      k_scales=k_scales, v_scales=v_scales)
     return paged_attention_reference(q, k_pages, v_pages, page_table,
-                                     context_lens, scale=scale)
+                                     context_lens, scale=scale,
+                                     k_scales=k_scales, v_scales=v_scales)
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,19 @@ from paddle_tpu.nn.layer.layers import Layer
 __all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
            "MovingAverageAbsmaxObserver", "HistObserver",
            "AbsmaxChannelWiseObserver", "FakeQuantLayer", "QuantedLinear",
-           "quanted_linear", "quantize_weight_int8"]
+           "quanted_linear", "quantize_weight_int8", "absmax_scale"]
+
+
+def absmax_scale(absmax, quant_bits: int = 8, qmax: float | None = None):
+    """THE absmax -> scale rule every quantizer in the repo shares (the
+    observers' `scale()`/`device_scale()` AND the serving KV page pools):
+    ``max(absmax / qmax, 1e-8)``, where qmax defaults to the signed-int
+    code range ``2^(bits-1) - 1`` and can be overridden for float formats
+    (448 for fp8 e4m3). Device arrays in, device arrays out — callers on
+    the decode hot path never pay a host sync."""
+    if qmax is None:
+        qmax = 2 ** (quant_bits - 1) - 1
+    return jnp.maximum(jnp.asarray(absmax, jnp.float32) / qmax, 1e-8)
 
 
 @jax.custom_vjp
@@ -67,10 +79,9 @@ class AbsmaxObserver:
     def device_scale(self):
         """The scale as a device scalar — the QAT fake-quant path consumes
         this, so training steps never block on a device->host read."""
-        denom = 2 ** (self.quant_bits - 1) - 1
         if self._absmax is None:
             return jnp.float32(1e-8)
-        return jnp.maximum(self._absmax / denom, 1e-8)
+        return absmax_scale(self._absmax, self.quant_bits)
 
 
 class MovingAverageAbsmaxObserver:
@@ -96,10 +107,9 @@ class MovingAverageAbsmaxObserver:
         return (self.absmax or 0.0) / (2 ** (self.quant_bits - 1) - 1) or 1e-8
 
     def device_scale(self):
-        denom = 2 ** (self.quant_bits - 1) - 1
         if self._absmax is None:
             return jnp.float32(1e-8)
-        return jnp.maximum(self._absmax / denom, 1e-8)
+        return absmax_scale(self._absmax, self.quant_bits)
 
 
 class HistObserver:
@@ -154,10 +164,22 @@ class AbsmaxChannelWiseObserver:
         self._absmax = cur if self._absmax is None else jnp.maximum(self._absmax, cur)
 
     def scale(self):
-        denom = 2 ** (self.quant_bits - 1) - 1
-        return jnp.maximum(self._absmax / denom, 1e-8)
+        return absmax_scale(self._absmax, self.quant_bits)
 
     device_scale = scale  # already a device array
+
+    @classmethod
+    def kv_page_scales(cls, values, quant_bits: int = 8,
+                       qmax: float | None = None):
+        """Per-slot-per-head absmax scales for the serving KV page pools:
+        `values` is the [..., head_dim] K or V activation about to be
+        scattered into quantized pages; head_dim is the reduced (channel)
+        axis, exactly this observer's observe()+scale() math in one fused
+        dispatch — serving and training quantization share ONE codepath
+        (PR-16 satellite), and the result stays a device array so the
+        decode path never host-syncs."""
+        return absmax_scale(jnp.max(jnp.abs(values), axis=-1),
+                            quant_bits, qmax=qmax)
 
 
 class QuantConfig:

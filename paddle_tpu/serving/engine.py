@@ -39,6 +39,18 @@ O(K) dictionary lookups in the draft proposer.
 Chaos: ``serving.spec.verify_mismatch`` (PR-10 registry) zeroes every
 row's draft window for the step — a forced full rejection; the engine must
 degrade to plain one-token decode, never wedge.
+
+KV memory hierarchy (``serving_kv_cache_dtype`` / ``serving_host_cache_mb``):
+the page pools can store int8/fp8 CODES with float32 per-slot-per-head
+absmax scales in side pools — writes quantize through the training
+observer math, reads dequantize inside the paged kernel, and
+``pages_for_budget`` admits ~2x/~4x the sequences at the same HBM budget.
+Below HBM sits an optional pinned-host cold tier: committed pages whose
+refcount drops to zero DEMOTE (one compiled D2H gather) instead of dying,
+and a later radix hit PROMOTES them back (one compiled H2D scatter) —
+both standalone programs, so the decode signature never retraces across a
+tier transition. ``serving.kv.promote_fail`` chaos degrades a failed
+restore to re-prefilling the unmatched tail.
 """
 from __future__ import annotations
 
@@ -50,6 +62,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.observability import events as obs_events
 from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.observability import tracing as obs_tracing
 from paddle_tpu.serving.drafts import NGramProposer
@@ -78,6 +91,9 @@ class ServingConfig:
     prefill_chunk: int = 0          # 0 -> FLAGS_serving_prefill_chunk
     max_seq_len: int = 0            # 0 -> FLAGS_serving_max_seq_len or model
     kv_dtype: object = None         # None -> model param dtype
+    kv_cache_dtype: str = ""        # "" -> FLAGS_serving_kv_cache_dtype
+                                    #   ("model" | "int8" | "fp8")
+    host_cache_mb: int = -1         # <0 -> FLAGS_serving_host_cache_mb
     sample_seed: int = 0
     max_waiting: int = 0            # 0 -> FLAGS_serving_waiting_queue_limit
     spec_k: int | None = None       # None -> FLAGS_serving_spec_k
@@ -98,8 +114,13 @@ class ServingConfig:
                   else self.spec_k)
         sharing = (flag("serving_prefix_sharing")
                    if self.prefix_sharing is None else self.prefix_sharing)
+        kv_mode = (self.kv_cache_dtype
+                   or flag("serving_kv_cache_dtype")).lower()
+        host_mb = (self.host_cache_mb if self.host_cache_mb >= 0
+                   else flag("serving_host_cache_mb"))
         return (int(ps), int(batch), int(chunk), int(smax), int(budget),
-                int(pages), int(waiting), int(spec_k), bool(sharing))
+                int(pages), int(waiting), int(spec_k), bool(sharing),
+                str(kv_mode), int(host_mb))
 
 
 import itertools as _itertools
@@ -143,6 +164,31 @@ def _register_engine_metrics(engine: "ServingEngine"):
                         labels=("engine",)).labels(
                 engine=eng._metrics_id)._set_total(
                 float(getattr(eng, attr)))
+        # PR-16 memory-hierarchy plane: tier occupancy, transition totals
+        # and the storage mode as a labeled one-hot
+        alloc = eng.allocator
+        tiers = reg.gauge("kv_tier_pages",
+                          "KV pages resident per tier (hbm counts held + "
+                          "cold committed pages; host counts demoted "
+                          "pages in the pinned-host pool)",
+                          labels=("engine", "tier"))
+        tiers.labels(engine=eng._metrics_id, tier="hbm").set(
+            float(eng.num_pages - 1 - alloc.free_pages))
+        tiers.labels(engine=eng._metrics_id, tier="host").set(
+            float(alloc.host_used))
+        reg.counter("kv_demotions_total",
+                    "KV pages demoted HBM -> host (tier evictions)",
+                    labels=("engine",)).labels(
+            engine=eng._metrics_id)._set_total(float(alloc.demotions))
+        reg.counter("kv_promotions_total",
+                    "KV pages promoted host -> HBM (radix-hit restores)",
+                    labels=("engine",)).labels(
+            engine=eng._metrics_id)._set_total(float(alloc.promotions))
+        reg.gauge("kv_cache_dtype",
+                  "KV page-pool storage mode (one-hot by dtype label)",
+                  labels=("engine", "dtype")).labels(
+            engine=eng._metrics_id,
+            dtype=st.get("kv_cache_dtype", "unknown")).set(1.0)
 
     obs_metrics.registry().add_collector(collect, owner=engine)
 
@@ -177,7 +223,8 @@ class ServingEngine:
         self.head_dim = int(mcfg.hidden_size) // int(mcfg.num_attention_heads)
         (self.page_size, self.decode_batch, self.prefill_chunk,
          self.max_seq_len, budget_mb, cfg_pages, self.max_waiting,
-         self.spec_k, self.prefix_sharing) = self.config.resolved(
+         self.spec_k, self.prefix_sharing, kv_mode,
+         host_mb) = self.config.resolved(
             int(mcfg.max_position_embeddings))
         if self.spec_k < 0:
             raise ValueError(f"serving_spec_k must be >= 0, "
@@ -203,7 +250,25 @@ class ServingEngine:
                     "model parameters are donated/deleted device arrays — "
                     "call CompiledTrainStep.sync_params_to_model() (or "
                     "reload a checkpoint) before constructing ServingEngine")
-        self.kv_dtype = jnp.dtype(self.config.kv_dtype or params[0].dtype)
+        # KV storage mode: "model" stores pages in the weight/kv_dtype
+        # (PR-9/12 behavior); "int8"/"fp8" store quantized CODES with
+        # per-slot-per-head float32 absmax scales in side pools and the
+        # paged kernel dequantizes in VMEM — page_bytes shrinks to 1
+        # byte/value, so pages_for_budget admits ~itemsize x the pages
+        if kv_mode not in ("model", "int8", "fp8"):
+            raise ValueError(f"serving_kv_cache_dtype must be one of "
+                             f"model/int8/fp8, got {kv_mode!r}")
+        if kv_mode == "fp8" and not hasattr(jnp, "float8_e4m3fn"):
+            kv_mode = "int8"   # platform without float8: same contract
+        self.kv_mode = kv_mode
+        self.kv_quantized = kv_mode != "model"
+        if kv_mode == "int8":
+            self.kv_dtype = jnp.dtype(jnp.int8)
+        elif kv_mode == "fp8":
+            self.kv_dtype = jnp.dtype(jnp.float8_e4m3fn)
+        else:
+            self.kv_dtype = jnp.dtype(self.config.kv_dtype
+                                      or params[0].dtype)
         page_bytes = kv_page_bytes(self.num_layers, self.num_kv_heads,
                                    self.page_size, self.head_dim,
                                    self.kv_dtype.itemsize)
@@ -217,8 +282,21 @@ class ServingEngine:
                 f"serving_max_seq_len")
         self.num_pages = int(num_pages)
         self.kv_cache_bytes = page_bytes * self.num_pages
+        # f32 scale side pools (k + v), reported separately from the page
+        # budget: 4 bytes per slot per head ~= pool_bytes * 4 / head_dim
+        scale_page_bytes = (2 * self.num_layers * self.num_kv_heads
+                            * self.page_size * 4) if self.kv_quantized else 0
+        self.kv_scale_bytes = scale_page_bytes * self.num_pages
 
-        self.allocator = PageAllocator(self.num_pages, self.page_size)
+        # host-RAM cold tier: committed-but-idle pages demote here instead
+        # of dying; sized by serving_host_cache_mb over FULL page bytes
+        # (codes + scales) so the knob is honest about host footprint
+        host_page_bytes = page_bytes + scale_page_bytes
+        self.host_pages = ((int(host_mb) << 20) // host_page_bytes
+                           if host_mb > 0 else 0)
+
+        self.allocator = PageAllocator(self.num_pages, self.page_size,
+                                       host_pages=self.host_pages)
         self.scheduler = ContinuousBatchingScheduler(
             self.allocator, self.decode_batch, self.max_seq_len,
             max_waiting=self.max_waiting,
@@ -227,8 +305,25 @@ class ServingEngine:
         self._params = params
         shape = (self.num_layers, self.num_kv_heads, self.num_pages,
                  self.page_size, self.head_dim)
-        self._ck = jnp.zeros(shape, self.kv_dtype)
-        self._cv = jnp.zeros(shape, self.kv_dtype)
+        # ONE cache pytree (donated through every compiled step as a
+        # single argument): k/v page pools, plus the scale side pools
+        # when quantized — the model's decode path keys its
+        # quantize-on-write behavior off the presence of "k_scale"
+        self._cache = {"k": jnp.zeros(shape, self.kv_dtype),
+                       "v": jnp.zeros(shape, self.kv_dtype)}
+        if self.kv_quantized:
+            self._cache["k_scale"] = jnp.zeros(shape[:4], jnp.float32)
+            self._cache["v_scale"] = jnp.zeros(shape[:4], jnp.float32)
+        # pinned-host backing store for demoted pages, one slot per host
+        # page ([slot, L, H, PS, D] so a page is one contiguous row)
+        self._host_store = {
+            name: np.zeros((self.host_pages, self.num_layers,
+                            self.num_kv_heads, self.page_size)
+                           + ((self.head_dim,)
+                              if name in ("k", "v") else ()),
+                           self._cache[name].dtype)
+            for name in self._cache
+        } if self.host_pages else {}
 
         self._chunk_buckets = _buckets(min(8, self.prefill_chunk),
                                        self.prefill_chunk)
@@ -244,6 +339,8 @@ class ServingEngine:
         self._decode_fn = None
         self._verify_fns: dict[int, object] = {}    # draft window K -> fn
         self._copy_fn = None
+        self._extract_fn = None      # D2H demote: gather one page
+        self._restore_fn = None      # H2D promote: scatter one page
         self._prefill_fns: dict[tuple[int, int], object] = {}
         # speculation / prefix-sharing accounting (stats() surfaces these;
         # the bench's accepted-tokens/step and prefix-hit-rate gates read
@@ -271,6 +368,16 @@ class ServingEngine:
     def _ctx_cap(self) -> int:
         return self.pages_per_seq * self.page_size
 
+    # read-only views of the page pools (tests/bench peek at page bytes;
+    # the MUTABLE handle is the single donated `_cache` pytree)
+    @property
+    def _ck(self):
+        return self._cache["k"]
+
+    @property
+    def _cv(self):
+        return self._cache["v"]
+
     # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------
@@ -278,13 +385,13 @@ class ServingEngine:
         if self._decode_fn is None:
             from paddle_tpu.parallel.train_step import functional_call
 
-            def fn(params, ck, cv, ids, lens, page_table, keys, temp,
+            def fn(params, cache, ids, lens, page_table, keys, temp,
                    top_k, top_p):
                 self._decode_traces += 1
                 positions = jnp.maximum(lens - 1, 0).astype(jnp.int32)
                 logits3, cache = functional_call(
                     self.model, params, (ids[:, None],),
-                    dict(cache={"k": ck, "v": cv}, page_table=page_table,
+                    dict(cache=cache, page_table=page_table,
                          context_lens=lens, position_ids=positions[:, None]),
                     training=False, method="decode_forward")
                 logits = logits3._value[:, 0]
@@ -293,10 +400,10 @@ class ServingEngine:
                 # logits are consumed by sampling IN-program and not
                 # returned: a [batch, vocab] fp32 output would otherwise
                 # stay live between steps for nothing
-                return tokens, new_keys, cache["k"], cache["v"]
+                return tokens, new_keys, cache
 
             self._decode_fn = jax.jit(
-                fn, donate_argnums=(1, 2) if self._donate else ())
+                fn, donate_argnums=(1,) if self._donate else ())
         return self._decode_fn
 
     def _prefill(self, chunk_pad: int, ctx_pad: int):
@@ -306,7 +413,7 @@ class ServingEngine:
 
             cap = self._ctx_cap()
 
-            def fn(params, ck, cv, ids, start, total, page_row):
+            def fn(params, cache, ids, start, total, page_row):
                 self._prefill_traces += 1
                 # pad tokens of the final chunk clamp to the last valid
                 # position: they write the one not-yet-valid slot cap-1
@@ -316,15 +423,15 @@ class ServingEngine:
                     start + jnp.arange(chunk_pad, dtype=jnp.int32), cap - 1)
                 _, cache = functional_call(
                     self.model, params, (ids[None],),
-                    dict(cache={"k": ck, "v": cv},
+                    dict(cache=cache,
                          page_table=page_row[None],
                          context_lens=total.reshape(1),
                          position_ids=positions[None], ctx_pad=ctx_pad),
                     training=False, method="decode_forward")
-                return cache["k"], cache["v"]
+                return cache
 
             self._prefill_fns[key] = jax.jit(
-                fn, donate_argnums=(1, 2) if self._donate else ())
+                fn, donate_argnums=(1,) if self._donate else ())
         return self._prefill_fns[key]
 
     def _verify(self, k: int):
@@ -337,7 +444,7 @@ class ServingEngine:
             t_frame = k + 1
             cap = self._ctx_cap()
 
-            def fn(params, ck, cv, ids, lens, page_table, keys, temp,
+            def fn(params, cache, ids, lens, page_table, keys, temp,
                    top_k, top_p, drafts, n_spec):
                 self._decode_traces += 1
                 base = jnp.maximum(lens - 1, 0).astype(jnp.int32)   # [B]
@@ -352,7 +459,7 @@ class ServingEngine:
                 positions = jnp.minimum(positions, cap - 1)
                 logits3, cache = functional_call(
                     self.model, params, (ids,),
-                    dict(cache={"k": ck, "v": cv}, page_table=page_table,
+                    dict(cache=cache, page_table=page_table,
                          context_lens=lens, position_ids=positions,
                          write_mask=write_mask, verify=True),
                     training=False, method="decode_forward")
@@ -382,23 +489,48 @@ class ServingEngine:
                                                axis=1), axis=1)    # [B]
                 new_keys = jnp.take_along_axis(
                     keyc, accepted[:, None, None], axis=1)[:, 0]
-                return tokens, accepted, new_keys, cache["k"], cache["v"]
+                return tokens, accepted, new_keys, cache
 
             self._verify_fns[k] = jax.jit(
-                fn, donate_argnums=(1, 2) if self._donate else ())
+                fn, donate_argnums=(1,) if self._donate else ())
         return self._verify_fns[k]
 
     def _copy_page(self):
         """One-page copy-on-write program (src/dst ride as arrays — ONE
-        compile serves every copy)."""
+        compile serves every copy). Copies EVERY pool in the cache pytree,
+        so quantized codes and their scales split together."""
         if self._copy_fn is None:
-            def fn(ck, cv, src, dst):
-                return (ck.at[:, :, dst].set(ck[:, :, src]),
-                        cv.at[:, :, dst].set(cv[:, :, src]))
+            def fn(cache, src, dst):
+                return {name: a.at[:, :, dst].set(a[:, :, src])
+                        for name, a in cache.items()}
 
             self._copy_fn = jax.jit(
-                fn, donate_argnums=(0, 1) if self._donate else ())
+                fn, donate_argnums=(0,) if self._donate else ())
         return self._copy_fn
+
+    def _extract_page(self):
+        """One-page D2H gather (the demote half of the host tier): returns
+        the page's slice of every pool; the caller device_gets it into the
+        pinned-host store. Page index rides as an array — ONE compile."""
+        if self._extract_fn is None:
+            def fn(cache, src):
+                return {name: a[:, :, src] for name, a in cache.items()}
+
+            self._extract_fn = jax.jit(fn)
+        return self._extract_fn
+
+    def _restore_page(self):
+        """One-page H2D scatter (the promote half): writes a host-stored
+        page back into a fresh pool page — the PR-12 copy-program shape
+        with the source riding as a transferred array."""
+        if self._restore_fn is None:
+            def fn(cache, data, dst):
+                return {name: a.at[:, :, dst].set(data[name])
+                        for name, a in cache.items()}
+
+            self._restore_fn = jax.jit(
+                fn, donate_argnums=(0,) if self._donate else ())
+        return self._restore_fn
 
     def configure_speculation(self, spec_k: int | None = None,
                               prefix_sharing: bool | None = None):
@@ -486,8 +618,8 @@ class ServingEngine:
             ids = np.zeros(cpad, np.int32)
             ids[:t] = ctx[off:off + t]
             fn = self._prefill(cpad, ctx_pad)
-            self._ck, self._cv = fn(
-                self._params, self._ck, self._cv, jnp.asarray(ids),
+            self._cache = fn(
+                self._params, self._cache, jnp.asarray(ids),
                 jnp.asarray(off, jnp.int32),
                 jnp.asarray(off + t, jnp.int32), row)
             off += t
@@ -517,8 +649,8 @@ class ServingEngine:
             temp[i] = req.temperature
             top_k[i] = req.top_k
             top_p[i] = req.top_p
-        tokens, new_keys, self._ck, self._cv = self._decode()(
-            self._params, self._ck, self._cv, jnp.asarray(ids),
+        tokens, new_keys, self._cache = self._decode()(
+            self._params, self._cache, jnp.asarray(ids),
             jnp.asarray(lens), jnp.asarray(pt), jnp.asarray(keys),
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
         toks = np.asarray(tokens)
@@ -581,8 +713,8 @@ class ServingEngine:
             ids[i, 1:1 + n] = prop
             n_spec[i] = n
         self._draft_ms += (time.perf_counter() - t_draft) * 1e3
-        tokens, accepted, new_keys, self._ck, self._cv = self._verify(k)(
-            self._params, self._ck, self._cv, jnp.asarray(ids),
+        tokens, accepted, new_keys, self._cache = self._verify(k)(
+            self._params, self._cache, jnp.asarray(ids),
             jnp.asarray(lens), jnp.asarray(pt), jnp.asarray(keys),
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
             jnp.asarray(drafts), jnp.asarray(n_spec))
@@ -623,9 +755,46 @@ class ServingEngine:
         self.scheduler.pending_cow = []
         fn = self._copy_page()
         for src, dst in copies:
-            self._ck, self._cv = fn(self._ck, self._cv,
-                                    jnp.asarray(src, jnp.int32),
-                                    jnp.asarray(dst, jnp.int32))
+            self._cache = fn(self._cache,
+                             jnp.asarray(src, jnp.int32),
+                             jnp.asarray(dst, jnp.int32))
+
+    def _apply_tier_ops(self):
+        """Drain the allocator's queued tier transitions: demotes (D2H —
+        a reclaimed cold page's bytes move to the pinned-host store BEFORE
+        anything overwrites the device page) then promotes (H2D — a
+        radix-hit host page restores into its fresh pool page). Ordering
+        contract with the allocator: this runs after every admission/grow
+        and before any prefill/decode/CoW device write, so tier copies are
+        standalone compiled programs and the decode step NEVER retraces
+        across a transition."""
+        if not self.allocator.tier_enabled:
+            return
+        demotes, promotes = self.allocator.take_tier_ops()
+        if not demotes and not promotes:
+            return
+        extract = self._extract_page()
+        restore = self._restore_page()
+        for page, slot in demotes:
+            data = extract(self._cache, jnp.asarray(page, jnp.int32))
+            for name, arr in data.items():
+                self._host_store[name][slot] = np.asarray(arr)
+        for slot, page in promotes:
+            data = {name: store[slot]
+                    for name, store in self._host_store.items()}
+            self._cache = restore(self._cache, data,
+                                  jnp.asarray(page, jnp.int32))
+        # journal the batch (storms — many transitions in one drain — at
+        # warning severity so dashboards notice thrash, not each page)
+        sev = "warning" if len(demotes) + len(promotes) >= 8 else "info"
+        if demotes:
+            obs_events.emit("serving", "kv_demote", severity=sev,
+                            pages=len(demotes),
+                            host_used=self.allocator.host_used)
+        if promotes:
+            obs_events.emit("serving", "kv_promote", severity=sev,
+                            pages=len(promotes),
+                            host_used=self.allocator.host_used)
 
     def step(self) -> bool:
         """One scheduler iteration: admissions (+ their tail prefills and
@@ -641,6 +810,10 @@ class ServingEngine:
             if not admitted:
                 break
             req = admitted[0]
+            # tier transitions queued by this admission's match/ensure
+            # (promoted radix hits, demoted reclaim victims) must land
+            # before the tail prefill touches the device pools
+            self._apply_tier_ops()
             self._run_prefill(req)
             if self.prefix_sharing:
                 # a request's committed context (prompt + pre-eviction
@@ -650,6 +823,7 @@ class ServingEngine:
                 self.allocator.register_prefix(req.rid, req.context)
             self.scheduler.activate(req)
         self.scheduler.grow()
+        self._apply_tier_ops()   # grow()'s reclaims demote before CoW writes
         self._apply_cow()
         running = list(self.scheduler.running)
         if not running:
@@ -658,8 +832,9 @@ class ServingEngine:
                 raise RuntimeError(
                     f"serving deadlock: request {blocked.rid} "
                     f"({blocked.total_len + 1} tokens) cannot be admitted "
-                    f"with {self.allocator.free_pages} free pages and "
-                    f"nothing left to evict")
+                    f"with {self.allocator.free_pages} free pages "
+                    f"({self.allocator.reclaimable_pages} reclaimable incl. "
+                    f"cold) and nothing left to evict")
             return False
         if obs_tracing.tracing_active():
             # one span per packed dispatch, carrying EVERY active request's
@@ -737,6 +912,7 @@ class ServingEngine:
                                        "for one full batch")
                 req.state = RequestState.RUNNING
                 req.admitted_t = time.perf_counter()
+                self._apply_tier_ops()
                 self._run_prefill(req)
             while any(not r.finished for r in group):
                 self._decode_once([r for r in group if not r.finished],
@@ -956,6 +1132,18 @@ class ServingEngine:
             "prefix_hit_rate": self.prefix_hit_rate,
             "cow_copies": self.allocator.cow_copies,
             "draft_ms_total": round(self._draft_ms, 3),
+            # PR-16 memory hierarchy: storage mode + tier occupancy and
+            # transition totals (the /stats view of the tier gauges)
+            "kv_cache_dtype": (self.kv_mode if self.kv_quantized
+                               else self.kv_dtype.name),
+            "kv_scale_bytes": self.kv_scale_bytes,
+            "kv_cold_pages": self.allocator.cold_pages,
+            "kv_host_pages": self.host_pages,
+            "kv_host_used": self.allocator.host_used,
+            "kv_demotions": self.allocator.demotions,
+            "kv_promotions": self.allocator.promotions,
+            "kv_cold_hits": self.allocator.cold_hits,
+            "kv_promote_failures": self.allocator.promote_failures,
         }
 
     @property
@@ -992,6 +1180,11 @@ class ServingEngine:
         self.allocator.cow_copies = 0
         self.allocator.prefix_matches = 0
         self.allocator.prefix_tokens_matched = 0
+        self.allocator.demotions = 0
+        self.allocator.promotions = 0
+        self.allocator.cold_hits = 0
+        self.allocator.dropped_cold = 0
+        self.allocator.promote_failures = 0
 
     @staticmethod
     def latency_stats(requests) -> dict:

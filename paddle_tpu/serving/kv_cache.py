@@ -28,10 +28,25 @@ Eviction is COPY-FREE: freeing a chain decrefs its pages (preempt-by-
 recomputation — the scheduler re-prefills the victim later); pages still
 held by sharers survive untouched, and a re-admitted victim re-matches the
 shared prefix so its re-prefill skips the shared pages again.
+
+Host-RAM cold tier (PR 16, the AllocatorFacade multi-tier shape): with
+``host_pages > 0``, an INDEXED page whose last holder frees it goes COLD
+(it keeps its prefix-index entry and its HBM bytes) instead of returning to
+the free list. Under allocation pressure the oldest cold page is reclaimed:
+its index entry demotes to a host slot (the engine drains the D2H page copy
+via `take_tier_ops` before any device write can touch the reclaimed page)
+or is dropped when the host pool is full. A radix hit on a host-resident
+prefix PROMOTES it — a fresh HBM page is allocated, the H2D restore copy is
+queued, and the entry re-enters the index as a cold HBM page the matcher
+then adopts normally (so a failed admission leaks nothing, and a CoW split
+of a demoted page always sees it promoted first). The
+``serving.kv.promote_fail`` chaos point makes a promotion lose the host
+entry instead: the match stops there and the request degrades to
+re-prefilling the tail.
 """
 from __future__ import annotations
 
-from collections import deque
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -40,21 +55,60 @@ __all__ = ["PageAllocator", "kv_page_bytes", "pages_for_budget"]
 NULL_PAGE = 0
 
 
+def _register_promote_fail():
+    from paddle_tpu.distributed.resilience import faults
+
+    faults.register(
+        "serving.kv.promote_fail",
+        "a host->HBM KV page promotion fails: the demoted prefix entry is "
+        "lost, the radix match stops at that depth and the request "
+        "degrades to re-prefilling the unmatched tail — never wedges")
+
+
+_register_promote_fail()
+
+
 def kv_page_bytes(num_layers: int, num_kv_heads: int, page_size: int,
                   head_dim: int, dtype_bytes=2) -> int:
     """K+V bytes ONE page costs across the whole layer stack — the unit of
     the serving HBM budget. `dtype_bytes` is the CACHE POOL dtype (an
     itemsize int, or any np/jnp dtype spec) — the pool may be narrower than
     the compute dtype (an int8 KV pool under a bf16 model halves page
-    bytes, doubling the pages a budget buys)."""
+    bytes, doubling the pages a budget buys). Quantized pools carry their
+    per-slot-per-head scale arrays SEPARATELY (4/head_dim of the pool
+    bytes — the engine reports them as `kv_scale_bytes`), so page capacity
+    comparisons across dtypes stay apples-to-apples on the pool itself."""
     if not isinstance(dtype_bytes, int):
         dtype_bytes = int(np.dtype(dtype_bytes).itemsize)
+    if min(num_layers, num_kv_heads, page_size, head_dim,
+           dtype_bytes) <= 0:
+        raise ValueError(
+            f"kv_page_bytes needs positive dimensions, got layers="
+            f"{num_layers} kv_heads={num_kv_heads} page_size={page_size} "
+            f"head_dim={head_dim} dtype_bytes={dtype_bytes}")
     return 2 * num_layers * num_kv_heads * page_size * head_dim * dtype_bytes
 
 
 def pages_for_budget(budget_bytes: int, page_bytes: int) -> int:
-    """Pool size (incl. the null page) fitting `budget_bytes`."""
-    return max(2, budget_bytes // max(page_bytes, 1))
+    """Pool size (incl. the null page) fitting `budget_bytes`. Raises on
+    budgets that cannot back a working pool — a zero/negative budget, or a
+    budget smaller than TWO pages (null + one usable) — instead of handing
+    the engine a pool it will die on later with an opaque allocator error.
+    """
+    if page_bytes <= 0:
+        raise ValueError(f"page_bytes must be positive, got {page_bytes}")
+    if budget_bytes <= 0:
+        raise ValueError(
+            f"KV budget must be positive, got {budget_bytes} bytes "
+            f"(check serving_hbm_budget_mb)")
+    pages = budget_bytes // page_bytes
+    if pages < 2:
+        raise ValueError(
+            f"KV budget of {budget_bytes} bytes buys {pages} page(s) of "
+            f"{page_bytes} bytes — the pool needs >= 2 (the reserved null "
+            f"page plus one usable); raise serving_hbm_budget_mb or lower "
+            f"serving_page_size/model KV width")
+    return pages
 
 
 def _prefix_key(tokens: np.ndarray, depth: int, page_size: int) -> bytes:
@@ -77,27 +131,81 @@ class PageAllocator:
     the allocator reports exhaustion and the scheduler evicts/queues).
     """
 
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int, host_pages: int = 0):
         if num_pages < 2:
             raise ValueError(f"need >= 2 pages (one is the reserved null "
                              f"page), got {num_pages}")
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if host_pages < 0:
+            raise ValueError(f"host_pages must be >= 0, got {host_pages}")
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
+        self.host_pages = int(host_pages)
         self._free = deque(range(1, num_pages))
         self._chains: dict[object, list[int]] = {}
         self._holders: dict[int, set] = {}      # page -> rids (refcount)
         self._prefix_index: dict[bytes, int] = {}   # token prefix -> page
         self._page_prefix: dict[int, bytes] = {}    # page -> its index key
+        # host cold tier (active iff host_pages > 0): COLD pages are
+        # HBM-resident, indexed, refcount-0 pages retained past their last
+        # holder (insertion order == demotion order under pressure); host
+        # slots hold demoted pages' bytes, owned by the engine's pinned
+        # host store — this map is pure index bookkeeping
+        self._cold: "OrderedDict[int, bytes]" = OrderedDict()
+        self._host_index: dict[bytes, int] = {}     # token prefix -> slot
+        self._host_prefix: dict[int, bytes] = {}    # slot -> its index key
+        self._host_free = deque(range(self.host_pages))
+        # cross-tier page copies the ENGINE must apply: demotions (hbm
+        # page -> host slot, D2H) queued by reclaim, promotions (host slot
+        # -> hbm page, H2D) queued by match; drained via take_tier_ops()
+        # BEFORE any device write can touch the pages involved
+        self._pending_demote: list[tuple[int, int]] = []
+        self._pending_promote: list[tuple[int, int]] = []
+        # host slots read by a pending promotion stay reserved until the
+        # engine drains the copy (a demotion reusing the slot first would
+        # overwrite the bytes the promotion is about to read)
+        self._promote_slots_pending: list[int] = []
         self.prefix_matches = 0                 # admissions that hit
         self.prefix_tokens_matched = 0          # tokens skipped via the index
         self.cow_copies = 0                     # copy-on-write page copies
+        self.demotions = 0                      # cold pages moved to host
+        self.promotions = 0                     # host pages restored to HBM
+        self.cold_hits = 0                      # matches on cold HBM pages
+        self.dropped_cold = 0                   # cold pages lost (host full)
+        self.promote_failures = 0               # chaos: promote_fail fires
 
     # ---- capacity ---------------------------------------------------------
     @property
+    def tier_enabled(self) -> bool:
+        return self.host_pages > 0
+
+    @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @property
+    def cold_pages(self) -> int:
+        return len(self._cold)
+
+    def _promote_locked(self) -> set:
+        """Cold pages whose H2D restore is still QUEUED: their HBM bytes
+        are stale until the engine drains take_tier_ops, so reclaiming
+        (and demoting!) one would ship garbage to the host tier."""
+        return {p for _, p in self._pending_promote if p in self._cold}
+
+    @property
+    def reclaimable_pages(self) -> int:
+        """Pages an allocation can draw on: truly free + cold (reclaiming a
+        cold page demotes or drops its index entry, never blocks), minus
+        cold pages locked by a pending promotion."""
+        return (len(self._free) + len(self._cold)
+                - len(self._promote_locked()))
+
+    @property
+    def host_used(self) -> int:
+        return (self.host_pages - len(self._host_free)
+                - len(self._promote_slots_pending))
 
     @property
     def used_pages(self) -> int:
@@ -110,7 +218,7 @@ class PageAllocator:
         return -(-int(tokens) // self.page_size) if tokens > 0 else 0
 
     def can_fit(self, tokens: int) -> bool:
-        return self.pages_for(tokens) <= self.free_pages
+        return self.pages_for(tokens) <= self.reclaimable_pages
 
     # ---- chains -----------------------------------------------------------
     def chain(self, rid) -> list[int]:
@@ -122,12 +230,50 @@ class PageAllocator:
     def is_shared(self, page: int) -> bool:
         return len(self._holders.get(page, ())) > 1
 
-    def _alloc_one(self, rid) -> int:
+    def _alloc_one(self, rid, protect=()) -> int:
+        if not self._free:
+            self._reclaim_cold(protect)
         page = self._free.popleft()
         assert page not in self._holders and page != NULL_PAGE, \
             f"page {page} double-allocated"
         self._holders[page] = {rid}
         return page
+
+    def _reclaim_cold(self, protect=()):
+        """Turn the oldest unprotected COLD page back into a free page:
+        its index entry demotes to a host slot (D2H copy queued for the
+        engine) or is dropped when the host pool is full. `protect` guards
+        pages a caller already matched/adopted in the same operation;
+        promote-locked pages (restore still queued) are never victims."""
+        locked = {p for _, p in self._pending_promote}
+        for page in self._cold:
+            if page not in protect and page not in locked:
+                break
+        else:
+            raise IndexError("no reclaimable cold page")
+        key = self._cold.pop(page)
+        assert self._page_prefix.pop(page, None) == key \
+            and self._prefix_index.pop(key, None) == page, \
+            f"cold page {page} out of sync with the prefix index"
+        if not self._host_free and self._host_index:
+            # host pool full: evict the OLDEST demoted entry to make room
+            # (the incoming page went cold more recently); a stale pending
+            # demote into the recycled slot is applied in queue order, so
+            # the new occupant's bytes land last
+            k0, s0 = next(iter(self._host_index.items()))
+            del self._host_index[k0]
+            del self._host_prefix[s0]
+            self._host_free.append(s0)
+            self.dropped_cold += 1
+        if self._host_free:
+            slot = self._host_free.popleft()
+            self._host_index[key] = slot
+            self._host_prefix[slot] = key
+            self._pending_demote.append((page, slot))
+            self.demotions += 1
+        else:
+            self.dropped_cold += 1
+        self._free.append(page)
 
     def _release_one(self, page: int, rid):
         holders = self._holders.get(page)
@@ -137,7 +283,14 @@ class PageAllocator:
         holders.discard(rid)
         if not holders:
             del self._holders[page]
-            key = self._page_prefix.pop(page, None)
+            key = self._page_prefix.get(page)
+            if (key is not None and self.tier_enabled
+                    and self._prefix_index.get(key) == page):
+                # cold retention: the indexed full page outlives its last
+                # holder — reclaimed lazily (demote-to-host) under pressure
+                self._cold[page] = key
+                return
+            self._page_prefix.pop(page, None)
             if key is not None and self._prefix_index.get(key) == page:
                 del self._prefix_index[key]
             self._free.append(page)
@@ -155,20 +308,37 @@ class PageAllocator:
             assert not chain, \
                 f"prefix adoption into a non-empty chain of {rid!r}"
             for page in adopt:
-                assert page in self._holders and page != NULL_PAGE, \
+                assert (page in self._holders or page in self._cold) \
+                    and page != NULL_PAGE, \
                     f"adopting unallocated page {page}"
         # ONE exhaustion check before ANY mutation (adoption consumes no
         # free pages, so the fresh-page shortfall is known up front):
-        # all-or-nothing needs no rollback path
+        # all-or-nothing needs no rollback path. Cold pages count as
+        # available (reclaiming one demotes/drops its index entry) EXCEPT
+        # the ones this very call adopts and the promote-locked ones
+        # (pending H2D restore — not reclaimable until the drain).
         need = (self.pages_for(total_tokens) - len(chain)
                 - (len(adopt) if adopt else 0))
-        if need > len(self._free):
+        adopt_set = set(adopt) if adopt else set()
+        locked = self._promote_locked()
+        avail = len(self._free) + len(self._cold) \
+            - sum(1 for p in self._cold
+                  if p in adopt_set or p in locked)
+        if need > avail:
             if not chain:
                 del self._chains[rid]
             return False
         if adopt:
             for page in adopt:
-                self._holders[page].add(rid)
+                if page in self._cold:
+                    # adopting a COLD page revives it copy-free: it leaves
+                    # the cold set and is refcounted like any shared page
+                    # (its index entry survives untouched)
+                    del self._cold[page]
+                    self._holders[page] = {rid}
+                    self.cold_hits += 1
+                else:
+                    self._holders[page].add(rid)
                 chain.append(page)
             self.prefix_matches += 1
             self.prefix_tokens_matched += len(adopt) * self.page_size
@@ -200,18 +370,91 @@ class PageAllocator:
     def match_prefix(self, tokens) -> tuple[list[int], int]:
         """Longest indexed prefix of `tokens`, in whole committed pages:
         returns (pages, matched_token_count). The radix walk is one index
-        probe per page_size stride, keyed by the exact token bytes."""
+        probe per page_size stride, keyed by the exact token bytes. A depth
+        resident only in the HOST tier is PROMOTED mid-walk (fresh HBM page
+        + pending H2D restore; the matcher then adopts it like any cold
+        page), so the caller never sees tiers — unless the
+        serving.kv.promote_fail chaos point fires, which loses the host
+        entry and stops the walk (the request re-prefills the tail)."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         pages: list[int] = []
         ps = self.page_size
         depth = 0
         while (depth + 1) * ps <= tokens.size:
-            page = self._prefix_index.get(_prefix_key(tokens, depth, ps))
+            key = _prefix_key(tokens, depth, ps)
+            page = self._prefix_index.get(key)
+            if page is None and key in self._host_index:
+                page = self._promote(key, protect=frozenset(pages))
             if page is None:
                 break
             pages.append(page)
             depth += 1
         return pages, depth * ps
+
+    def _promote(self, key: bytes, protect=frozenset()) -> int | None:
+        """Restore a host-resident prefix entry into a fresh HBM page: the
+        H2D copy is queued for the engine and the entry re-enters the index
+        as a COLD page (refcount 0) — adoption then refcounts it exactly
+        like a resident radix hit, and a failed admission leaves a
+        consistent cold page rather than a leak. Returns None when no HBM
+        page can be reclaimed (or the chaos point eats the host entry)."""
+        slot = self._host_index[key]
+        if self._fire_promote_fail():
+            # chaos: the restore path failed — the host entry is LOST (its
+            # bytes are unreachable) and the caller's walk stops here; the
+            # admission degrades to re-prefilling the unmatched tail
+            del self._host_index[key]
+            del self._host_prefix[slot]
+            self._host_free.append(slot)
+            self.promote_failures += 1
+            return None
+        locked = {p for _, p in self._pending_promote}
+        if not self._free and not any(p not in protect and p not in locked
+                                      for p in self._cold):
+            return None
+        page = self._free.popleft() if self._free else None
+        if page is None:
+            self._reclaim_cold(protect)
+            page = self._free.popleft()
+            if key not in self._host_index:
+                # reclaiming demoted INTO a full host pool and the FIFO
+                # drop evicted this very entry — the bytes are gone, so
+                # hand the page back and degrade to a miss
+                self._free.appendleft(page)
+                return None
+        del self._host_index[key]
+        del self._host_prefix[slot]
+        # the slot stays reserved (not free) until take_tier_ops drains
+        # the restore copy — see _promote_slots_pending
+        self._promote_slots_pending.append(slot)
+        self._pending_promote.append((slot, page))
+        self._prefix_index[key] = page
+        self._page_prefix[page] = key
+        self._cold[page] = key
+        self.promotions += 1
+        return page
+
+    @staticmethod
+    def _fire_promote_fail() -> bool:
+        from paddle_tpu.distributed.resilience import faults
+
+        return faults.fire_check("serving.kv.promote_fail")
+
+    def take_tier_ops(self) -> tuple[list[tuple[int, int]],
+                                     list[tuple[int, int]]]:
+        """Drain the pending cross-tier copies: (demotions [(hbm_page,
+        host_slot)...], promotions [(host_slot, hbm_page)...]). The engine
+        must apply them in THAT order — demotions first (their source pages
+        were handed back to the free list and will be rewritten), then
+        promotions (whose source slots a same-batch demotion can never
+        alias: slots read by promotions are only returned to the host free
+        list here, after the demotion list was fixed) — and must drain
+        BEFORE dispatching any program that writes the pages involved."""
+        demote, promote = self._pending_demote, self._pending_promote
+        self._pending_demote, self._pending_promote = [], []
+        self._host_free.extend(self._promote_slots_pending)
+        self._promote_slots_pending = []
+        return demote, promote
 
     def register_prefix(self, rid, tokens) -> int:
         """Index `rid`'s chain pages that hold FULL pages of the committed
@@ -230,6 +473,14 @@ class PageAllocator:
             page = chain[depth]
             if page in self._page_prefix:       # already indexed under
                 continue                        # another (stale) prefix
+            slot = self._host_index.pop(key, None)
+            if slot is not None:
+                # a freshly committed HBM copy supersedes the demoted one:
+                # drop the host entry (its slot may be reused immediately —
+                # any stale pending demote into it is applied in queue
+                # order, so the new occupant's bytes land last)
+                del self._host_prefix[slot]
+                self._host_free.append(slot)
             self._prefix_index[key] = page
             self._page_prefix[page] = key
             new += 1
@@ -252,7 +503,7 @@ class PageAllocator:
         hi = min(last_token // ps, len(chain) - 1)
         shared_idx = [i for i in range(lo, hi + 1)
                       if self.is_shared(chain[i])]
-        if len(shared_idx) > len(self._free):
+        if len(shared_idx) > self.reclaimable_pages:
             return None
         copies = []
         for i in shared_idx:
@@ -267,8 +518,11 @@ class PageAllocator:
     # ---- invariants -------------------------------------------------------
     def check_consistency(self):
         """Test hook: every allocated page refcounted by exactly the chains
-        that contain it, free list and refcounted pages partition the
-        non-null pool, the prefix index points only at allocated pages."""
+        that contain it; free list, refcounted pages and COLD pages
+        partition the non-null pool; the prefix index points only at
+        allocated-or-cold pages; the host tier's slot bookkeeping (index,
+        backrefs, free list, promote-reserved slots) partitions the host
+        pool with keys disjoint from the HBM index."""
         seen: dict[int, set] = {}
         for rid, chain in self._chains.items():
             for page in chain:
@@ -283,15 +537,52 @@ class PageAllocator:
                 f"page {page} refcount {sorted(map(repr, self._holders[page]))} " \
                 f"!= chains holding it {sorted(map(repr, rids))}"
         free = set(self._free)
+        cold = set(self._cold)
         assert len(free) == len(self._free), "free list duplicates"
         assert not (free & set(seen)), "free list overlaps a live chain"
-        assert len(free) + len(seen) == self.num_pages - 1, \
+        assert not (cold & free) and not (cold & set(seen)), \
+            "cold pages overlap the free list or a live chain"
+        assert len(free) + len(seen) + len(cold) == self.num_pages - 1, \
             "pages leaked or duplicated"
+        for page, key in self._cold.items():
+            assert self._page_prefix.get(page) == key \
+                and self._prefix_index.get(key) == page, \
+                f"cold page {page} out of sync with the prefix index"
         for key, page in self._prefix_index.items():
-            assert page in self._holders, \
+            assert page in self._holders or page in self._cold, \
                 f"prefix index points at freed page {page}"
             assert self._page_prefix.get(page) == key, \
                 f"prefix backref out of sync for page {page}"
         for page in self._page_prefix:
-            assert page in self._holders, \
+            assert page in self._holders or page in self._cold, \
                 f"prefix backref holds freed page {page}"
+        # ---- host tier ----
+        host_free = set(self._host_free)
+        pending = set(self._promote_slots_pending)
+        assert len(host_free) == len(self._host_free), \
+            "host free list duplicates"
+        assert len(pending) == len(self._promote_slots_pending), \
+            "promote-reserved slot duplicates"
+        held = set(self._host_prefix)
+        assert not (host_free & held) and not (pending & held) \
+            and not (host_free & pending), "host slot in two states"
+        assert len(host_free) + len(held) + len(pending) == self.host_pages, \
+            "host slots leaked or duplicated"
+        assert {k: s for s, k in self._host_prefix.items()} \
+            == self._host_index, "host index/backref out of sync"
+        assert not (set(self._host_index) & set(self._prefix_index)), \
+            "prefix resident in BOTH tiers"
+        for page, slot in self._pending_demote:
+            # a pending demote may be STALE (register_prefix of a fresher
+            # HBM copy freed its slot; a later demote may re-take it and a
+            # match may even promote-reserve it before one drain — queue
+            # order at the drain keeps the bytes right: demote writes land
+            # before promote reads), so the only hard invariant is that
+            # the slot is accounted for in the partition above
+            assert slot in host_free or slot in held or slot in pending, \
+                f"pending demotion into untracked host slot {slot}"
+        for slot, page in self._pending_promote:
+            assert slot in pending, \
+                f"pending promotion from unreserved host slot {slot}"
+            assert page in self._cold or page in self._holders, \
+                f"pending promotion into unallocated page {page}"

@@ -15,11 +15,15 @@ The machinery, in the order a request meets it:
 2. **Shed policy** — past the aggregate-depth watermark the router caps
    `max_new_tokens` (degrade before drop); the done event carries
    ``"shed": true`` so callers know.
-3. **Placement** — a request with a ``session`` key rendezvous-hashes
-   onto a healthy replica (minimal remap on membership change, so
-   follow-up turns land on the replica holding their KV pages); unkeyed
-   requests go to the least-loaded replica (router in-flight + probed
-   queue depth + slot fill).
+3. **Placement** — the request's placement key rendezvous-hashes onto a
+   healthy replica (minimal remap on membership change). With
+   ``router_placement=session`` (default) the key is the ``session`` id,
+   so follow-up turns land on the replica holding their KV pages; with
+   ``router_placement=prefix`` it is a digest of the prompt's first
+   ``router_prefix_tokens`` ids, so requests SHARING a system prompt land
+   where its pages already live (session id stays the tiebreak for
+   promptless payloads). Unkeyed requests go to the least-loaded replica
+   (router in-flight + probed queue depth + slot fill).
 4. **Relay with failover** — events are relayed with a gap timeout; a
    dead/wedged replica, cut stream, or dropped dispatch triggers a
    bounded re-dispatch (exponential backoff, `dispatch_attempts` total)
@@ -106,12 +110,19 @@ class RouterConfig:
     shed_queue_depth: int = -1        # <0 -> FLAGS_router_shed_queue_depth
     shed_max_new_tokens: int = 0      # 0 -> FLAGS_router_shed_max_new_tokens
     retry_after_s: float = 0.0        # 0 -> FLAGS_router_retry_after_s
+    placement: str = ""               # "" -> FLAGS_router_placement
+    prefix_tokens: int = 0            # 0 -> FLAGS_router_prefix_tokens
 
     def resolved(self) -> "RouterConfig":
         from paddle_tpu.core.flags import flag
 
         def pick(v, name, cast):
             return cast(v) if v > 0 else cast(flag(name))
+
+        placement = (self.placement or str(flag("router_placement"))).lower()
+        if placement not in ("session", "prefix"):
+            raise ValueError(f"router_placement must be 'session' or "
+                             f"'prefix', got {placement!r}")
 
         return RouterConfig(
             probe_interval_s=pick(self.probe_interval_s,
@@ -136,7 +147,10 @@ class RouterConfig:
             shed_max_new_tokens=pick(self.shed_max_new_tokens,
                                      "router_shed_max_new_tokens", int),
             retry_after_s=pick(self.retry_after_s,
-                               "router_retry_after_s", float))
+                               "router_retry_after_s", float),
+            placement=placement,
+            prefix_tokens=pick(self.prefix_tokens,
+                               "router_prefix_tokens", int))
 
 
 _ROUTER_COUNTERS = ("accepted", "completed", "failed", "refused",
@@ -391,6 +405,33 @@ class Router:
     # ------------------------------------------------------------------
     # placement
     # ------------------------------------------------------------------
+    def placement_key(self, payload: dict):
+        """The rendezvous key for one request, per `cfg.placement`:
+
+        * ``session`` — the session id (PR-11 behavior: one user's turns
+          stick to one replica and its KV pages).
+        * ``prefix`` — a blake2b digest of the prompt's first
+          ``prefix_tokens`` ids, so every request SHARING a system prompt
+          hashes to the SAME key and lands on the replica already holding
+          that prefix's pages (per-replica radix hits become a fleet-wide
+          property). Session id remains the tiebreak for promptless
+          payloads; a request with neither goes least-loaded (None).
+        """
+        session = payload.get("session")
+        if self.cfg.placement != "prefix":
+            return session
+        ids = payload.get("prompt_ids")
+        if ids is None:
+            return session
+        n = max(int(self.cfg.prefix_tokens), 1)
+        try:
+            head = [int(t) for t in list(ids)[:n]]
+        except (TypeError, ValueError):
+            return session
+        h = hashlib.blake2b(
+            b"\x00".join(str(t).encode() for t in head), digest_size=8)
+        return f"prefix:{h.hexdigest()}"
+
     def _pick(self, key, exclude) -> _Slot | None:
         with self._lock:
             cands = [s for s in self._slots.values()
@@ -498,7 +539,7 @@ class Router:
         """The dispatch/failover relay loop of one accepted request (the
         body of `stream()` — split out so the tracing span wraps it)."""
         cfg = self.cfg
-        key = payload.get("session")
+        key = self.placement_key(payload)
         delays = backoff_delays(cfg.dispatch_attempts, cfg.backoff_initial_s,
                                 cfg.backoff_max_s)
         emitted, attempts = 0, 0
@@ -658,6 +699,7 @@ class Router:
     def stats(self) -> dict:
         with self._lock:
             return {
+                "placement_mode": self.cfg.placement,
                 "in_flight": len(self._inflight),
                 "accepted": self.accepted, "completed": self.completed,
                 "failed": self.failed, "refused": self.refused,

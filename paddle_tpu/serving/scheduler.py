@@ -196,6 +196,9 @@ class ContinuousBatchingScheduler:
                     "scheduler.admit", t0, time.perf_counter_ns() - t0,
                     {"component": "scheduler", "rid": req.rid,
                      "matched_tokens": matched,
+                     # host-tier restores this match triggered (radix hits
+                     # on demoted pages promote before the tail prefill)
+                     "promotions_total": self.allocator.promotions,
                      **({"trace_id": req.trace_id} if req.trace_id else {})})
         return admitted
 

@@ -46,12 +46,14 @@ CHAOS_POINTS = [
 ]
 # the serving half of the registry (PR 11/12): registered at import of
 # paddle_tpu.serving.replica/router/engine, exercised by the routed chaos
-# matrix in test_router.py (transport points) and the speculative-decode
-# degradation test in test_serving.py (serving.spec.verify_mismatch) —
-# these points fire on serving traffic, so injecting them into a
-# Model.fit run would test nothing
+# matrix in test_router.py (transport points), the speculative-decode
+# degradation test in test_serving.py (serving.spec.verify_mismatch), and
+# the host-tier degradation tests in test_kv_hierarchy.py
+# (serving.kv.promote_fail) — these points fire on serving traffic, so
+# injecting them into a Model.fit run would test nothing
 SERVING_CHAOS_POINTS = [
-    "serving.dispatch.drop", "serving.replica.kill", "serving.replica.slow",
+    "serving.dispatch.drop", "serving.kv.promote_fail",
+    "serving.replica.kill", "serving.replica.slow",
     "serving.spec.verify_mismatch", "serving.stream.cut",
 ]
 

@@ -127,7 +127,16 @@ class TestPageAllocator:
                            head_dim=64, dtype_bytes=2)
         assert pb == 2 * 2 * 2 * 16 * 64 * 2      # k+v * L * H * ps * D * b
         assert pages_for_budget(10 * pb, pb) == 10
-        assert pages_for_budget(0, pb) == 2               # floor: null + 1
+        # PR-16 hardening: budgets that cannot back a working pool fail
+        # LOUDLY at sizing time, not later inside the engine
+        with pytest.raises(ValueError, match="positive"):
+            pages_for_budget(0, pb)
+        with pytest.raises(ValueError, match="positive"):
+            pages_for_budget(-1, pb)
+        with pytest.raises(ValueError, match=">= 2"):
+            pages_for_budget(pb, pb)                      # 1 page < null + 1
+        with pytest.raises(ValueError, match="page_bytes"):
+            pages_for_budget(10 * pb, 0)
 
 
 class TestSchedulerEviction:

@@ -23,7 +23,7 @@ TOLERANCE = 0.10
 
 def run_bench() -> dict:
     res = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                         capture_output=True, text=True, timeout=900)
+                         capture_output=True, text=True, timeout=2100)
     if res.returncode != 0:
         print(res.stdout[-2000:], res.stderr[-2000:], file=sys.stderr)
         raise SystemExit("bench.py failed")
@@ -93,6 +93,95 @@ def _moe_gates(cur: dict):
             "dense-masked reference")
 
 
+def _cache_gates(cur: dict):
+    """KV memory-hierarchy self-consistency gates (docs/serving.md): int8
+    pages must buy >= 1.9x capacity at a fixed budget and convert it into
+    throughput/p99 wins on the budget-matched arms, streams must be
+    bit-equal across the host-tier axis and >= 99% token-match across the
+    dtype axis, the demote->promote roundtrip (and its promote_fail
+    chaos) must reproduce the exact stream, and prefix-affinity placement
+    must hold the fleet prefix-hit >= 0.9 where session placement
+    scatters it."""
+    kv = (cur["detail"] or {}).get("kv_cache") or {}
+    if not kv:
+        # fail CLOSED: the arm goes missing exactly when the cache probe
+        # crashed, which is when these gates matter most
+        raise SystemExit(
+            "KV-CACHE REGRESSION: the CACHE_JSON arm is missing from the "
+            "bench report (probe failed?) — the hierarchy gates cannot run")
+    cap = kv["capacity"]
+    mat = kv["matrix"]
+    tier = kv["tier_roundtrip"]
+    routing = kv["routing"]
+    arms = mat["arms"]
+    print(f"kv-cache: capacity {cap['capacity_ratio']}x, int8 "
+          f"{arms['int8_tier']['tokens_per_sec']} vs model "
+          f"{arms['model_tier']['tokens_per_sec']} tok/s, int8 match "
+          f"{mat['int8_token_match_vs_model']}, fleet prefix-hit "
+          f"{routing['prefix']['fleet_prefix_hit']} (session "
+          f"{routing['session']['fleet_prefix_hit']})")
+    if not cap.get("capacity_ok", False):
+        raise SystemExit(
+            f"KV-CACHE REGRESSION: int8 capacity ratio "
+            f"{cap['capacity_ratio']} below the 1.9x gate")
+    if not mat.get("int8_capacity_realized", False):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: at one byte budget the int8 arm must "
+            "serve the burst with ZERO evictions while the model-dtype "
+            "arm evicts — the capacity win stopped being realized")
+    if not mat.get("int8_overhead_ok", False):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: int8 arm fell below 0.5x the "
+            "model-dtype arm's tokens/sec (dequant overhead blew up)")
+    if not mat.get("int8_p99_ok", False):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: int8 arm p99 above 2x the model-dtype "
+            "arm at the same byte budget")
+    if not mat.get("model_streams_bit_equal_across_tier", False):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: host-tier demote/promote changed a "
+            "model-dtype greedy stream (roundtrip must be byte-exact)")
+    if not mat.get("int8_streams_bit_equal_across_tier", False):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: host-tier demote/promote changed an "
+            "int8 greedy stream (codes+scales roundtrip must be exact)")
+    if not mat.get("int8_match_ok", False):
+        raise SystemExit(
+            f"KV-CACHE REGRESSION: int8 token match "
+            f"{mat['int8_token_match_vs_model']} below the 0.99 gate")
+    if not mat.get("tier_demotions_exercised", False):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: the pressured tier arm demoted nothing "
+            "— the hierarchy was not exercised")
+    if not (mat.get("zero_retrace_ok", False)
+            and tier.get("zero_retrace_ok", False)):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: decode recompiled after warmup on a "
+            "hierarchy arm (tier/quant must be shape-stable)")
+    if not tier.get("promotions_exercised", False) \
+            or not tier.get("stream_equal_after_promote", False):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: radix hit on a demoted page did not "
+            "restore the exact stream via promotion")
+    if not (tier.get("chaos") or {}).get("degraded_not_wedged", False):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: promote_fail chaos did not degrade to "
+            "a clean re-prefill of the identical stream")
+    if not routing.get("prefix_hit_ok", False):
+        raise SystemExit(
+            f"KV-CACHE REGRESSION: fleet prefix-hit "
+            f"{routing['prefix']['fleet_prefix_hit']} below the 0.9 gate "
+            f"under prefix-affinity placement")
+    if not routing.get("prefix_beats_session", False):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: prefix-affinity placement no better "
+            "than session placement on the shared-prefix fleet workload")
+    if not routing.get("remap_minimal", False):
+        raise SystemExit(
+            "KV-CACHE REGRESSION: rendezvous remap over prefix keys was "
+            "not minimal on membership change")
+
+
 def main():
     cur = run_bench()
     platform = cur["detail"]["platform"]
@@ -105,6 +194,7 @@ def main():
     # self-consistency gates first: they compare arms WITHIN this run, so
     # they hold on any platform, baseline recorded or not
     _moe_gates(cur)
+    _cache_gates(cur)
 
     if not os.path.exists(BASELINE):
         raise SystemExit(f"no {BASELINE}; record one with --update")
