@@ -2446,6 +2446,266 @@ def _cache_probe():
     return None
 
 
+LORA_PROBE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import json, tempfile, time
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.lora import (AdapterStore, LoRAConfig, attach, detach,
+                             export_adapter, load_adapter)
+from paddle_tpu.lora.store import AdapterLoadError
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ServingConfig, ServingEngine
+
+# Multi-tenant LoRA economics on the CPU interpret path (LORA_JSON):
+# (1) tokens/sec + p99 for the SAME traffic through ONE storeful engine
+#     at 0 (base rows via the trash slot), 1, and 16 concurrent
+#     adapters — the multi-tenant tax is the grouped-matmul gather and
+#     must stay >= 0.8x single-tenant tokens/sec (the acceptance gate).
+#     The 256-adapter sweep needs real hardware (CPU interpret wall
+#     clock) — ROADMAP item-5 remainder, declared, not silently capped.
+# (2) hot-swap latency: re-register a RESIDENT adapter (eager
+#     .at[slot].set pool rewrite) — what a tenant pays for a mid-flight
+#     model update under live traffic.
+# (3) swap_fail chaos: a failed swap-in costs ONE typed error, the pool
+#     recovers, mixed traffic completes — zero retraces throughout.
+cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=128,
+                  use_parallel_cross_entropy=False)
+paddle.seed(0)
+model = LlamaForCausalLM(cfg)
+model.eval()
+
+RANK, NA = 4, 16
+d = tempfile.mkdtemp()
+paths = {}
+for i in range(NA + 1):                     # one extra for the chaos arm
+    aid = f"t{i}"
+    h = attach(model, LoRAConfig(rank=RANK, alpha=2.0 * RANK, seed=i))
+    r = np.random.default_rng(i)
+    for _, _, _, B in h.entries:
+        B.set_value((r.standard_normal(tuple(B.shape)) * 0.05)
+                    .astype(np.float32))
+    paths[aid] = os.path.join(d, aid + ".pdmodel")
+    export_adapter(paths[aid], h, adapter_id=aid)
+    detach(h)
+artifact_bytes = os.path.getsize(paths["t0"])
+
+store = AdapterStore(model, rank=RANK, slots=NA)
+for i in range(NA):
+    store.register(f"t{i}", paths[f"t{i}"])
+eng = ServingEngine(model, ServingConfig(
+    page_size=16, num_pages=128, decode_batch=8, prefill_chunk=16,
+    max_seq_len=64), adapter_store=store)
+
+rng = np.random.RandomState(3)
+N = 24
+prompts = [rng.randint(1, cfg.vocab_size, int(n)).astype(np.int32)
+           for n in rng.randint(6, 13, N)]
+news = [int(n) for n in rng.randint(16, 25, N)]
+
+# warm every program (one prefill bucket, decode, the adapter path) and
+# swap ALL 16 adapters resident — the gate scores steady-state serving,
+# not the one-time cold swap-in of a fresh tenant — then freeze the
+# retrace counter
+eng.generate([prompts[0], prompts[1]], max_new_tokens=4)
+w = eng.submit(prompts[2], max_new_tokens=4, adapter="t0")
+while not eng.scheduler.idle:
+    eng.step()
+eng.release(w)
+for i in range(NA):
+    store.acquire(f"t{i}")
+    store.release(f"t{i}")
+eng.mark_warmup()
+
+
+def run_arm(which):
+    # Two passes over the same traffic: the first (unmeasured) absorbs
+    # per-arm one-time costs — allocator/page-pool growth, any residual
+    # host-side compilation — which on the 2-core CPU runner dwarf the
+    # ~0.5s of real work; the second pass is the steady state the
+    # acceptance gate scores. (Retraces stay frozen across both.)
+    for measured in (False, True):
+        t0 = time.perf_counter()
+        rids = [eng.submit(prompts[i], max_new_tokens=news[i],
+                           adapter=which(i), tenant=which(i) or "")
+                for i in range(N)]
+        while not eng.scheduler.idle:
+            eng.step()
+        t = time.perf_counter() - t0
+        reqs = [eng.scheduler.get(r) for r in rids]
+        lat = ServingEngine.latency_stats(reqs)
+        toks = sum(len(r.generated) for r in reqs)
+        for r in rids:
+            eng.release(r)
+    return {"adapters": len({which(i) for i in range(N)} - {None}),
+            "tokens": toks,
+            "tokens_per_sec": round(toks / t, 1),
+            "p50_ms": lat.get("p50_ms"), "p99_ms": lat.get("p99_ms"),
+            "decode_retraces_after_warmup":
+                eng.decode_retraces_after_warmup}
+
+
+arms = {"base": run_arm(lambda i: None),
+        "single": run_arm(lambda i: "t0"),
+        "multi16": run_arm(lambda i: f"t{i % 16}")}
+
+# ---- hot-swap latency (resident-slot rewrite under the write path) ---------
+blob_a, blob_b = load_adapter(paths["t0"]), load_adapter(paths["t1"])
+blob_b["adapter"]["id"] = "t0"
+store.register("t0", blob_b)                # compile the slot write once
+times = []
+for k in range(6):
+    t0 = time.perf_counter()
+    store.register("t0", blob_a if k % 2 else blob_b)
+    times.append((time.perf_counter() - t0) * 1e3)
+hot_swap = {"mean_ms": round(sum(times) / len(times), 3),
+            "max_ms": round(max(times), 3),
+            "store_swap_ms_mean": store.residency()["swap_ms_mean"]}
+
+# ---- swap_fail chaos: one typed error, pool recovers, traffic completes ----
+store.register("t16", paths["t16"])         # registered, NOT resident
+faults.reset()
+typed = 0
+try:
+    faults.arm("serving.lora.swap_fail", mode="once")
+    try:
+        eng.submit(prompts[0], adapter="t16")
+    except AdapterLoadError:
+        typed += 1
+finally:
+    faults.reset()
+rids = [eng.submit(prompts[i], max_new_tokens=4,
+                   adapter=(None, "t3", "t16")[i % 3]) for i in range(6)]
+while not eng.scheduler.idle:
+    eng.step()
+completed = sum(len(eng.scheduler.get(r).generated) == 4 for r in rids)
+for r in rids:
+    eng.release(r)
+chaos = {"typed_errors": typed, "completed": completed,
+         "degraded_not_wedged": bool(typed == 1 and completed == 6)}
+
+# ---- ROUTER_JSON chaos re-run with adapters on (satellite) -----------------
+# A 2-replica fleet where every payload carries an adapter + tenant:
+# replica 1 is killed while it is mid-service, so the contract under test
+# is ROUTER_JSON's (kill strands live streams -> failover re-prefill,
+# nothing lost) COMPOSED with the adapter plane (the re-prefilled request
+# re-pins its adapter on the survivor's store). Survivor decode must not
+# retrace.
+import threading
+from paddle_tpu.serving import InProcessReplica, Router, RouterConfig
+
+m2 = LlamaForCausalLM(cfg)
+m2.eval()
+store2 = AdapterStore(m2, rank=RANK, slots=4)
+for i in range(4):
+    store2.register(f"t{i}", paths[f"t{i}"])
+eng2 = ServingEngine(m2, ServingConfig(
+    page_size=16, num_pages=64, decode_batch=4, prefill_chunk=16,
+    max_seq_len=64), adapter_store=store2)
+eng2.generate([prompts[0]], max_new_tokens=2)
+w = eng2.submit(prompts[1], max_new_tokens=2, adapter="t0")
+while not eng2.scheduler.idle:
+    eng2.step()
+eng2.release(w)
+eng2.mark_warmup()
+
+reps = [InProcessReplica(eng, replica_id=0),
+        InProcessReplica(eng2, replica_id=1)]
+router = Router(reps, RouterConfig(probe_interval_s=0.05,
+                                   gap_timeout_s=2.0))
+M = 8
+rc_results = [None] * M
+
+
+def rc_client(i):
+    try:
+        toks, term = router.generate(
+            {"prompt_ids": [int(t) for t in prompts[i]],
+             "max_new_tokens": 24, "adapter": f"t{i % 4}",
+             "tenant": f"ten{i % 4}", "session": f"rc{i}"})
+        rc_results[i] = (toks, term)
+    except Exception as e:
+        rc_results[i] = ([], {"error": repr(e)})
+
+
+def rc_killer():
+    deadline = time.perf_counter() + 5.0
+    while (time.perf_counter() < deadline
+           and not eng2.scheduler.running):
+        time.sleep(0.002)
+    reps[1].kill()
+
+
+threads = [threading.Thread(target=rc_client, args=(i,)) for i in range(M)]
+kt = threading.Thread(target=rc_killer)
+for t in threads:
+    t.start()
+kt.start()
+for t in threads:
+    t.join(timeout=120.0)
+kt.join(timeout=10.0)
+rc_done = sum(1 for r in rc_results if r and r[1] and r[1].get("done"))
+rc_stats = router.stats()
+router.close()
+for rep in reps:
+    rep.close()
+router_chaos = {
+    "replicas": 2, "killed_replica": 1, "requests": M,
+    "completed": rc_done, "lost": M - rc_done,
+    "failovers": rc_stats.get("failovers"),
+    "survivor_zero_retrace": bool(eng.decode_retraces_after_warmup == 0),
+    "ok": bool(rc_done == M
+               and eng.decode_retraces_after_warmup == 0),
+}
+
+ratio = arms["multi16"]["tokens_per_sec"] / max(
+    arms["single"]["tokens_per_sec"], 1e-9)
+out = {
+    "rank": RANK, "slots": NA, "requests": N,
+    "adapter_artifact_bytes": int(artifact_bytes),
+    "arms": arms,
+    "multi_vs_single_ratio": round(ratio, 3),
+    "multi_tenant_ok": bool(ratio >= 0.8),
+    "p99_ok": bool((arms["multi16"]["p99_ms"] or 0)
+                   <= 2.0 * (arms["single"]["p99_ms"] or 1)),
+    "hot_swap": hot_swap,
+    "chaos": chaos,
+    "router_chaos": router_chaos,
+    "zero_retrace_ok": bool(eng.decode_retraces_after_warmup == 0),
+    "skipped_256_adapters": "CPU interpret wall clock; real-TPU "
+                            "remainder (ROADMAP item 5)",
+}
+print("LORA_JSON " + json.dumps(out))
+"""
+
+
+def _lora_probe():
+    """Multi-tenant LoRA probe on CPU (PR 17): tokens/sec + p99 at
+    0/1/16 concurrent adapters through one storeful engine, resident-slot
+    hot-swap latency, and the swap_fail chaos degradation (LORA_JSON)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        res = subprocess.run([sys.executable, "-c", LORA_PROBE],
+                             capture_output=True, text=True, timeout=900,
+                             env=env)
+        for line in res.stdout.splitlines():
+            if line.startswith("LORA_JSON "):
+                return json.loads(line[len("LORA_JSON "):])
+        print(f"lora probe produced no result; stderr tail:\n"
+              f"{res.stderr[-800:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"lora probe failed: {e!r}", file=sys.stderr)
+    return None
+
+
 OBS_PROBE = r"""
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -3036,6 +3296,7 @@ def main():
     resilience = _resilience_probe()
     router = _router_probe()
     kv_cache = _cache_probe()
+    lora = _lora_probe()
     observability = _observability_probe()
     # fixed-geometry 8-layer probe: compile-time O(1)-in-depth + remat-policy
     # memory lever, comparable across rounds on any platform. The measured
@@ -3113,6 +3374,20 @@ def main():
                   "3-replica fleet prefix-hit rate under prefix-affinity "
                   "placement").set(
             kv_cache["routing"]["prefix"]["fleet_prefix_hit"])
+    if lora:
+        # multi-tenant LoRA instrument (PR 17): the multi-tenant tax and
+        # the hot-swap latency, gated by bench_regression
+        reg.gauge("bench_lora_single_tokens_per_sec",
+                  "single-adapter serving throughput through the "
+                  "storeful engine").set(
+            lora["arms"]["single"]["tokens_per_sec"])
+        reg.gauge("bench_lora_multi16_tokens_per_sec",
+                  "16-concurrent-adapter heterogeneous-batch "
+                  "throughput, same engine/traffic").set(
+            lora["arms"]["multi16"]["tokens_per_sec"])
+        reg.gauge("bench_lora_hot_swap_ms",
+                  "mean resident-slot adapter hot-swap latency").set(
+            lora["hot_swap"]["mean_ms"])
     snap = reg.snapshot()
     metrics_snapshot = {
         name: snap[name]["samples"][0]["value"]
@@ -3127,7 +3402,10 @@ def main():
                      "bench_kv_int8_capacity_ratio",
                      "bench_kv_model_tokens_per_sec",
                      "bench_kv_int8_tokens_per_sec",
-                     "bench_kv_fleet_prefix_hit")
+                     "bench_kv_fleet_prefix_hit",
+                     "bench_lora_single_tokens_per_sec",
+                     "bench_lora_multi16_tokens_per_sec",
+                     "bench_lora_hot_swap_ms")
         if name in snap}
     metrics_snapshot["mfu_source"] = mfu_source
 
@@ -3163,6 +3441,7 @@ def main():
                    "resilience": resilience,
                    "router": router,
                    "kv_cache": kv_cache,
+                   "lora": lora,
                    "observability": observability},
     }))
 

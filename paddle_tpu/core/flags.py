@@ -336,9 +336,24 @@ define_flag("router_placement", "session",
             "first router_prefix_tokens ids (session id as tiebreak when "
             "no prompt is present), so requests sharing a system prompt "
             "land where its KV pages already live and the per-replica "
-            "prefix-hit rate becomes a fleet-wide property")
+            "prefix-hit rate becomes a fleet-wide property; 'adapter' "
+            "rendezvous-hashes the request's LoRA adapter id (session "
+            "fallback when none), so one tenant's requests land where "
+            "their adapter is already resident in the slot pool")
 define_flag("router_prefix_tokens", 64,
             "prompt-prefix digest length (tokens) for "
             "router_placement=prefix: long enough to separate distinct "
             "system prompts, short enough that a shared preamble maps all "
             "its requests to one digest", type=int)
+define_flag("router_tenant_max_inflight", 0,
+            "per-tenant in-flight fairness cap at router admission: one "
+            "tenant (request 'tenant' field, adapter id fallback) may hold "
+            "at most this many concurrent streams — past it the request is "
+            "refused with a typed 'tenant_limit' event + Retry-After, so a "
+            "flooding tenant cannot starve the shared engine; 0 = off",
+            type=int)
+define_flag("serving_adapter_slots", 16,
+            "LoRA AdapterStore HBM slot-pool size: how many adapters can "
+            "be RESIDENT (servable) at once per engine; registered "
+            "adapters beyond this page host<->HBM on demand (LRU over "
+            "refcount-0 slots, pinned slots never evicted)", type=int)

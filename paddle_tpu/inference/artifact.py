@@ -57,7 +57,10 @@ def write_artifact(path: str, blob: dict) -> None:
         for p in params]
     with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as z:
         z.writestr(_META, json.dumps(meta))
-        z.writestr(_PROGRAM, bytes(blob["stablehlo"]))
+        # program member is optional: LoRA adapter artifacts are pure data
+        # (factors + 'adapter' meta block) against a shared base program
+        if blob.get("stablehlo") is not None:
+            z.writestr(_PROGRAM, bytes(blob["stablehlo"]))
         for i, p in enumerate(params):
             z.writestr(_param_name(i), np.ascontiguousarray(p).tobytes())
 
@@ -99,6 +102,7 @@ def read_artifact(path: str) -> dict:
             arr = np.frombuffer(raw, dtype=np_dtype(entry["dtype"]))
             params.append(arr.reshape([int(d) for d in entry["shape"]]))
         blob = dict(meta)
-        blob["stablehlo"] = z.read(_PROGRAM)
+        if _PROGRAM in z.namelist():
+            blob["stablehlo"] = z.read(_PROGRAM)
         blob["params"] = params
     return blob

@@ -1,0 +1,33 @@
+"""Multi-tenant LoRA: adapter train -> export -> serve on one base model.
+
+Lazy by design (PEP 562): `nn.functional` imports `paddle_tpu.lora.seam`
+at module load to hook the `F.linear` dispatch seam, so this package
+must not eagerly pull in the adapter/store stacks (inference.artifact,
+observability, resilience) — attribute access resolves them on demand.
+"""
+from __future__ import annotations
+
+from paddle_tpu.lora import seam  # light: stdlib + lazy jax
+
+__all__ = ["seam", "LoRAConfig", "LoRAAdapter", "attach", "detach",
+           "export_adapter", "load_adapter", "find_targets",
+           "DEFAULT_TARGETS", "AdapterStore", "AdapterLoadError"]
+
+_ADAPTER = ("LoRAConfig", "LoRAAdapter", "attach", "detach",
+            "export_adapter", "load_adapter", "find_targets",
+            "DEFAULT_TARGETS")
+_STORE = ("AdapterStore", "AdapterLoadError")
+
+
+def __getattr__(name):
+    if name in _ADAPTER:
+        from paddle_tpu.lora import adapter
+        return getattr(adapter, name)
+    if name in _STORE:
+        from paddle_tpu.lora import store
+        return getattr(store, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(__all__)

@@ -1,0 +1,232 @@
+"""LoRA adapter definition + frozen-base training + artifact export.
+
+The adapter math (Hu et al.): a target projection ``y = x @ W`` gains a
+rank-``r`` update ``y = x @ W + (alpha / r) * (x @ A) @ B`` with
+``A [d_in, r]`` (small random init) and ``B [r, d_out]`` (zeros — the
+delta starts at exactly zero, so attaching is a no-op until training
+moves B). W stays FROZEN: `attach()` flips every base parameter's
+``stop_gradient`` on, so a `CompiledTrainStep` built afterwards computes
+gradients and allocates optimizer moments for the adapter factors ONLY
+(base params ride through its donated buffers read-only).
+
+Attachment is by dispatch seam, not by module surgery: A/B register in
+`lora.seam` keyed by ``id(weight)`` and `F.linear` adds the delta for
+any projection whose weight is adapted — `ColumnParallelLinear`,
+`RowParallelLinear` and plain `nn.Linear` all route through that one
+seam, so no model rewrite is needed. The factors also land on the model
+as a ``_lora_host`` sublayer, which puts them in ``model.parameters()``
+(what `CompiledTrainStep` packs) and in checkpoints.
+
+Export writes a tiny `paddle_tpu-npz1` container (inference/artifact.py)
+holding ONLY the A/B factors plus an ``adapter`` meta block — no
+stablehlo program, no base weights: thousands of per-customer adapters
+stay kilobytes each against one shared base.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from paddle_tpu.lora import seam
+
+__all__ = ["LoRAConfig", "LoRAAdapter", "attach", "detach",
+           "export_adapter", "load_adapter", "find_targets",
+           "DEFAULT_TARGETS"]
+
+DEFAULT_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj")
+
+
+@dataclass
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: tuple = DEFAULT_TARGETS
+    dtype: object = None          # None -> each target weight's dtype
+    seed: int = 0
+
+    @property
+    def scale(self) -> float:
+        return float(self.alpha) / float(self.rank)
+
+
+def find_targets(model, targets):
+    """Deterministic (traversal-order) list of ``(path, weight Parameter)``
+    for every sublayer whose attribute name matches a target projection
+    and that carries a 2-D ``weight`` — the shared discovery both
+    `attach()` (training) and the serving `AdapterStore` run, so exported
+    factor order lines up with the store's pool order by construction."""
+    found = []
+    seen = set()
+    for path, sub in model.named_sublayers():
+        if path.rsplit(".", 1)[-1] not in targets:
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None or getattr(w, "ndim", 0) != 2 or id(w) in seen:
+            continue
+        seen.add(id(w))
+        found.append((path, w))
+    if not found:
+        raise ValueError(
+            f"no LoRA target projections found: none of {tuple(targets)} "
+            f"name a sublayer with a 2-D weight on {type(model).__name__}")
+    return found
+
+
+@dataclass
+class LoRAAdapter:
+    """Handle returned by `attach()`: the adapted weights and their A/B
+    factors, plus what `detach()` needs to restore the model exactly."""
+    model: object
+    config: LoRAConfig
+    entries: list = field(default_factory=list)   # (path, weight, A, B)
+    _frozen: list = field(default_factory=list)   # (param, prior stop_gradient)
+    _attached: bool = True
+
+    def parameters(self):
+        out = []
+        for _, _, a, b in self.entries:
+            out.extend((a, b))
+        return out
+
+    def export(self, path: str, adapter_id: str = "adapter"):
+        export_adapter(path, self, adapter_id=adapter_id)
+
+    def load_weights(self, blob: dict):
+        """Overwrite the attached factors from a `load_adapter()` blob
+        (rank/targets validated) — resume or A/B-swap during training."""
+        meta, weights = blob["adapter"], blob["weights"]
+        if int(meta["rank"]) != int(self.config.rank):
+            raise ValueError(f"adapter rank {meta['rank']} != attached "
+                             f"rank {self.config.rank}")
+        for path, _, a, b in self.entries:
+            if path not in weights:
+                raise ValueError(f"adapter blob is missing factors for "
+                                 f"target {path!r}")
+            av, bv = weights[path]
+            a.set_value(np.asarray(av))
+            b.set_value(np.asarray(bv))
+
+
+def attach(model, config: LoRAConfig | None = None,
+           freeze_base: bool = True) -> LoRAAdapter:
+    """Attach rank-``r`` factors to every target projection and (by
+    default) freeze the base: afterwards ``model.parameters()`` is the
+    frozen base plus the trainable A/B factors, and a `CompiledTrainStep`
+    built from it trains ONLY the adapter (its optimizer state is sized
+    to the adapter — train_step keeps no moments for frozen entries).
+    Detach before serving the base through an `AdapterStore`."""
+    from paddle_tpu.nn.layer.layers import Layer, Parameter
+
+    cfg = config or LoRAConfig()
+    if int(cfg.rank) <= 0:
+        raise ValueError(f"LoRA rank must be positive, got {cfg.rank}")
+    if getattr(model, "_lora_host", None) is not None:
+        raise ValueError("model already has a LoRA adapter attached; "
+                         "detach() it first")
+    handle = LoRAAdapter(model=model, config=cfg)
+    if freeze_base:
+        for p in model.parameters():
+            handle._frozen.append((p, p.stop_gradient))
+            p.stop_gradient = True
+    rng = np.random.default_rng(cfg.seed)
+    host = Layer()
+    for i, (path, w) in enumerate(find_targets(model, cfg.targets)):
+        d_in, d_out = int(w.shape[0]), int(w.shape[1])
+        if cfg.dtype is None:
+            dt = np.dtype(w._value.dtype)
+        elif isinstance(cfg.dtype, str):
+            from paddle_tpu.inference.artifact import np_dtype
+            dt = np_dtype(cfg.dtype)      # "bfloat16" and friends
+        else:
+            dt = np.dtype(cfg.dtype)
+        # A: small random (the delta needs a non-degenerate input
+        # projection); B: zeros, so attach is exactly a no-op at step 0
+        a_np = (rng.standard_normal((d_in, cfg.rank))
+                * (1.0 / max(cfg.rank, 1)))
+        A = Parameter(a_np.astype(dt), trainable=True, name=f"lora_a_{i}")
+        B = Parameter(np.zeros((cfg.rank, d_out), dt), trainable=True,
+                      name=f"lora_b_{i}")
+        setattr(host, f"a_{i}", A)
+        setattr(host, f"b_{i}", B)
+        handle.entries.append((path, w, A, B))
+        seam.train_register(id(w), seam.TrainEntry(A, B, cfg.scale))
+    model._lora_host = host
+    return handle
+
+
+def detach(handle: LoRAAdapter):
+    """Remove the adapter: clear the seam registry, drop the host
+    sublayer (A/B leave ``model.parameters()``), restore every base
+    parameter's prior ``stop_gradient``. The model is bit-identical to
+    pre-attach (B started at zero and W was never written)."""
+    if not handle._attached:
+        return
+    handle._attached = False
+    seam.train_clear(id(w) for _, w, _, _ in handle.entries)
+    model = handle.model
+    if getattr(model, "_lora_host", None) is not None:
+        model._sub_layers.pop("_lora_host", None)
+        model._lora_host = None
+        model._sub_layers.pop("_lora_host", None)
+    for p, prior in handle._frozen:
+        p.stop_gradient = prior
+
+
+def export_adapter(path: str, handle: LoRAAdapter,
+                   adapter_id: str = "adapter"):
+    """Write the adapter as a `paddle_tpu-npz1` artifact: params are the
+    interleaved ``[A_0, B_0, A_1, B_1, ...]`` factors in target order and
+    meta carries the ``adapter`` block (id, rank, alpha, target names) —
+    everything `AdapterStore.register()` needs to validate and place it.
+    No stablehlo member: adapters are data against a shared base."""
+    cfg = handle.config
+    params, names = [], []
+    for pth, _, a, b in handle.entries:
+        params.append(np.asarray(a._value))
+        params.append(np.asarray(b._value))
+        names.append(pth)
+    if all(not np.any(params[i]) for i in range(1, len(params), 2)):
+        # every B is exactly zero — the fresh-attach state. After a
+        # CompiledTrainStep run the trained factors live in the step's
+        # donated device buffers until synced back to the Parameters.
+        raise ValueError(
+            "export_adapter: every B factor is zero (the attach-time "
+            "init), so this adapter is a no-op. If you trained through "
+            "CompiledTrainStep, call step.sync_params_to_model() before "
+            "exporting.")
+    from paddle_tpu.inference.artifact import write_artifact
+
+    write_artifact(path, {
+        "params": params,
+        "class_name": type(handle.model).__name__,
+        "adapter": {
+            "id": str(adapter_id),
+            "rank": int(cfg.rank),
+            "alpha": float(cfg.alpha),
+            "targets": list(cfg.targets),
+            "names": names,
+        },
+    })
+
+
+def load_adapter(path: str) -> dict:
+    """Read an adapter artifact back: ``{"adapter": meta,
+    "weights": {target_path: (A, B)}}``. Rejects containers without the
+    ``adapter`` meta block (a full-model artifact is not an adapter)."""
+    from paddle_tpu.inference.artifact import read_artifact
+
+    blob = read_artifact(path)
+    meta = blob.get("adapter")
+    if not meta:
+        raise ValueError(f"{path!r} is not a LoRA adapter artifact "
+                         f"(no 'adapter' meta block)")
+    names = list(meta.get("names", ()))
+    params = blob.get("params", [])
+    if len(params) != 2 * len(names):
+        raise ValueError(
+            f"{path!r}: adapter artifact has {len(params)} factor arrays "
+            f"for {len(names)} targets (expected exactly A+B per target)")
+    weights = {n: (params[2 * i], params[2 * i + 1])
+               for i, n in enumerate(names)}
+    return {"adapter": meta, "weights": weights}

@@ -18,6 +18,7 @@ import numpy as np
 from paddle_tpu.core.dtype import to_jax_dtype
 from paddle_tpu.core.flags import flag
 from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.lora import seam as _lora_seam
 from paddle_tpu.ops.random_state import default_generator
 
 __all__ = [
@@ -214,20 +215,69 @@ def maxout(x, groups, axis=1):
 # linear / embedding
 # ---------------------------------------------------------------------------
 
+# linear's collaborators, bound once on first call instead of re-imported
+# per projection per decode step (this seam is the per-token hot path)
+_fp8 = None
+_prec = None
+
+
+def _bind_linear_deps():
+    global _fp8, _prec
+    from paddle_tpu.amp import fp8 as fp8_mod
+    from paddle_tpu.ops.linalg import _prec as prec_fn
+
+    _fp8 = fp8_mod
+    _prec = prec_fn
+
+
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b; W is [in, out] (paddle convention, nn/functional/common.py).
 
     Under an active fp8 session (`CompiledTrainStep(fp8_policy=...)`, the
     pipelined runtimes, or `amp.fp8_autocast`) the matmul runs through
     float8_e4m3 with e5m2 gradients — the hot-path seam the fp8 policy
-    hooks (paddle_tpu.amp.fp8)."""
-    from paddle_tpu.ops.linalg import _prec
+    hooks (paddle_tpu.amp.fp8).
 
+    This is also the LoRA dispatch seam (paddle_tpu.lora.seam): when this
+    weight has attached train-mode A/B factors, or a serving AdapterStore
+    binding is active inside the traced program, the rank-r delta is added
+    here — every projection layer routes through this one function, so no
+    model rewrite is needed to adapt it."""
+    if _fp8 is None:
+        _bind_linear_deps()
     xt, wt = _t(x), _t(weight)
-    from paddle_tpu.amp import fp8 as _fp8
-
     if _fp8.linear_fp8_enabled(xt._value, wt._value):
         return _fp8.fp8_linear(xt, wt, None if bias is None else _t(bias))
+    if _lora_seam.active():
+        sb = _lora_seam.serve_binding()
+        if sb is not None:
+            pool = sb.pools.get(id(weight))
+            if pool is not None:
+                a_pool, b_pool = pool
+
+                def f_serve(v, w, *rest):
+                    y = jnp.matmul(v, w, precision=_prec())
+                    d = _lora_seam.serve_delta(v, a_pool, b_pool, sb)
+                    y = y + d.astype(y.dtype)
+                    return y + rest[0] if rest else y
+
+                args = (xt, wt) if bias is None else (xt, wt, _t(bias))
+                return apply_op(f_serve, *args, name="linear")
+        entry = _lora_seam.train_lookup(id(weight))
+        if entry is not None:
+            s = entry.scale
+
+            def f_train(v, w, a, b2, *rest):
+                y = jnp.matmul(v, w, precision=_prec())
+                d = jnp.matmul(jnp.matmul(v, a, precision=_prec()), b2,
+                               precision=_prec())
+                y = y + (s * d).astype(y.dtype)
+                return y + rest[0] if rest else y
+
+            args = (xt, wt, _t(entry.A), _t(entry.B))
+            if bias is not None:
+                args = args + (_t(bias),)
+            return apply_op(f_train, *args, name="linear")
     if bias is None:
         return apply_op(lambda v, w: jnp.matmul(v, w, precision=_prec()), xt, wt, name="linear")
     return apply_op(

@@ -256,7 +256,21 @@ def grouped_matmul(x, w, gids, *, block_rows: int | None = None,
         raise ValueError(f"gids shape {gids.shape} != ({m},)")
     bm = block_rows or pick_block_rows(m, num_groups)
     if m % bm:
-        raise ValueError(f"rows {m} not a multiple of block_rows {bm}")
+        # Surface the bad launch config here with its provenance — without
+        # this check it dies inside Pallas grid setup with an opaque shape
+        # error (the flash-attention block-validation idiom from PR-5).
+        from paddle_tpu.core.flags import flag
+
+        if block_rows is not None:
+            src = f"block_rows={block_rows} (caller-supplied)"
+        elif int(flag("moe_block_rows")) > 0:
+            src = f"block_rows={bm} (FLAGS_moe_block_rows override)"
+        else:
+            src = f"block_rows={bm} (auto-picked)"
+        raise ValueError(
+            f"grouped_matmul: rows {m} not a multiple of {src}; pad the "
+            f"row count to a multiple of the block, or set "
+            f"FLAGS_moe_block_rows to a divisor of {m}")
     backend = _resolve_backend(backend)
     interpret = _interpret_mode() if backend == "pallas" else False
     return _gmm(x, w, gids.astype(jnp.int32), num_groups, bm, backend,

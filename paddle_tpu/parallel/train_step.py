@@ -710,6 +710,12 @@ class CompiledTrainStep:
         n_outer = len(self._outer_params)
         for p, pv in zip(self._outer_params, self._param_vals[:n_outer]):
             p._set_value(pv)
+            if p.stop_gradient:
+                # frozen params (e.g. a LoRA-frozen base) never see the
+                # update loop — keep no moments for them, so adapter
+                # training's optimizer state is sized to the adapter
+                yield {}
+                continue
             yield dict(existing.get(id(p)) or optimizer._init_state(p))
         for col, sv in zip(self._group_cols, self._param_vals[n_outer:]):
             sts = [existing.get(id(p)) for p in col]
@@ -1295,6 +1301,8 @@ class CompiledTrainStep:
         opt = _innermost_opt(self.optimizer)
         n_outer = len(self._outer_params)
         for p, st in zip(self._outer_params, self._opt_states[:n_outer]):
+            if not st:       # frozen param: no moments were ever allocated
+                continue
             opt._state[id(p)] = dict(st)
         for col, st in zip(self._group_cols, self._opt_states[n_outer:]):
             for l, p in enumerate(col):

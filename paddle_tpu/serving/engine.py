@@ -62,6 +62,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.lora.store import AdapterLoadError  # registers swap_fail chaos
 from paddle_tpu.observability import events as obs_events
 from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.observability import tracing as obs_tracing
@@ -189,6 +190,15 @@ def _register_engine_metrics(engine: "ServingEngine"):
                   labels=("engine", "dtype")).labels(
             engine=eng._metrics_id,
             dtype=st.get("kv_cache_dtype", "unknown")).set(1.0)
+        # multi-tenant LoRA billing: committed tokens per tenant (the
+        # AdapterStore registers its own residency/swap collectors)
+        tok = reg.counter("lora_tokens_total",
+                          "committed tokens per tenant (tenant field, "
+                          "adapter id fallback)",
+                          labels=("engine", "tenant"))
+        for tenant, n in st.get("tenant_tokens", {}).items():
+            tok.labels(engine=eng._metrics_id,
+                       tenant=tenant)._set_total(float(n))
 
     obs_metrics.registry().add_collector(collect, owner=engine)
 
@@ -214,9 +224,17 @@ class ServingEngine:
     """Continuous-batching generation over a decode-capable model (the
     `decode_forward` protocol LlamaForCausalLM implements)."""
 
-    def __init__(self, model, config: ServingConfig | None = None):
+    def __init__(self, model, config: ServingConfig | None = None,
+                 adapter_store=None):
         self.model = model
         self.config = config or ServingConfig()
+        # multi-tenant LoRA: per-row adapter slot ids + the store's pools
+        # ride EVERY decode/verify/prefill signature (None placeholders
+        # when storeless — None is a static pytree, so both modes share
+        # one program shape and neither ever retraces)
+        self.adapters = adapter_store
+        if adapter_store is not None:
+            adapter_store.validate_model(model)
         mcfg = model.config
         self.num_layers = int(mcfg.num_hidden_layers)
         self.num_kv_heads = int(mcfg.num_key_value_heads)
@@ -349,6 +367,9 @@ class ServingEngine:
         self._committed_tokens = 0
         self._decode_steps = 0
         self._slot_steps = 0        # sum over steps of active slots
+        # per-tenant committed-token billing (tenant field, adapter id
+        # fallback) — the lora_tokens_total{tenant=} counter source
+        self._tenant_tokens: dict[str, int] = {}
         self._draft_ms = 0.0
         self._prefix_admit_tokens = 0
         self._prefix_matched_tokens = 0
@@ -381,19 +402,59 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------
+    def _adapter_bind(self, aslots, apools, bpools):
+        """The in-program LoRA binding: inside a traced step, expose the
+        traced pool/slot arguments to F.linear via the seam. Storeless
+        engines (aslots is None — a STATIC empty pytree) get a no-op, so
+        one program body serves both modes without retracing."""
+        if self.adapters is not None and aslots is not None:
+            return self.adapters.bind(apools, bpools, aslots)
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def _adapter_args(self, aslots):
+        """Host-side halves of the adapter signature: the packed per-row
+        slot array + the store's current pools (None placeholders when
+        storeless, so call sites stay uniform)."""
+        if self.adapters is None:
+            return None, None, None
+        apools, bpools = self.adapters.pools()
+        return jnp.asarray(aslots), apools, bpools
+
+    def _bill_tenant(self, req):
+        key = req.tenant or req.adapter
+        if key:
+            self._tenant_tokens[key] = self._tenant_tokens.get(key, 0) + 1
+
+    def _pack_adapter_rows(self, active, b):
+        """Per-row adapter slot ids for one packed dispatch — adapter ids
+        ride the signature like sampling knobs. Rows without an adapter
+        (and empty slots) carry the store's trash id: the grouped matmul
+        contributes an exact zero delta for them."""
+        if self.adapters is None:
+            return None
+        rows = np.full(b, self.adapters.num_slots, np.int32)
+        for i, req in enumerate(active):
+            if req.adapter:
+                rows[i] = self.adapters.slot_of(req.adapter)
+        return rows
+
     def _decode(self):
         if self._decode_fn is None:
             from paddle_tpu.parallel.train_step import functional_call
 
             def fn(params, cache, ids, lens, page_table, keys, temp,
-                   top_k, top_p):
+                   top_k, top_p, aslots, apools, bpools):
                 self._decode_traces += 1
                 positions = jnp.maximum(lens - 1, 0).astype(jnp.int32)
-                logits3, cache = functional_call(
-                    self.model, params, (ids[:, None],),
-                    dict(cache=cache, page_table=page_table,
-                         context_lens=lens, position_ids=positions[:, None]),
-                    training=False, method="decode_forward")
+                with self._adapter_bind(aslots, apools, bpools):
+                    logits3, cache = functional_call(
+                        self.model, params, (ids[:, None],),
+                        dict(cache=cache, page_table=page_table,
+                             context_lens=lens,
+                             position_ids=positions[:, None]),
+                        training=False, method="decode_forward")
                 logits = logits3._value[:, 0]
                 tokens, new_keys = sample_tokens(logits, keys, temp,
                                                  top_k, top_p)
@@ -413,7 +474,8 @@ class ServingEngine:
 
             cap = self._ctx_cap()
 
-            def fn(params, cache, ids, start, total, page_row):
+            def fn(params, cache, ids, start, total, page_row, aslots,
+                   apools, bpools):
                 self._prefill_traces += 1
                 # pad tokens of the final chunk clamp to the last valid
                 # position: they write the one not-yet-valid slot cap-1
@@ -421,13 +483,14 @@ class ServingEngine:
                 # of wrapping into live slots
                 positions = jnp.minimum(
                     start + jnp.arange(chunk_pad, dtype=jnp.int32), cap - 1)
-                _, cache = functional_call(
-                    self.model, params, (ids[None],),
-                    dict(cache=cache,
-                         page_table=page_row[None],
-                         context_lens=total.reshape(1),
-                         position_ids=positions[None], ctx_pad=ctx_pad),
-                    training=False, method="decode_forward")
+                with self._adapter_bind(aslots, apools, bpools):
+                    _, cache = functional_call(
+                        self.model, params, (ids[None],),
+                        dict(cache=cache,
+                             page_table=page_row[None],
+                             context_lens=total.reshape(1),
+                             position_ids=positions[None], ctx_pad=ctx_pad),
+                        training=False, method="decode_forward")
                 return cache
 
             self._prefill_fns[key] = jax.jit(
@@ -445,7 +508,7 @@ class ServingEngine:
             cap = self._ctx_cap()
 
             def fn(params, cache, ids, lens, page_table, keys, temp,
-                   top_k, top_p, drafts, n_spec):
+                   top_k, top_p, drafts, n_spec, aslots, apools, bpools):
                 self._decode_traces += 1
                 base = jnp.maximum(lens - 1, 0).astype(jnp.int32)   # [B]
                 offs = jnp.arange(t_frame, dtype=jnp.int32)[None]   # [1,T]
@@ -457,12 +520,13 @@ class ServingEngine:
                               & (positions < cap)
                               & (lens > 0)[:, None])
                 positions = jnp.minimum(positions, cap - 1)
-                logits3, cache = functional_call(
-                    self.model, params, (ids,),
-                    dict(cache=cache, page_table=page_table,
-                         context_lens=lens, position_ids=positions,
-                         write_mask=write_mask, verify=True),
-                    training=False, method="decode_forward")
+                with self._adapter_bind(aslots, apools, bpools):
+                    logits3, cache = functional_call(
+                        self.model, params, (ids,),
+                        dict(cache=cache, page_table=page_table,
+                             context_lens=lens, position_ids=positions,
+                             write_mask=write_mask, verify=True),
+                        training=False, method="decode_forward")
                 logits = logits3._value                           # [B,T,V]
                 # the EXACT plain-decode sampling chain, unrolled over the
                 # frame: position i draws with the key plain decode would
@@ -561,14 +625,31 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 16, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0, eos_id: int | None = None,
-               stream_cb=None) -> int:
+               stream_cb=None, adapter: str | None = None,
+               tenant: str = "") -> int:
+        if adapter and self.adapters is None:
+            raise AdapterLoadError(
+                adapter, "engine was constructed without an AdapterStore")
+        if adapter:
+            # pin BEFORE the scheduler sees the request: the slot must be
+            # resident for every dispatch this request rides, and a failed
+            # load must cost one typed error, never a queued-then-wedged
+            # request (unpinned on the QueueFull race below and in
+            # release())
+            self.adapters.acquire(adapter)
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k, top_p=top_p,
-                      eos_id=eos_id, stream_cb=stream_cb)
+                      eos_id=eos_id, stream_cb=stream_cb,
+                      adapter=adapter, tenant=tenant)
         # pool sufficiency is a CONSTRUCTOR invariant (>= pages_per_seq
         # usable pages), so any request within serving_max_seq_len fits
         # alone; the scheduler enforces the length limit
-        rid = self.scheduler.submit(req)
+        try:
+            rid = self.scheduler.submit(req)
+        except Exception:
+            if adapter:
+                self.adapters.release(adapter)
+            raise
         self._keys[rid] = self._new_key()
         if self.spec_k > 0:
             self._proposer.add_request(rid, req.prompt)
@@ -610,6 +691,12 @@ class ServingEngine:
         off = int(req.matched_tokens)
         self._prefix_admit_tokens += total
         self._prefix_matched_tokens += off
+        aslots, apools, bpools = (None, None, None)
+        if self.adapters is not None:
+            slot = (self.adapters.slot_of(req.adapter)
+                    if req.adapter else self.adapters.num_slots)
+            aslots, apools, bpools = self._adapter_args(
+                np.full(1, slot, np.int32))
         while off < total:
             t = min(self.prefill_chunk, total - off)
             cpad = _bucket(t, self._chunk_buckets)
@@ -621,7 +708,8 @@ class ServingEngine:
             self._cache = fn(
                 self._params, self._cache, jnp.asarray(ids),
                 jnp.asarray(off, jnp.int32),
-                jnp.asarray(off + t, jnp.int32), row)
+                jnp.asarray(off + t, jnp.int32), row,
+                aslots, apools, bpools)
             off += t
 
     def _decode_once(self, active, finisher):
@@ -638,6 +726,7 @@ class ServingEngine:
         temp = np.zeros(b, np.float32)
         top_k = np.zeros(b, np.int32)
         top_p = np.ones(b, np.float32)
+        arows = self._pack_adapter_rows(active, b)
         for i, req in enumerate(active):
             # NOT req.context[-1]: that concatenates prompt+generated every
             # step (O(len) per token -> O(len^2) per stream)
@@ -649,10 +738,13 @@ class ServingEngine:
             temp[i] = req.temperature
             top_k[i] = req.top_k
             top_p[i] = req.top_p
+        aslots, apools, bpools = self._adapter_args(arows) \
+            if arows is not None else (None, None, None)
         tokens, new_keys, self._cache = self._decode()(
             self._params, self._cache, jnp.asarray(ids),
             jnp.asarray(lens), jnp.asarray(pt), jnp.asarray(keys),
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            aslots, apools, bpools)
         toks = np.asarray(tokens)
         nkeys = np.asarray(new_keys)
         now = time.perf_counter()
@@ -661,6 +753,7 @@ class ServingEngine:
             req.generated.append(tok)
             req.token_times.append(now)
             self._keys[req.rid] = nkeys[i]
+            self._bill_tenant(req)
             if req.stream_cb is not None:
                 req.stream_cb(req, tok)
             if ((req.eos_id is not None and tok == req.eos_id)
@@ -713,11 +806,15 @@ class ServingEngine:
             ids[i, 1:1 + n] = prop
             n_spec[i] = n
         self._draft_ms += (time.perf_counter() - t_draft) * 1e3
+        arows = self._pack_adapter_rows(active, b)
+        aslots, apools, bpools = self._adapter_args(arows) \
+            if arows is not None else (None, None, None)
         tokens, accepted, new_keys, self._cache = self._verify(k)(
             self._params, self._cache, jnp.asarray(ids),
             jnp.asarray(lens), jnp.asarray(pt), jnp.asarray(keys),
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-            jnp.asarray(drafts), jnp.asarray(n_spec))
+            jnp.asarray(drafts), jnp.asarray(n_spec),
+            aslots, apools, bpools)
         toks = np.asarray(tokens)
         acc = np.asarray(accepted)
         nkeys = np.asarray(new_keys)
@@ -733,6 +830,7 @@ class ServingEngine:
                 req.generated.append(tok)
                 req.token_times.append(now)
                 self._committed_tokens += 1
+                self._bill_tenant(req)
                 if self.spec_k > 0:
                     self._proposer.observe(req.rid, tok)
                 if req.stream_cb is not None:
@@ -867,8 +965,14 @@ class ServingEngine:
 
     def release(self, rid: int):
         """Drop a finished request's bookkeeping (scheduler entry, RNG
-        key, draft tables) — the per-request memory a long-lived server
-        must not retain."""
+        key, draft tables, adapter slot pin) — the per-request memory a
+        long-lived server must not retain."""
+        req = self.scheduler._by_rid.get(rid)
+        if (req is not None and req.finished and req.adapter
+                and self.adapters is not None):
+            # unpin exactly once: scheduler.release drops the _by_rid
+            # entry for finished requests, so a second release is a no-op
+            self.adapters.release(req.adapter)
         self.scheduler.release(rid)
         self._keys.pop(rid, None)
         self._proposer.drop(rid)
@@ -932,6 +1036,7 @@ class ServingEngine:
         import queue as queue_mod
 
         q = queue_mod.Queue()
+        adapter_err = None
         with self._http_lock:
             try:
                 rid = self.submit(
@@ -941,12 +1046,20 @@ class ServingEngine:
                     top_k=int(payload.get("top_k", 0)),
                     top_p=float(payload.get("top_p", 1.0)),
                     eos_id=payload.get("eos_id"),
-                    stream_cb=lambda req, tok: q.put(tok))
+                    stream_cb=lambda req, tok: q.put(tok),
+                    adapter=payload.get("adapter"),
+                    tenant=str(payload.get("tenant") or ""))
             except QueueFull:
                 # admission raced past the pre-headers check: headers are
                 # already out, so the refusal becomes the ONE terminal
                 # stream event (with the same Retry-After semantics)
                 rid = None
+            except AdapterLoadError as e:
+                # a failed adapter load degrades to ONE typed terminal
+                # event for THIS request — the engine, the batch and every
+                # other tenant's stream are untouched
+                rid = None
+                adapter_err = e
             else:
                 req = self.scheduler.get(rid)
                 # the trace id rides the request object like sampling
@@ -955,8 +1068,13 @@ class ServingEngine:
         if rid is None:
             from paddle_tpu.core.flags import flag
 
-            yield {"error": "queue_full",
-                   "retry_after": float(flag("router_retry_after_s"))}
+            if adapter_err is not None:
+                yield {"error": "adapter_load_failed",
+                       "adapter": adapter_err.adapter_id,
+                       "message": str(adapter_err)}
+            else:
+                yield {"error": "queue_full",
+                       "retry_after": float(flag("router_retry_after_s"))}
             return
         n = 0
         try:
@@ -1144,6 +1262,11 @@ class ServingEngine:
             "kv_promotions": self.allocator.promotions,
             "kv_cold_hits": self.allocator.cold_hits,
             "kv_promote_failures": self.allocator.promote_failures,
+            # multi-tenant LoRA: adapter residency + per-tenant billing
+            # (empty placeholders storeless, so /stats keys are stable)
+            "lora": (self.adapters.residency()
+                     if self.adapters is not None else {}),
+            "tenant_tokens": dict(self._tenant_tokens),
         }
 
     @property

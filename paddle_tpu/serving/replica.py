@@ -218,6 +218,13 @@ class InProcessReplica:
                 trace_id=(str(payload.get("trace")) if payload.get("trace")
                           else None),
                 replica=self.replica_id):
+            # adapter/tenant ride only when the payload carries them, so
+            # engines predating the multi-tenant signature still serve
+            extra = {}
+            if payload.get("adapter"):
+                extra["adapter"] = str(payload["adapter"])
+            if payload.get("tenant"):
+                extra["tenant"] = str(payload["tenant"])
             with self._lock:
                 rid = self.engine.submit(
                     np.asarray(payload["prompt_ids"], np.int32),
@@ -226,7 +233,8 @@ class InProcessReplica:
                     top_k=int(payload.get("top_k", 0)),
                     top_p=float(payload.get("top_p", 1.0)),
                     eos_id=payload.get("eos_id"),
-                    stream_cb=lambda req, tok: q.put(tok))
+                    stream_cb=lambda req, tok: q.put(tok),
+                    **extra)
                 req = self.engine.scheduler.get(rid)
                 # the trace id rides the Request like the sampling knobs:
                 # engine spans (prefill -> scheduler.admit -> decode step)

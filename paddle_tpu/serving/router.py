@@ -47,8 +47,9 @@ Stream event contract (what `stream()` yields — also the ndjson lines of
 the HTTP front-end): ``{"token": t}`` per token, then exactly one
 terminal event — ``{"done": true, "tokens", "replica", "failovers"[,
 "shed"]}`` or ``{"error": kind, "message", "tokens", "failovers"[,
-"retry_after"]}`` with kind one of ``refused | queue_full |
-no_healthy_replica | timeout | failover_exhausted``.
+"retry_after"]}`` with kind one of ``refused | tenant_limit |
+queue_full | no_healthy_replica | timeout | failover_exhausted |
+adapter_load_failed``.
 """
 from __future__ import annotations
 
@@ -58,6 +59,7 @@ import time
 from dataclasses import dataclass, field
 
 from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.lora.store import AdapterLoadError
 from paddle_tpu.observability import events as obs_events
 from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.observability import tracing as obs_tracing
@@ -112,6 +114,8 @@ class RouterConfig:
     retry_after_s: float = 0.0        # 0 -> FLAGS_router_retry_after_s
     placement: str = ""               # "" -> FLAGS_router_placement
     prefix_tokens: int = 0            # 0 -> FLAGS_router_prefix_tokens
+    tenant_max_inflight: int = -1     # <0 -> FLAGS_router_tenant_max_inflight
+                                      #   (0 = no per-tenant cap)
 
     def resolved(self) -> "RouterConfig":
         from paddle_tpu.core.flags import flag
@@ -120,9 +124,9 @@ class RouterConfig:
             return cast(v) if v > 0 else cast(flag(name))
 
         placement = (self.placement or str(flag("router_placement"))).lower()
-        if placement not in ("session", "prefix"):
-            raise ValueError(f"router_placement must be 'session' or "
-                             f"'prefix', got {placement!r}")
+        if placement not in ("session", "prefix", "adapter"):
+            raise ValueError(f"router_placement must be 'session', "
+                             f"'prefix' or 'adapter', got {placement!r}")
 
         return RouterConfig(
             probe_interval_s=pick(self.probe_interval_s,
@@ -150,11 +154,15 @@ class RouterConfig:
                                "router_retry_after_s", float),
             placement=placement,
             prefix_tokens=pick(self.prefix_tokens,
-                               "router_prefix_tokens", int))
+                               "router_prefix_tokens", int),
+            tenant_max_inflight=(int(self.tenant_max_inflight)
+                                 if self.tenant_max_inflight >= 0
+                                 else int(flag(
+                                     "router_tenant_max_inflight"))))
 
 
 _ROUTER_COUNTERS = ("accepted", "completed", "failed", "refused",
-                    "failovers", "sheds", "drained")
+                    "failovers", "sheds", "drained", "tenant_refused")
 _CIRCUIT_CODE = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
 
 
@@ -225,6 +233,7 @@ class _Dispatch:
     abort: threading.Event
     abort_why: str = ""
     replica_id: int | None = None
+    tenant: str = ""                   # fairness-cap accounting key
 
 
 class _Drained(Exception):
@@ -265,6 +274,10 @@ class Router:
         self.failovers = 0
         self.sheds = 0
         self.drained = 0
+        self.tenant_refused = 0
+        # per-tenant in-flight counts (the fairness-cap ledger; tenant
+        # field, adapter id fallback — entries die with their streams)
+        self._tenant_inflight: dict[str, int] = {}
         self.monitor_errors: list[str] = []
         self._stop = threading.Event()
         _register_router_metrics(self)
@@ -416,8 +429,16 @@ class Router:
           that prefix's pages (per-replica radix hits become a fleet-wide
           property). Session id remains the tiebreak for promptless
           payloads; a request with neither goes least-loaded (None).
+        * ``adapter`` — the request's LoRA adapter id, so one tenant's
+          requests land where their adapter is already resident in the
+          AdapterStore slot pool (swap-ins become a once-per-replica
+          cost, not a per-request one). Session fallback for adapterless
+          requests.
         """
         session = payload.get("session")
+        if self.cfg.placement == "adapter":
+            adapter = payload.get("adapter")
+            return f"adapter:{adapter}" if adapter else session
         if self.cfg.placement != "prefix":
             return session
         ids = payload.get("prompt_ids")
@@ -490,6 +511,7 @@ class Router:
         in the module docstring). Always yields EXACTLY ONE terminal
         event — the zero-lost-requests guarantee lives here."""
         cfg = self.cfg
+        tenant = str(payload.get("tenant") or payload.get("adapter") or "")
         with self._lock:
             # build the refusal under the lock, yield OUTSIDE it: a
             # generator suspends at yield, and suspending while holding
@@ -501,12 +523,30 @@ class Router:
                             "retry_after": cfg.retry_after_s,
                             "message": f"router at max in-flight "
                                        f"({cfg.max_inflight})"}
+            elif (tenant and cfg.tenant_max_inflight > 0
+                  and self._tenant_inflight.get(tenant, 0)
+                  >= cfg.tenant_max_inflight):
+                # the per-tenant fairness cap: a flooding tenant is refused
+                # with its OWN typed error while everyone else's admission
+                # headroom stays intact
+                self.tenant_refused += 1
+                self.refused += 1
+                rejected = {"error": "tenant_limit", "tokens": 0,
+                            "failovers": 0,
+                            "retry_after": cfg.retry_after_s,
+                            "tenant": tenant,
+                            "message": f"tenant {tenant!r} at max "
+                                       f"in-flight "
+                                       f"({cfg.tenant_max_inflight})"}
             else:
                 rejected = None
                 self._seq += 1
                 ctx = _Dispatch(seq=self._seq, arrival_t=time.monotonic(),
-                                abort=threading.Event())
+                                abort=threading.Event(), tenant=tenant)
                 self._inflight[ctx.seq] = ctx
+                if tenant:
+                    self._tenant_inflight[tenant] = \
+                        self._tenant_inflight.get(tenant, 0) + 1
                 self.accepted += 1
         if rejected is not None:
             yield rejected
@@ -623,10 +663,34 @@ class Router:
                                 done["shed"] = True
                             yield done
                             return
+                        elif ev.get("error") == "adapter_load_failed":
+                            # typed per-request adapter failure from the
+                            # engine: the replica is healthy and no peer
+                            # can do better (registration is store-wide)
+                            # — ONE terminal event, no strike, no failover
+                            with self._lock:
+                                self.failed += 1
+                            yield {"error": "adapter_load_failed",
+                                   "tokens": emitted,
+                                   "failovers": attempts - 1,
+                                   "adapter": str(ev.get("adapter", "")),
+                                   "message": str(ev.get("message", ""))}
+                            return
                         elif "error" in ev:
                             raise ReplicaError(
                                 f"replica {slot.rid} stream error: "
                                 f"{ev['error']}")
+                except AdapterLoadError as e:
+                    # the in-process submit path raises directly (the HTTP
+                    # path arrives as the stream event above): same typed
+                    # terminal degradation, same no-strike contract
+                    with self._lock:
+                        self.failed += 1
+                    yield {"error": "adapter_load_failed",
+                           "tokens": emitted,
+                           "failovers": attempts - 1,
+                           "adapter": e.adapter_id, "message": str(e)}
+                    return
                 except QueueFull as e:
                     # bounded-queue pushback: admission backpressure from a
                     # busy peer, NOT ill health — no breaker strike
@@ -672,6 +736,12 @@ class Router:
         finally:
             with self._lock:
                 self._inflight.pop(ctx.seq, None)
+                if ctx.tenant:
+                    n = self._tenant_inflight.get(ctx.tenant, 0) - 1
+                    if n > 0:
+                        self._tenant_inflight[ctx.tenant] = n
+                    else:
+                        self._tenant_inflight.pop(ctx.tenant, None)
 
     def generate(self, payload: dict, deadline: float | None = None):
         """Synchronous convenience: drain one stream, return (tokens,
@@ -705,6 +775,9 @@ class Router:
                 "failed": self.failed, "refused": self.refused,
                 "failovers": self.failovers, "sheds": self.sheds,
                 "drained": self.drained,
+                "tenant_refused": self.tenant_refused,
+                "tenant_max_inflight": self.cfg.tenant_max_inflight,
+                "tenants": dict(self._tenant_inflight),
                 "monitor_errors": len(self.monitor_errors),
                 "replicas": {
                     str(s.rid): {

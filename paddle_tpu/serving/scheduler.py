@@ -62,6 +62,11 @@ class Request:
     top_p: float = 1.0                      # >= 1 -> off
     eos_id: int | None = None
     stream_cb: object = None                # callable(request, token) or None
+    # multi-tenant LoRA: the adapter this request decodes through (None =
+    # base model) and the tenant it bills/fair-shares under (adapter id
+    # fallback when empty) — these ride the request like sampling knobs
+    adapter: str | None = None
+    tenant: str = ""
     rid: int = field(default_factory=lambda: next(_rid_counter))
     state: RequestState = RequestState.WAITING
     generated: list = field(default_factory=list)

@@ -53,8 +53,9 @@ CHAOS_POINTS = [
 # injecting them into a Model.fit run would test nothing
 SERVING_CHAOS_POINTS = [
     "serving.dispatch.drop", "serving.kv.promote_fail",
-    "serving.replica.kill", "serving.replica.slow",
-    "serving.spec.verify_mismatch", "serving.stream.cut",
+    "serving.lora.swap_fail", "serving.replica.kill",
+    "serving.replica.slow", "serving.spec.verify_mismatch",
+    "serving.stream.cut",
 ]
 
 

@@ -182,6 +182,53 @@ def _cache_gates(cur: dict):
             "not minimal on membership change")
 
 
+def _lora_gates(cur: dict):
+    """Multi-tenant LoRA self-consistency gates (docs/lora.md): serving
+    16 concurrent adapters through one engine must hold >= 0.8x the
+    single-adapter tokens/sec on the SAME traffic (the grouped-matmul
+    gather is the only difference), p99 must stay within 2x, no arm may
+    retrace after warmup, and the swap_fail chaos run must degrade to
+    exactly one typed error with every surviving stream completing."""
+    lora = (cur["detail"] or {}).get("lora") or {}
+    if not lora:
+        # fail CLOSED: the arm goes missing exactly when the LoRA probe
+        # crashed, which is when these gates matter most
+        raise SystemExit(
+            "LORA REGRESSION: the LORA_JSON arm is missing from the bench "
+            "report (probe failed?) — the multi-tenant gates cannot run")
+    arms = lora["arms"]
+    print(f"lora: multi16 {arms['multi16']['tokens_per_sec']} vs single "
+          f"{arms['single']['tokens_per_sec']} tok/s "
+          f"({lora['multi_vs_single_ratio']}x), hot-swap "
+          f"{lora['hot_swap']['mean_ms']} ms, artifact "
+          f"{lora['adapter_artifact_bytes']} bytes")
+    if not lora.get("multi_tenant_ok", False):
+        raise SystemExit(
+            f"LORA REGRESSION: 16-adapter heterogeneous batching at "
+            f"{lora['multi_vs_single_ratio']}x single-tenant tokens/sec "
+            f"(gate: >= 0.8x)")
+    if not lora.get("p99_ok", False):
+        raise SystemExit(
+            "LORA REGRESSION: multi-tenant p99 above 2x the "
+            "single-tenant p99 on identical traffic")
+    if not lora.get("zero_retrace_ok", False):
+        raise SystemExit(
+            "LORA REGRESSION: decode recompiled after warmup across "
+            "adapter mixes (slot ids/pools must be shape-stable)")
+    if not (lora.get("chaos") or {}).get("degraded_not_wedged", False):
+        raise SystemExit(
+            "LORA REGRESSION: swap_fail chaos did not degrade to one "
+            "typed error with all surviving streams completing")
+    rc = lora.get("router_chaos") or {}
+    if not rc.get("ok", False):
+        raise SystemExit(
+            f"LORA REGRESSION: router chaos with adapters on lost "
+            f"{rc.get('lost')} of {rc.get('requests')} streams (failovers="
+            f"{rc.get('failovers')}, survivor_zero_retrace="
+            f"{rc.get('survivor_zero_retrace')}) — a replica kill must "
+            f"fail over adapter traffic with nothing lost")
+
+
 def main():
     cur = run_bench()
     platform = cur["detail"]["platform"]
@@ -195,6 +242,7 @@ def main():
     # they hold on any platform, baseline recorded or not
     _moe_gates(cur)
     _cache_gates(cur)
+    _lora_gates(cur)
 
     if not os.path.exists(BASELINE):
         raise SystemExit(f"no {BASELINE}; record one with --update")
