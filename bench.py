@@ -2102,6 +2102,245 @@ def _router_probe():
     return None
 
 
+DISAGG_PROBE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import json, threading, time
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ServingConfig, ServingEngine
+from paddle_tpu.serving.disagg import build_disagg
+
+# Disaggregated prefill/decode probe, two arms (docs/serving.md):
+# (1) packed prefill — the same 8 short prompts prefilled one chunked
+#     dispatch at a time (the PR-18 path) vs batched into [1, 128]
+#     segment-id frames. ABBA-paired rounds so CPU drift cancels;
+#     wall-clock speedup gated >= 1.5x with page bytes AND greedy
+#     streams bit-equal (valid token positions — chunk-pad slack is
+#     never read back and differs by construction).
+# (2) split vs mixed — the same bursty-Poisson mixed-length workload on
+#     a mixed-role engine (inline chunked prefill stalls decode between
+#     steps) and on a decode-role engine with 2 packed prefill workers
+#     behind the KV-page handoff, serving.prefill.kill fired once
+#     mid-run (one worker survives): decode p99 inter-token gap must
+#     beat mixed, goodput within 5%, every stream complete and
+#     bit-equal to the fault-free mixed reference (exactly-once under
+#     worker death), zero decode retraces on both arms.
+S = 128
+cfg = LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=S,
+                  use_parallel_cross_entropy=False)
+paddle.seed(0)
+model = LlamaForCausalLM(cfg)
+model.eval()
+PS, BATCH, FRAME = 8, 8, 128
+
+
+def make_engine(**over):
+    kw = dict(page_size=PS, num_pages=256, decode_batch=BATCH,
+              prefill_chunk=32, max_seq_len=S)
+    kw.update(over)
+    eng = ServingEngine(model, ServingConfig(**kw))
+    w = np.random.RandomState(1)
+    packed = eng.prefill_pack
+    # warm BOTH prefill paths: a packed engine still re-prefills through
+    # the chunked program on handoff reclaims, and retraces gate at zero
+    for flip in ([False, True] if packed else [False]):
+        eng.prefill_pack = flip
+        for lens in ((5, 11, 30), (40,), (100,),
+                     (9, 13, 17, 21, 6, 8, 12, 19)):
+            eng.generate([w.randint(1, cfg.vocab_size, n).astype(np.int32)
+                          for n in lens], max_new_tokens=4)
+    eng.prefill_pack = packed
+    eng.mark_warmup()
+    eng.reset_stats()
+    return eng
+
+
+seq = make_engine(prefill_pack=False)
+pack = make_engine(pack_frame=FRAME)
+
+# ---- arm 1: packed-prefill parity + speedup -------------------------------
+rng = np.random.RandomState(3)
+LENS = (24, 17, 31, 9, 28, 15, 21, 30)
+prompts8 = [rng.randint(1, cfg.vocab_size, n).astype(np.int32)
+            for n in LENS]
+
+
+def chain_tokens(eng, rid, n):
+    # per-request KV bytes for the first n token positions, gathered in
+    # chain order so parity is independent of page-id assignment
+    chain = eng.allocator.chain(rid)
+    out = {}
+    for name, arr in eng._cache.items():
+        a = np.asarray(arr)[:, :, chain]
+        out[name] = a.reshape(a.shape[0], a.shape[1], -1,
+                              a.shape[-1])[:, :, :n]
+    return out
+
+
+pages_equal, streams, ref_snap = True, {}, None
+for eng in (seq, pack):
+    rids = [eng.submit(p, max_new_tokens=4) for p in prompts8]
+    eng.step()
+    snap = [chain_tokens(eng, r, n) for r, n in zip(rids, LENS)]
+    eng.run_until_idle()
+    streams[id(eng)] = [list(eng.scheduler.get(r).generated)
+                        for r in rids]
+    for r in rids:
+        eng.release(r)
+    if ref_snap is None:
+        ref_snap = snap
+    else:
+        for a, b in zip(ref_snap, snap):
+            for name in a:
+                if not np.array_equal(a[name], b[name]):
+                    pages_equal = False
+streams_equal = streams[id(seq)] == streams[id(pack)]
+frames = pack.stats()["prefill_packed_frames"]
+
+
+def round_ms(eng):
+    t0 = time.perf_counter()
+    rids = [eng.submit(p, max_new_tokens=1) for p in prompts8]
+    eng.run_until_idle()
+    for r in rids:
+        eng.release(r)
+    return (time.perf_counter() - t0) * 1e3
+
+
+for eng in (seq, pack):                      # shape warm for this round
+    round_ms(eng)
+t = {id(seq): [], id(pack): []}
+for eng in (seq, pack, pack, seq) * 3:       # ABBA x3
+    t[id(eng)].append(round_ms(eng))
+seq_ms = float(np.median(t[id(seq)]))
+pack_ms = float(np.median(t[id(pack)]))
+
+# ---- arm 2: split vs mixed under bursty Poisson + worker kill -------------
+rng = np.random.RandomState(7)
+N_BURSTS, PER_BURST, N_NEW = 6, 4, 12
+burst_t = np.cumsum(rng.exponential(0.35, N_BURSTS))
+arrivals, lens2 = [], []
+for b in range(N_BURSTS):
+    for j in range(PER_BURST):
+        arrivals.append(float(burst_t[b]) + 0.004 * j)
+        # 3 short prompts + one long per burst: the long one's inline
+        # chunked prefill is what stalls the mixed arm's decode loop
+        lens2.append(96 if j == PER_BURST - 1 else int(rng.randint(6, 22)))
+prompts2 = [rng.randint(1, cfg.vocab_size, n).astype(np.int32)
+            for n in lens2]
+
+
+def run_arm(eng):
+    rec = [{"arrival": 0.0, "ts": [], "rid": -1} for _ in prompts2]
+    fed = threading.Event()
+
+    def feeder():
+        t0 = time.perf_counter()
+        for i, (at, p) in enumerate(zip(arrivals, prompts2)):
+            dt = t0 + at - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            r = rec[i]
+            r["arrival"] = time.perf_counter()
+            r["rid"] = eng.submit(
+                p, max_new_tokens=N_NEW,
+                stream_cb=(lambda rr: (lambda req, tok: rr["ts"].append(
+                    time.perf_counter())))(r))
+        fed.set()
+
+    th = threading.Thread(target=feeder, daemon=True)
+    t0 = time.perf_counter()
+    th.start()
+    while not fed.is_set() or eng.busy:
+        if eng.busy:
+            eng.step()
+        else:
+            time.sleep(0.001)
+    th.join()
+    wall = time.perf_counter() - t0
+    toks = [list(eng.scheduler.get(r["rid"]).generated) for r in rec]
+    for r in rec:
+        eng.release(r["rid"])
+    gaps, ttft = [], []
+    for r in rec:
+        ts = r["ts"]
+        if ts:
+            ttft.append((ts[0] - r["arrival"]) * 1e3)
+            gaps.extend(float(g) * 1e3 for g in np.diff(ts))
+    gaps.sort()
+    ttft.sort()
+    pct = lambda a, p: (round(a[min(int(len(a) * p / 100), len(a) - 1)], 3)
+                        if a else None)
+    return {"decode_gap_p50_ms": pct(gaps, 50),
+            "decode_gap_p99_ms": pct(gaps, 99),
+            "ttft_p99_ms": pct(ttft, 99),
+            "goodput_tok_s": round(sum(len(tk) for tk in toks) / wall, 2),
+            "lost": int(sum(len(tk) != N_NEW for tk in toks))}, toks
+
+
+mixed, mixed_toks = run_arm(seq)
+
+faults.reset()
+faults.arm("serving.prefill.kill", mode="nth", nth=2)
+channel, workers = build_disagg(pack, 2, mode="alias", timeout_s=1.0)
+try:
+    split, split_toks = run_arm(pack)
+    split["fired"] = faults.fired("serving.prefill.kill")
+    split["workers_alive"] = channel.stats()["workers_alive"]
+finally:
+    faults.reset()
+    for w in workers:
+        w.close()
+    pack._handoff_channel = None
+st = pack.stats()
+split["reclaims"] = st["handoff_reclaims"]
+split["handoffs"] = st["handoffs"]
+split["fill"] = round(float(st["prefill_batch_fill"]), 4)
+split["streams_equal"] = split_toks == mixed_toks
+
+out = {
+    "packed": {"seq_ms": round(seq_ms, 2), "pack_ms": round(pack_ms, 2),
+               "speedup": round(seq_ms / max(pack_ms, 1e-9), 3),
+               "streams_equal": bool(streams_equal),
+               "pages_equal": bool(pages_equal), "frames": int(frames)},
+    "mixed": mixed,
+    "split": split,
+    "retraces": {"mixed": int(seq.decode_retraces_after_warmup),
+                 "split": int(pack.decode_retraces_after_warmup)},
+}
+print("DISAGG_JSON " + json.dumps(out))
+"""
+
+
+def _disagg_probe():
+    """Disaggregated prefill/decode probe on CPU: packed multi-prompt
+    prefill speedup (bit-equal pages + streams) and split-vs-mixed decode
+    p99/goodput under bursty load with a prefill worker killed mid-run
+    (DISAGG_JSON)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    try:
+        res = subprocess.run([sys.executable, "-c", DISAGG_PROBE],
+                             capture_output=True, text=True, timeout=540,
+                             env=env)
+        for line in res.stdout.splitlines():
+            if line.startswith("DISAGG_JSON "):
+                return json.loads(line[len("DISAGG_JSON "):])
+        print(f"disagg probe produced no result; stderr tail:\n"
+              f"{res.stderr[-800:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"disagg probe failed: {e!r}", file=sys.stderr)
+    return None
+
+
 CACHE_PROBE = r"""
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -3295,6 +3534,7 @@ def main():
     serving = _serving_probe()
     resilience = _resilience_probe()
     router = _router_probe()
+    disagg = _disagg_probe()
     kv_cache = _cache_probe()
     lora = _lora_probe()
     observability = _observability_probe()
@@ -3388,6 +3628,25 @@ def main():
         reg.gauge("bench_lora_hot_swap_ms",
                   "mean resident-slot adapter hot-swap latency").set(
             lora["hot_swap"]["mean_ms"])
+    if disagg:
+        # disaggregated prefill/decode instrument (PR 19): the packed
+        # prefill amortization and the split-vs-mixed decode tail,
+        # gated by bench_regression
+        reg.gauge("bench_disagg_packed_speedup",
+                  "packed multi-prompt prefill speedup vs one-at-a-time "
+                  "chunked prefill, same prompts bit-equal").set(
+            disagg["packed"]["speedup"])
+        reg.gauge("bench_disagg_split_decode_p99_ms",
+                  "decode p99 inter-token gap, disaggregated "
+                  "prefill/decode under a worker kill").set(
+            float(disagg["split"]["decode_gap_p99_ms"] or 0.0))
+        reg.gauge("bench_disagg_mixed_decode_p99_ms",
+                  "decode p99 inter-token gap, mixed-role engine, "
+                  "same workload").set(
+            float(disagg["mixed"]["decode_gap_p99_ms"] or 0.0))
+        reg.gauge("bench_disagg_prefill_fill",
+                  "mean packed prefill frame fill on the split arm").set(
+            float(disagg["split"]["fill"]))
     snap = reg.snapshot()
     metrics_snapshot = {
         name: snap[name]["samples"][0]["value"]
@@ -3405,7 +3664,11 @@ def main():
                      "bench_kv_fleet_prefix_hit",
                      "bench_lora_single_tokens_per_sec",
                      "bench_lora_multi16_tokens_per_sec",
-                     "bench_lora_hot_swap_ms")
+                     "bench_lora_hot_swap_ms",
+                     "bench_disagg_packed_speedup",
+                     "bench_disagg_split_decode_p99_ms",
+                     "bench_disagg_mixed_decode_p99_ms",
+                     "bench_disagg_prefill_fill")
         if name in snap}
     metrics_snapshot["mfu_source"] = mfu_source
 
@@ -3440,6 +3703,7 @@ def main():
                    "serving": serving,
                    "resilience": resilience,
                    "router": router,
+                   "disagg": disagg,
                    "kv_cache": kv_cache,
                    "lora": lora,
                    "observability": observability},

@@ -286,6 +286,33 @@ define_flag("serving_waiting_queue_limit", 128,
             "requests raise the typed QueueFull, which the front-end/"
             "router maps to 503 + Retry-After instead of growing the "
             "queue without limit; 0 = unbounded (legacy)", type=int)
+define_flag("serving_role", "mixed",
+            "serving engine role in a disaggregated fleet: 'mixed' (one "
+            "engine prefills AND decodes — the single-host default), "
+            "'prefill' (a packed-prefill worker replica the router never "
+            "routes /generate traffic to), or 'decode' (a decode worker "
+            "that, when a handoff channel is attached, delegates fresh "
+            "prompt prefills to prefill workers and ingests their KV-page "
+            "handoffs)")
+define_flag("serving_prefill_pack", 1,
+            "batched packed prefill: admissions arriving together are "
+            "packed into ONE [1, frame] flash-attention frame with PR-5 "
+            "segment ids (first-fit over 32-aligned rows) instead of "
+            "prefilling one request at a time — pages and streams stay "
+            "bit-equal to sequential prefill; prompts longer than the "
+            "frame (or with an adopted prefix) still run the chunked "
+            "path; 0 = always chunked (PR-9 behavior)", type=int)
+define_flag("serving_pack_frame", 0,
+            "packed-prefill frame length in tokens (rounded down to the "
+            "32-row pack alignment); 0 = serving_prefill_chunk. Bounds "
+            "the packed compile set to the power-of-two buckets <= frame",
+            type=int)
+define_flag("serving_handoff_timeout_s", 5.0,
+            "decode-worker patience for a posted prefill job: past this "
+            "(or on prefill-worker death) the decode engine RECLAIMS the "
+            "request and re-prefills locally — the exactly-once fallback "
+            "that makes a lost handoff cost latency, never a stream",
+            type=float)
 define_flag("router_probe_interval_s", 0.25,
             "router health-monitor cadence: each replica's health()/"
             "readiness (queue depth, slot fill, retraces) is probed this "

@@ -163,7 +163,14 @@ def build_http_server(port: int, run_fn=None, generate_fn=None, *,
                           dicts, streamed as one JSON line each (ndjson) —
                           the continuous-batching scheduler's token stream
                           when paddle_tpu.serving.ServingEngine.serve_http
-                          injects it.
+                          injects it. A submitted prompt prefills through
+                          the engine's packed multi-prompt frames (or is
+                          posted to the prefill workers of a
+                          disaggregated decode-role engine) before its
+                          tokens stream; serving.replica.HTTPReplica is
+                          the matching client, so a fleet Router drives
+                          this endpoint exactly like an in-process
+                          replica.
       * GET /healthz   -> health_fn() dict, answered as JSON (503 when the
                           dict carries ``"ok": False`` or health_fn raises)
       * GET /stats     -> stats_fn() dict as JSON — queue depth, in-flight

@@ -213,7 +213,7 @@ class LlamaAttention(nn.Layer):
 
     def forward_decode(self, x, *, rope, cache, layer_idx, page_table,
                        context_lens, position_ids, ctx_pad=None,
-                       write_mask=None, verify=False):
+                       write_mask=None, verify=False, segment_ids=None):
         """Serving forward over the paged KV cache. x: [B, T, H]; T == 1 is
         a decode step (paged ragged attention over the page table), T > 1
         is a page-writing prefill chunk (runs through the standard flash
@@ -232,11 +232,18 @@ class LlamaAttention(nn.Layer):
         only — draft tokens are PROVISIONAL). `write_mask` [B, T] bool
         redirects masked entries' K/V writes to the reserved null page —
         how a verify frame keeps out-of-window draft slots (past a row's
-        budget/context cap) from scribbling live cache. Returns
-        (out, cache)."""
+        budget/context cap) from scribbling live cache.
+
+        `segment_ids` [B, T] switches T > 1 into the PACKED MULTI-PROMPT
+        prefill frame: several fresh prompts ride one frame, page_table is
+        [n_segments + 1, pages] (one page chain per segment; the last row
+        is all-null and backs pad/gap tokens), position_ids are
+        SEGMENT-LOCAL, and attention runs the PR-5 segment-aware flash
+        path over the frame itself. Returns (out, cache)."""
         from paddle_tpu.ops.pallas.paged_attention import paged_attention
 
         b, t, _ = x.shape
+        packed = segment_ids is not None and t > 1 and not verify
         q = self.q_proj(x).reshape([b, t, -1, self.head_dim])
         k = self.k_proj(x).reshape([b, t, -1, self.head_dim])
         v = self.v_proj(x).reshape([b, t, -1, self.head_dim])
@@ -251,7 +258,14 @@ class LlamaAttention(nn.Layer):
         # the engine donates the pools so XLA updates them in place)
         ck, cv = cache["k"], cache["v"]
         ps = ck.shape[3]
-        pidx = jnp.take_along_axis(page_table, position_ids // ps, axis=1)
+        if packed:
+            # packed frame: a token's page CHAIN is its segment's row, its
+            # column its segment-local position; pad/gap tokens carry the
+            # all-null last row, so they spill to page 0 with no mask
+            pidx = page_table[segment_ids, position_ids // ps]
+        else:
+            pidx = jnp.take_along_axis(page_table,
+                                       position_ids // ps, axis=1)
         if write_mask is not None:
             # masked entries scatter into the null page (page 0): a
             # harmless spill target the allocator never hands out and the
@@ -297,6 +311,29 @@ class LlamaAttention(nn.Layer):
             out = paged_attention(qv, ck[layer_idx], cv[layer_idx],
                                   page_table, context_lens,
                                   k_scales=k_sc, v_scales=v_sc)
+        elif packed:
+            # packed multi-prompt prefill: every segment is a FRESH prompt
+            # whose full K/V sits in this very frame, so attention runs the
+            # segment-aware flash path over the frame itself — no page
+            # gather. The in-frame K/V first round-trips through the cache
+            # dtype (identity when the pool stores the model dtype, the
+            # chunked gather's dequant when quantized), so packed pages AND
+            # outputs stay bit-equal to sequential chunked prefill. Frame
+            # causality == per-segment causality because each segment's
+            # tokens are contiguous and ordered; pads only see the null
+            # segment.
+            if k_sc is not None:
+                k_in = (kq.astype(ck.dtype).astype(qv.dtype)
+                        * sck[..., None].astype(qv.dtype))
+                v_in = (vq.astype(cv.dtype).astype(qv.dtype)
+                        * scv[..., None].astype(qv.dtype))
+            else:
+                k_in = kv.astype(ck.dtype).astype(qv.dtype)
+                v_in = vv.astype(cv.dtype).astype(qv.dtype)
+            out = F.scaled_dot_product_attention(
+                qv, k_in, v_in, is_causal=True, training=False,
+                segment_ids=segment_ids)
+            out = out._value if isinstance(out, Tensor) else out
         else:
             # chunked prefill: gather the full context (pages cover the
             # chunk itself too — just scattered above) and run the SAME
@@ -373,12 +410,13 @@ class LlamaDecoderLayer(nn.Layer):
 
     def forward_decode(self, x, *, rope, cache, layer_idx, page_table,
                        context_lens, position_ids, ctx_pad=None,
-                       write_mask=None, verify=False):
+                       write_mask=None, verify=False, segment_ids=None):
         attn_out, cache = self.self_attn.forward_decode(
             self.input_layernorm(x), rope=rope, cache=cache,
             layer_idx=layer_idx, page_table=page_table,
             context_lens=context_lens, position_ids=position_ids,
-            ctx_pad=ctx_pad, write_mask=write_mask, verify=verify)
+            ctx_pad=ctx_pad, write_mask=write_mask, verify=verify,
+            segment_ids=segment_ids)
         x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, cache
@@ -419,10 +457,11 @@ class LlamaModel(nn.Layer):
 
     def decode_forward(self, input_ids, cache, page_table, context_lens,
                        position_ids, ctx_pad=None, write_mask=None,
-                       verify=False):
+                       verify=False, segment_ids=None):
         """Serving forward over the paged KV cache (decode step when
         input_ids is [B, 1], page-writing prefill chunk when [B, T>1],
-        speculative verify frame when [B, T>1] with verify=True).
+        speculative verify frame when [B, T>1] with verify=True, packed
+        multi-prompt prefill frame when [B, T>1] with segment_ids).
         `cache` = raw {"k","v": [L, Hkv, P, page_size, D]} pools; returns
         (hidden, updated cache). The layer loop is an unrolled Python loop
         — decode programs are tiny next to training HLO, and every layer
@@ -431,6 +470,8 @@ class LlamaModel(nn.Layer):
         context_lens = _raw(context_lens).astype(jnp.int32)
         position_ids = _raw(position_ids).astype(jnp.int32)
         write_mask = _raw(write_mask)
+        segment_ids = (_raw(segment_ids).astype(jnp.int32)
+                       if segment_ids is not None else None)
         x = self.embed_tokens(input_ids)
         rope = (self.rope_cos._value, self.rope_sin._value)
         for i, layer in enumerate(self.layers):
@@ -438,7 +479,8 @@ class LlamaModel(nn.Layer):
                 x, rope=rope, cache=cache, layer_idx=i,
                 page_table=page_table, context_lens=context_lens,
                 position_ids=position_ids, ctx_pad=ctx_pad,
-                write_mask=write_mask, verify=verify)
+                write_mask=write_mask, verify=verify,
+                segment_ids=segment_ids)
         return self.norm(x), cache
 
     def _run_layers(self, x, attn_mask, segment_ids=None, position_ids=None):
@@ -571,12 +613,13 @@ class LlamaForCausalLM(nn.Layer):
 
     def decode_forward(self, input_ids, cache, page_table, context_lens,
                        position_ids, ctx_pad=None, write_mask=None,
-                       verify=False):
+                       verify=False, segment_ids=None):
         """Serving decode/prefill/verify entry: (logits [B, T, vocab],
         cache)."""
         hidden, cache = self.llama.decode_forward(
             input_ids, cache, page_table, context_lens, position_ids,
-            ctx_pad=ctx_pad, write_mask=write_mask, verify=verify)
+            ctx_pad=ctx_pad, write_mask=write_mask, verify=verify,
+            segment_ids=segment_ids)
         return self.lm_head(hidden), cache
 
     # ---- pipeline-parallel factory ----------------------------------------

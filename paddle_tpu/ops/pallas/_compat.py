@@ -1,7 +1,9 @@
 """Shared Pallas-kernel compatibility helpers."""
 from __future__ import annotations
 
-__all__ = ["x64_off"]
+import contextlib
+
+__all__ = ["x64_off", "kernel_trace_ctx"]
 
 
 def x64_off():
@@ -12,3 +14,17 @@ def x64_off():
     from jax.experimental import disable_x64
 
     return disable_x64()
+
+
+def kernel_trace_ctx(interpret: bool):
+    """Context for tracing a pallas_call: `x64_off()` on the Mosaic path,
+    a no-op in interpret mode.
+
+    Interpret mode must trace under the ambient x64 setting: when the call
+    sits inside an outer `jax.jit`, its grid/loop machinery is lowered only
+    when the OUTER program lowers — after this context has exited — and a
+    jaxpr traced x32 but lowered x64 re-canonicalizes weak int literals into
+    i64/i32 StableHLO verifier mismatches. Mosaic never defers past the
+    context (and needs x64 off for its index types), so the TPU path keeps
+    the override."""
+    return contextlib.nullcontext() if interpret else x64_off()

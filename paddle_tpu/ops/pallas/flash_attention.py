@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from paddle_tpu.ops.pallas._compat import x64_off as _x64_off
+from paddle_tpu.ops.pallas._compat import kernel_trace_ctx as _kernel_trace_ctx
 
 try:  # pallas TPU backend may be absent on pure-CPU installs
     from jax.experimental.pallas import tpu as pltpu
@@ -276,7 +276,10 @@ def _flash_fwd(q, k, v, seg, causal: bool, scale: float, group: int,
         args.extend([seg, seg])
     # Mosaic lowering mishandles 64-bit index types; the kernel is pure
     # f32/bf16/i32, so trace it with x64 off regardless of the global setting.
-    with _x64_off():
+    # Interpret mode keeps the ambient x64 (see kernel_trace_ctx): an outer
+    # jit lowers the grid loops after this context exits, and an x32-traced /
+    # x64-lowered jaxpr trips the StableHLO verifier on weak int literals.
+    with _kernel_trace_ctx(interpret):
         out, lse = pl.pallas_call(
             kernel,
             grid=grid,
@@ -437,7 +440,7 @@ def _flash_bwd(q, k, v, seg, out, lse, do, causal: bool, scale: float,
             (1, block_k), lambda b, i, j: (b // heads_q, j)))
         dq_args.extend([seg, seg])
 
-    with _x64_off():
+    with _kernel_trace_ctx(interpret):
         dq = pl.pallas_call(
             functools.partial(_dq_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k,
@@ -538,7 +541,7 @@ def segment_block_visit_counts(segment_ids, block_q: int | None = None,
         interpret = _interpret_mode()
     kernel = functools.partial(_visit_kernel, block_q=block_q,
                                block_k=block_k, seq_len=s, causal=causal)
-    with _x64_off():
+    with _kernel_trace_ctx(interpret):
         cnt = pl.pallas_call(
             kernel,
             grid=(b, s // block_q),

@@ -23,14 +23,29 @@ Compiled-signature strategy (ZERO decode retraces):
     drafts' K/V are provisional garbage past the committed length and are
     rewritten before they ever become readable (the PR-9 last-token
     rewrite, widened to the frame head).
-  * A small prefill bucket set. Prompts prefill one request at a time in
-    chunks of ``serving_prefill_chunk`` tokens through the standard flash
-    path; chunk length and padded context round up to power-of-two buckets,
-    bounding compiles to |chunk buckets| x |context buckets|. With
-    ``serving_prefix_sharing`` on, admission adopts the longest indexed
-    committed-prefix pages (refcounted, copy-on-write — kv_cache.py) and
-    prefill runs ONLY the unmatched tail: a fleet of requests sharing one
-    system prompt prefills it once.
+  * A small prefill bucket set, with BATCHED PACKED prefill. Admissions
+    arriving together are packed into ONE ``[1, frame]`` flash-attention
+    frame using PR-5 segment ids (first-fit over 32-aligned rows, one
+    page chain per segment), so one program dispatch prefills N short
+    prompts instead of N dispatches — pages and streams stay bit-equal
+    to sequential prefill. Prompts longer than the frame, adopted-prefix
+    tails, and solo arrivals run the chunked path: one request at a time
+    in chunks of ``serving_prefill_chunk`` tokens through the same flash
+    kernel. Chunk/frame lengths and padded context round up to
+    power-of-two buckets, bounding compiles to |chunk buckets| x
+    |context buckets| + |frame buckets|. With ``serving_prefix_sharing``
+    on, admission adopts the longest indexed committed-prefix pages
+    (refcounted, copy-on-write — kv_cache.py) and prefill runs ONLY the
+    unmatched tail: a fleet of requests sharing one system prompt
+    prefills it once.
+  * Disaggregated roles (``serving_role``). A ``decode``-role engine
+    with a `disagg.HandoffChannel` attached POSTS fresh full-prompt
+    admissions to prefill workers and activates them only on the typed
+    KV-page handoff (single-host pools alias, so the handoff is a page
+    table splice; copy mode splices extracted pages through the
+    compiled restore program). A dead worker or a dropped/overdue
+    handoff is RECLAIMED: the decode side re-prefills locally — page
+    writes are idempotent byte-identical, so recovery is exactly-once.
 
 Sampling runs inside the decode program (greedy + temperature/top-k/top-p,
 per-request RNG keys), so a step's host work is queue bookkeeping plus
@@ -99,6 +114,10 @@ class ServingConfig:
     max_waiting: int = 0            # 0 -> FLAGS_serving_waiting_queue_limit
     spec_k: int | None = None       # None -> FLAGS_serving_spec_k
     prefix_sharing: bool | None = None  # None -> FLAGS_serving_prefix_sharing
+    role: str = ""                  # "" -> FLAGS_serving_role
+    prefill_pack: bool | None = None    # None -> FLAGS_serving_prefill_pack
+    pack_frame: int = 0             # 0 -> FLAGS_serving_pack_frame,
+                                    #      then prefill_chunk
 
     def resolved(self, model_max_pos: int):
         from paddle_tpu.core.flags import flag
@@ -119,9 +138,14 @@ class ServingConfig:
                    or flag("serving_kv_cache_dtype")).lower()
         host_mb = (self.host_cache_mb if self.host_cache_mb >= 0
                    else flag("serving_host_cache_mb"))
+        role = (self.role or str(flag("serving_role"))).lower()
+        pack = (flag("serving_prefill_pack") if self.prefill_pack is None
+                else self.prefill_pack)
+        frame = self.pack_frame or flag("serving_pack_frame")
         return (int(ps), int(batch), int(chunk), int(smax), int(budget),
                 int(pages), int(waiting), int(spec_k), bool(sharing),
-                str(kv_mode), int(host_mb))
+                str(kv_mode), int(host_mb), str(role), bool(pack),
+                int(frame))
 
 
 import itertools as _itertools
@@ -135,12 +159,14 @@ _ENGINE_GAUGES = (
     "queue_depth", "oldest_wait_age_s", "in_flight", "slot_fill",
     "decode_retraces_after_warmup", "free_pages", "spec_k",
     "accepted_tokens_per_step", "prefix_hit_rate", "cow_copies",
+    "prefill_batch_fill", "handoff_ms", "pending_handoffs",
 )
 _ENGINE_COUNTERS = {
     # monotonic engine totals mirrored at scrape time
     "committed_tokens": "_committed_tokens",
     "decode_steps": "_decode_steps",
     "prefix_matched_tokens": "_prefix_matched_tokens",
+    "handoff_pages": "_handoff_pages",
 }
 
 
@@ -190,6 +216,13 @@ def _register_engine_metrics(engine: "ServingEngine"):
                   labels=("engine", "dtype")).labels(
             engine=eng._metrics_id,
             dtype=st.get("kv_cache_dtype", "unknown")).set(1.0)
+        # PR-19 disaggregation: the engine's serving role as a labeled
+        # one-hot (prefill/decode/mixed — what router placement filters)
+        reg.gauge("serving_engine_role",
+                  "engine serving role (one-hot by role label)",
+                  labels=("engine", "role")).labels(
+            engine=eng._metrics_id,
+            role=st.get("role", "mixed")).set(1.0)
         # multi-tenant LoRA billing: committed tokens per tenant (the
         # AdapterStore registers its own residency/swap collectors)
         tok = reg.counter("lora_tokens_total",
@@ -242,8 +275,12 @@ class ServingEngine:
         (self.page_size, self.decode_batch, self.prefill_chunk,
          self.max_seq_len, budget_mb, cfg_pages, self.max_waiting,
          self.spec_k, self.prefix_sharing, kv_mode,
-         host_mb) = self.config.resolved(
+         host_mb, role, pack, pack_frame) = self.config.resolved(
             int(mcfg.max_position_embeddings))
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"serving_role must be one of "
+                             f"mixed/prefill/decode, got {role!r}")
+        self.role = role
         if self.spec_k < 0:
             raise ValueError(f"serving_spec_k must be >= 0, "
                              f"got {self.spec_k}")
@@ -360,6 +397,35 @@ class ServingEngine:
         self._extract_fn = None      # D2H demote: gather one page
         self._restore_fn = None      # H2D promote: scatter one page
         self._prefill_fns: dict[tuple[int, int], object] = {}
+        # batched packed prefill (PR-19 tentpole): same-arrival short
+        # prompts share ONE [1, frame] segment-id flash frame. Segment
+        # starts stay 32-row aligned so the packed kernel sees the exact
+        # block decomposition sequential prefill would — that alignment
+        # is what makes packed page bytes BIT-EQUAL to one-at-a-time.
+        self.prefill_pack = bool(pack)
+        self.pack_align = 32
+        frame = min(int(pack_frame or self.prefill_chunk), self._ctx_cap())
+        self.pack_frame = max(self.pack_align,
+                              (frame // self.pack_align) * self.pack_align)
+        self._pack_buckets = _buckets(min(64, self.pack_frame),
+                                      self.pack_frame)
+        self._prefill_packed_fns: dict[int, object] = {}
+        self._pack_frames = 0
+        self._pack_reqs = 0
+        self._pack_fill_tokens = 0
+        self._pack_frame_tokens = 0
+        # KV-page handoff (decode role): admissions parked on the prefill
+        # workers until their page chains land (or the reclaim fallback
+        # re-prefills locally)
+        self._handoff_channel = None
+        self._handoff_timeout_s = 5.0
+        self._pending_handoff: dict[int, object] = {}
+        self._cancelled_pending: set[int] = set()
+        self._handoffs = 0
+        self._handoff_reclaims = 0
+        self._handoff_pages = 0
+        self._handoff_ms_total = 0.0
+        self._handoff_ms_last = 0.0
         # speculation / prefix-sharing accounting (stats() surfaces these;
         # the bench's accepted-tokens/step and prefix-hit-rate gates read
         # them): committed counts REAL tokens delivered to requests, steps
@@ -378,6 +444,11 @@ class ServingEngine:
         self._util_samples: deque = deque(maxlen=65536)
         import threading
         self._http_lock = threading.Lock()
+        # serializes device work between this engine's driver and any
+        # ALIAS-mode prefill worker writing into the shared pools: every
+        # compiled step REASSIGNS (and on TPU donates) the functional
+        # cache handle, so concurrent dispatch would fork or kill it
+        self._step_lock = threading.RLock()
         self._http_stop = False
         self._http_error: str | None = None
         # observability: register a SCRAPE-TIME collector mapping stats()
@@ -496,6 +567,114 @@ class ServingEngine:
             self._prefill_fns[key] = jax.jit(
                 fn, donate_argnums=(1,) if self._donate else ())
         return self._prefill_fns[key]
+
+    def _prefill_packed(self, frame: int):
+        """The packed MULTI-PROMPT prefill program for one frame bucket:
+        token ids, segment ids and segment-local positions ride as
+        [frame] arrays, the per-segment page chains as one
+        [frame/32 + 1, pages] table (the extra all-null row backs pad and
+        gap rows), so ONE compile per bucket serves every packing mix.
+        Logits are never sampled — the first decode step's last-token
+        rewrite mints each request's first token — so the lm_head matmul
+        is dead code XLA eliminates."""
+        if frame not in self._prefill_packed_fns:
+            from paddle_tpu.parallel.train_step import functional_call
+
+            def fn(params, cache, ids, seg, pos, tables, aslots, apools,
+                   bpools):
+                self._prefill_traces += 1
+                with self._adapter_bind(aslots, apools, bpools):
+                    _, cache = functional_call(
+                        self.model, params, (ids[None],),
+                        dict(cache=cache, page_table=tables,
+                             context_lens=jnp.ones(1, jnp.int32),
+                             position_ids=pos[None],
+                             segment_ids=seg[None]),
+                        training=False, method="decode_forward")
+                return cache
+
+            self._prefill_packed_fns[frame] = jax.jit(
+                fn, donate_argnums=(1,) if self._donate else ())
+        return self._prefill_packed_fns[frame]
+
+    def _plan_frames(self, seq, length_of):
+        """First-fit split into pack frames: each segment consumes
+        ceil(len/32)*32 aligned rows, and a segment that would overflow
+        the frame starts the next one. Items longer than the frame never
+        get here (callers route them to the chunked path)."""
+        frames, cur, used = [], [], 0
+        for x in seq:
+            rows = -(-int(length_of(x)) // self.pack_align) * self.pack_align
+            if cur and used + rows > self.pack_frame:
+                frames.append(cur)
+                cur, used = [], 0
+            cur.append(x)
+            used += rows
+        if cur:
+            frames.append(cur)
+        return frames
+
+    def packed_prefill_cache(self, cache, items, adapter=None):
+        """Device work of ONE packed multi-prompt prefill frame over
+        `cache`: `items` is a list of (tokens int32 [L], page_row int32)
+        pairs whose page chains live in whichever pool `cache` belongs to
+        — this engine's own, or a copy-mode prefill worker's side pool.
+        Callers pre-split items with `_plan_frames`. Returns the updated
+        cache handle. Pads and inter-segment gap rows carry the null
+        segment id (the all-null table row), so their K/V writes land in
+        the reserved trash page and their attention contribution is
+        masked out by the segment-id kernel."""
+        align, ps = self.pack_align, self.page_size
+        used = sum(-(-int(t.size) // align) * align for t, _ in items)
+        fpad = _bucket(used, self._pack_buckets)
+        n_seg = fpad // align       # frame capacity in 32-row segments
+        n_pages = -(-fpad // ps)
+        ids = np.zeros(fpad, np.int32)
+        seg = np.full(fpad, n_seg, np.int32)
+        pos = np.zeros(fpad, np.int32)
+        tables = np.zeros((n_seg + 1, n_pages), np.int32)
+        off = filled = 0
+        for j, (toks, row) in enumerate(items):
+            t = int(toks.size)
+            ids[off:off + t] = toks
+            seg[off:off + t] = j
+            pos[off:off + t] = np.arange(t, dtype=np.int32)
+            n = min(n_pages, int(np.asarray(row).size))
+            tables[j, :n] = np.asarray(row)[:n]
+            off += -(-t // align) * align
+            filled += t
+        aslots, apools, bpools = (None, None, None)
+        if self.adapters is not None:
+            slot = (self.adapters.slot_of(adapter)
+                    if adapter else self.adapters.num_slots)
+            aslots, apools, bpools = self._adapter_args(
+                np.full(1, slot, np.int32))
+        cache = self._prefill_packed(fpad)(
+            self._params, cache, jnp.asarray(ids), jnp.asarray(seg),
+            jnp.asarray(pos), jnp.asarray(tables), aslots, apools, bpools)
+        self._pack_frames += 1
+        self._pack_reqs += len(items)
+        self._pack_fill_tokens += filled
+        self._pack_frame_tokens += fpad
+        return cache
+
+    def prefill_jobs(self, jobs) -> float:
+        """ALIAS-mode prefill-worker entry: run the jobs' packed frames
+        straight into this engine's shared pools under the step lock.
+        The chains were allocated by the decode side at admission, so
+        writes land in pages the target requests already own — and a
+        later decode-side re-prefill of the same job is an idempotent
+        byte-overwrite, which is what makes reclaim exactly-once.
+        Returns device milliseconds spent."""
+        items = [(j.tokens, j.page_row) for j in jobs if not j.cancelled]
+        t0 = time.perf_counter()
+        if items:
+            with self._step_lock:
+                for frame in self._plan_frames(items,
+                                               lambda it: it[0].size):
+                    self._cache = self.packed_prefill_cache(self._cache,
+                                                            frame)
+        return (time.perf_counter() - t0) * 1e3
 
     def _verify(self, k: int):
         """The [batch, K+1] speculative verify program for draft window
@@ -664,6 +843,15 @@ class ServingEngine:
         return np.asarray(key, np.uint32)
 
     def cancel(self, rid: int) -> bool:
+        job = self._pending_handoff.get(rid)
+        if job is not None:
+            # the rid's pages are an in-flight prefill-worker target:
+            # freeing them now could reallocate them under a write. Mark
+            # and defer — handoff resolution finishes the cancel on the
+            # decode thread once the writes are settled.
+            job.cancelled = True
+            self._cancelled_pending.add(rid)
+            return True
         return self.scheduler.cancel(rid)
 
     # ------------------------------------------------------------------
@@ -711,6 +899,23 @@ class ServingEngine:
                 jnp.asarray(off + t, jnp.int32), row,
                 aslots, apools, bpools)
             off += t
+
+    def _run_prefill_packed(self, reqs):
+        """One packed frame prefilling `reqs` together — bit-equal to
+        running `_run_prefill` per request (same kernel, same 32-row
+        block decomposition), amortizing one program dispatch over N."""
+        items = []
+        for r in reqs:
+            self._prefix_admit_tokens += int(r.context.size)
+            items.append((np.asarray(r.context, np.int32),
+                          self.allocator.page_table_row(
+                              r.rid, self.pages_per_seq)))
+        with obs_tracing.span(
+                "engine.prefill_packed", component="engine",
+                reqs=len(reqs), tokens=sum(int(t.size) for t, _ in items),
+                trace_ids=[r.trace_id for r in reqs if r.trace_id]):
+            self._cache = self.packed_prefill_cache(
+                self._cache, items, adapter=reqs[0].adapter)
 
     def _decode_once(self, active, finisher):
         """Pack `active` requests into the fixed decode-batch signature,
@@ -884,7 +1089,7 @@ class ServingEngine:
                                   jnp.asarray(page, jnp.int32))
         # journal the batch (storms — many transitions in one drain — at
         # warning severity so dashboards notice thrash, not each page)
-        sev = "warning" if len(demotes) + len(promotes) >= 8 else "info"
+        sev = "warn" if len(demotes) + len(promotes) >= 8 else "info"
         if demotes:
             obs_events.emit("serving", "kv_demote", severity=sev,
                             pages=len(demotes),
@@ -894,37 +1099,138 @@ class ServingEngine:
                             pages=len(promotes),
                             host_used=self.allocator.host_used)
 
-    def step(self) -> bool:
-        """One scheduler iteration: admissions (+ their tail prefills and
-        prefix registration), chain growth/eviction + copy-on-write, then
-        ONE packed decode step — the [batch] plain-decode program, or the
-        [batch, K+1] speculative verify frame when serving_spec_k > 0.
-        Returns False when nothing is running (idle or waiting-only)."""
+    def _packable(self, req: Request) -> bool:
+        return (self.prefill_pack
+                and req.matched_tokens == 0
+                and int(req.context.size) <= self.pack_frame)
+
+    def _postable(self, req: Request) -> bool:
+        # adapter'd requests prefill locally (one slot id rides the
+        # packed frame; cross-engine slot residency is not a worker
+        # contract), as do adopted-prefix tails and over-frame prompts
+        return (req.matched_tokens == 0 and not req.adapter
+                and int(req.context.size) <= self.pack_frame)
+
+    def _pack_collides(self, head: Request, batch) -> bool:
+        """Would the waiting head prefix-match a collected-but-unflushed
+        batch member? Packing past that point would lose the adoption
+        (pages register only at flush), so the caller flushes first."""
+        if not self.prefix_sharing:
+            return False
+        ps = self.page_size
+        ctx = head.context
+        if int(ctx.size) < ps:
+            return False
+        h = np.asarray(ctx[:ps])
+        return any(int(r.context.size) >= ps
+                   and np.array_equal(np.asarray(r.context[:ps]), h)
+                   for r in batch)
+
+    def _admit(self):
+        """Admission phase: drain the waiting queue into prefills.
+
+        Packable same-arrival admissions (fresh full prompts that fit
+        the pack frame) COLLECT into a batch flushed as packed
+        segment-id frames; everything else — adopted-prefix tails,
+        prompts longer than the frame, an adapter change mid-batch, a
+        waiting head that would prefix-match a collected member —
+        flushes first and runs the chunked one-at-a-time path, keeping
+        the PR-14 contract that a request's pages are registered before
+        the next prefix match runs.
+
+        A decode-role engine with live prefill workers POSTS packable
+        admissions instead: the page chain is allocated here, the writes
+        happen on the worker, and activation waits for the typed
+        KV-page handoff (or the reclaim fallback re-prefills locally)."""
+        batch: list[Request] = []
+
+        def flush():
+            if not batch:
+                return
+            self._apply_tier_ops()
+            for frame in self._plan_frames(batch,
+                                           lambda r: r.context.size):
+                if len(frame) == 1:
+                    # a frame of one gains nothing over the chunked path
+                    # and would cost an extra compile bucket: solo
+                    # arrivals keep the exact PR-9 program sequence
+                    self._run_prefill(frame[0])
+                else:
+                    self._run_prefill_packed(frame)
+            for r in batch:
+                if self.prefix_sharing:
+                    self.allocator.register_prefix(r.rid, r.context)
+                self.scheduler.activate(r)
+            batch.clear()
+
+        post_ok = (self._handoff_channel is not None
+                   and self._handoff_channel.workers_alive())
         while True:
-            # one admission at a time: each request's prefill + prefix
-            # registration lands BEFORE the next match, so same-step
-            # arrivals sharing a system prompt adopt each other's pages
+            # collected batch members and posted-but-unlanded handoffs
+            # hold decode slots the scheduler can't see yet: account for
+            # them here or collection would overcommit the batch
+            if (len(self.scheduler.running) + len(batch)
+                    + len(self._pending_handoff) >= self.decode_batch):
+                break
+            head = (self.scheduler.waiting[0]
+                    if self.scheduler.waiting else None)
+            if head is None:
+                break
+            if batch and self._pack_collides(head, batch):
+                flush()
+                continue
             admitted = self.scheduler.admissions(limit=1)
             if not admitted:
                 break
             req = admitted[0]
+            if post_ok and self._postable(req):
+                flush()
+                self._post_prefill(req)
+                continue
+            if self._packable(req):
+                if batch and ((req.adapter or None)
+                              != (batch[0].adapter or None)):
+                    flush()
+                batch.append(req)
+                continue
+            flush()
             # tier transitions queued by this admission's match/ensure
             # (promoted radix hits, demoted reclaim victims) must land
             # before the tail prefill touches the device pools
             self._apply_tier_ops()
             self._run_prefill(req)
             if self.prefix_sharing:
-                # a request's committed context (prompt + pre-eviction
-                # generation) becomes matchable the moment its pages are
-                # written: the next admission sharing the prefix adopts
-                # them instead of re-prefilling
+                # a request's committed context becomes matchable the
+                # moment its pages are written: the next admission
+                # sharing the prefix adopts them instead of re-prefilling
                 self.allocator.register_prefix(req.rid, req.context)
             self.scheduler.activate(req)
+        flush()
+
+    def step(self) -> bool:
+        """One scheduler iteration: handoff ingest (decode role),
+        admissions (+ their packed/chunked prefills and prefix
+        registration), chain growth/eviction + copy-on-write, then ONE
+        packed decode step — the [batch] plain-decode program, or the
+        [batch, K+1] speculative verify frame when serving_spec_k > 0.
+        Returns False when nothing is running (idle or waiting-only)."""
+        with self._step_lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> bool:
+        if self._handoff_channel is not None:
+            self._drain_handoffs()
+        self._admit()
         self.scheduler.grow()
         self._apply_tier_ops()   # grow()'s reclaims demote before CoW writes
         self._apply_cow()
         running = list(self.scheduler.running)
         if not running:
+            if self._pending_handoff:
+                # every admitted request is parked on the prefill
+                # workers: wait a beat for a handoff instead of spinning
+                self._drain_handoffs(wait_s=0.002)
+                return True
             if self.scheduler.waiting:
                 blocked = self.scheduler.waiting[0]
                 raise RuntimeError(
@@ -954,9 +1260,126 @@ class ServingEngine:
             self._decode_once(running, self.scheduler.finish)
         return True
 
+    # ------------------------------------------------------------------
+    # disaggregation: the decode side of the KV-page handoff
+    # ------------------------------------------------------------------
+    @property
+    def busy(self) -> bool:
+        """Work pending anywhere: scheduler queues OR admissions parked
+        on the prefill workers. Drivers must keep stepping for the
+        latter — `scheduler.idle` alone would strand them (a pending
+        handoff is neither waiting nor running)."""
+        return (not self.scheduler.idle) or bool(self._pending_handoff)
+
+    def attach_prefill(self, channel, timeout_s: float | None = None):
+        """Wire a `disagg.HandoffChannel` into this engine (the decode
+        role): packable fresh admissions are POSTED as prefill jobs and
+        activate only on the typed KV-page handoff. An overdue, dropped
+        or worker-death-orphaned job is RECLAIMED by a local re-prefill:
+        page writes are idempotent byte-overwrites into pages this
+        engine's request already owns, so a worker that died mid-write
+        cannot corrupt the stream — recovery is exactly-once."""
+        from paddle_tpu.core.flags import flag
+
+        self._handoff_channel = channel
+        self._handoff_timeout_s = float(
+            flag("serving_handoff_timeout_s") if timeout_s is None
+            else timeout_s)
+
+    def _post_prefill(self, req: Request):
+        from paddle_tpu.serving.disagg import PrefillJob
+
+        # tier ops queued by this admission must land before a worker
+        # writes into the freshly ensured chain
+        self._apply_tier_ops()
+        job = PrefillJob(
+            rid=req.rid,
+            tokens=np.asarray(req.context, np.int32),
+            page_row=np.asarray(self.allocator.page_table_row(
+                req.rid, self.pages_per_seq), np.int32),
+            posted_t=time.monotonic(),
+            trace_id=req.trace_id or "")
+        self._pending_handoff[req.rid] = job
+        self._handoff_channel.post(job)
+
+    def _drain_handoffs(self, wait_s: float = 0.0):
+        ch = self._handoff_channel
+        for h in ch.take_done(wait_s):
+            self._ingest_handoff(h)
+        if not self._pending_handoff:
+            return
+        now = time.monotonic()
+        alive = ch.workers_alive()
+        stale = [job for job in list(self._pending_handoff.values())
+                 if job.failed or not alive
+                 or now - job.posted_t > self._handoff_timeout_s]
+        for job in stale:
+            self._reclaim(job)
+
+    def _ingest_handoff(self, h):
+        job = self._pending_handoff.pop(h.rid, None)
+        if job is None:
+            return            # already reclaimed locally: exactly-once
+        req = self.scheduler._by_rid.get(h.rid)
+        if req is None or h.rid in self._cancelled_pending:
+            self._finish_cancelled(h.rid)
+            return
+        if h.pages is not None:
+            # copy mode: splice the worker's extracted pages into this
+            # pool's chain through the compiled restore program (the
+            # PR-16 promote shape — the "one compiled device-to-device
+            # copy program" of the handoff contract)
+            restore = self._restore_page()
+            chain = self.allocator.chain(h.rid)
+            for data, dst in zip(h.pages, chain):
+                self._cache = restore(self._cache, data,
+                                      jnp.asarray(dst, jnp.int32))
+        self._handoffs += 1
+        self._handoff_pages += int(h.n_pages)
+        self._handoff_ms_total += float(h.ms)
+        self._handoff_ms_last = float(h.ms)
+        self._prefix_admit_tokens += int(req.context.size)
+        obs_events.emit(
+            "serving", "handoff", rid=int(h.rid), pages=int(h.n_pages),
+            ms=round(float(h.ms), 3), worker=h.worker,
+            mode="copy" if h.pages is not None else "alias")
+        if self.prefix_sharing:
+            self.allocator.register_prefix(req.rid, req.context)
+        self.scheduler.activate(req)
+
+    def _reclaim(self, job):
+        self._pending_handoff.pop(job.rid, None)
+        job.cancelled = True      # a live worker skips it if still queued
+        req = self.scheduler._by_rid.get(job.rid)
+        if req is None or job.rid in self._cancelled_pending:
+            self._finish_cancelled(job.rid)
+            return
+        self._handoff_reclaims += 1
+        obs_events.emit("serving", "handoff_reclaim", severity="warn",
+                        rid=int(job.rid),
+                        cause="worker_failed" if job.failed else "timeout")
+        self._apply_tier_ops()
+        self._run_prefill(req)
+        if self.prefix_sharing:
+            self.allocator.register_prefix(req.rid, req.context)
+        self.scheduler.activate(req)
+
+    def _finish_cancelled(self, rid: int):
+        """The deferred cancel+release for a request whose pages were an
+        in-flight prefill-worker target when its client went away:
+        resolution runs on the decode thread with the writes settled, so
+        the pages are finally safe to free."""
+        self._cancelled_pending.discard(rid)
+        if self.scheduler._by_rid.get(rid) is None:
+            return
+        self.scheduler.cancel(rid)
+        self.scheduler.release(rid)
+        self._keys.pop(rid, None)
+        self._proposer.drop(rid)
+
     def run_until_idle(self, max_steps: int = 1_000_000):
         steps = 0
-        while not self.scheduler.idle:
+        while self.busy:
             self.step()
             steps += 1
             if steps > max_steps:
@@ -967,6 +1390,10 @@ class ServingEngine:
         """Drop a finished request's bookkeeping (scheduler entry, RNG
         key, draft tables, adapter slot pin) — the per-request memory a
         long-lived server must not retain."""
+        if rid in self._pending_handoff or rid in self._cancelled_pending:
+            # deferred alongside cancel(): handoff resolution runs the
+            # real cleanup once the worker's writes are settled
+            return
         req = self.scheduler._by_rid.get(rid)
         if (req is not None and req.finished and req.adapter
                 and self.adapters is not None):
@@ -1117,7 +1544,7 @@ class ServingEngine:
         while not self._http_stop:
             try:
                 with self._http_lock:
-                    busy = not self.scheduler.idle
+                    busy = self.busy
                     if busy:
                         self.step()
             except Exception as e:  # surface through every open stream
@@ -1267,7 +1694,28 @@ class ServingEngine:
             "lora": (self.adapters.residency()
                      if self.adapters is not None else {}),
             "tenant_tokens": dict(self._tenant_tokens),
+            # PR-19 disaggregation: serving role, packed-frame fill, and
+            # the KV-page handoff counters (the /stats view of the
+            # handoff gauges; routers filter placement on "role")
+            "role": self.role,
+            "prefill_batch_fill": self.prefill_batch_fill,
+            "prefill_packed_frames": self._pack_frames,
+            "prefill_packed_requests": self._pack_reqs,
+            "pending_handoffs": len(self._pending_handoff),
+            "handoffs": self._handoffs,
+            "handoff_reclaims": self._handoff_reclaims,
+            "handoff_pages": self._handoff_pages,
+            "handoff_ms": round(self._handoff_ms_last, 3),
+            "handoff_ms_total": round(self._handoff_ms_total, 3),
         }
+
+    @property
+    def prefill_batch_fill(self) -> float:
+        """Mean packed-frame fill: real prompt tokens over padded frame
+        rows across packed prefill dispatches (1.0 = no padding waste;
+        0.0 until the first packed frame)."""
+        return round(self._pack_fill_tokens / self._pack_frame_tokens, 4) \
+            if self._pack_frame_tokens else 0.0
 
     @property
     def accepted_tokens_per_step(self) -> float:
@@ -1300,6 +1748,15 @@ class ServingEngine:
         self._draft_ms = 0.0
         self._prefix_admit_tokens = 0
         self._prefix_matched_tokens = 0
+        self._pack_frames = 0
+        self._pack_reqs = 0
+        self._pack_fill_tokens = 0
+        self._pack_frame_tokens = 0
+        self._handoffs = 0
+        self._handoff_reclaims = 0
+        self._handoff_pages = 0
+        self._handoff_ms_total = 0.0
+        self._handoff_ms_last = 0.0
         self.allocator.cow_copies = 0
         self.allocator.prefix_matches = 0
         self.allocator.prefix_tokens_matched = 0

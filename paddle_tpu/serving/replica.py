@@ -41,9 +41,11 @@ import numpy as np
 from paddle_tpu.distributed.resilience import faults
 from paddle_tpu.observability import events as obs_events
 from paddle_tpu.observability import tracing as obs_tracing
+from paddle_tpu.serving.scheduler import QueueFull
 
 __all__ = ["ReplicaError", "ReplicaDead", "StreamGap", "StreamCut",
-           "InProcessReplica", "ReplicaStream"]
+           "InProcessReplica", "ReplicaStream", "HTTPReplica",
+           "HTTPReplicaStream"]
 
 
 class ReplicaError(RuntimeError):
@@ -175,7 +177,12 @@ class InProcessReplica:
                 if faults.fire_check("serving.replica.slow"):
                     time.sleep(self.slow_stall_s)
                 with self._lock:
-                    busy = not self.engine.scheduler.idle
+                    # engine.busy also covers admissions parked on
+                    # prefill workers (pending KV-page handoffs are
+                    # neither waiting nor running); fall back to the
+                    # scheduler for engine stand-ins without the property
+                    busy = bool(getattr(self.engine, "busy",
+                                        not self.engine.scheduler.idle))
                     if busy:
                         self.engine.step()
             except BaseException as e:
@@ -271,3 +278,187 @@ class InProcessReplica:
     def __exit__(self, *a):
         self.close()
         return False
+
+
+class HTTPReplicaStream:
+    """The HTTP half of `ReplicaStream`: one open /generate response. A
+    reader thread drains the chunked ndjson body into a queue;
+    `next_event` maps protocol lines into the SAME vocabulary the
+    in-process stream speaks — parsed event dicts through, in-stream
+    ``queue_full`` re-raised as the typed backpressure exception, a
+    connection death or truncated body as StreamCut. `close()` tears
+    down the response + connection (the server's generator teardown
+    cancels and releases the request) and joins the reader."""
+
+    def __init__(self, rep: "HTTPReplica", conn, resp):
+        self.replica = rep
+        self._conn = conn
+        self._resp = resp
+        self._q: queue_mod.Queue = queue_mod.Queue()
+        self._closed = False
+        self._done_seen = False
+        self._reader = threading.Thread(
+            target=self._read, daemon=True,
+            name=f"paddle_tpu.serving.http.{rep.replica_id}.reader")
+        self._reader.start()
+
+    def _read(self):
+        try:
+            for raw in iter(self._resp.readline, b""):
+                raw = raw.strip()
+                if raw:
+                    self._q.put(("event", raw))
+            self._q.put(("eof", None))
+        except Exception as e:
+            # a close() racing the read lands here too: next_event is
+            # never called after close, so the cut marker just drains
+            self._q.put(("cut", f"{type(e).__name__}: {e}"))
+
+    def next_event(self, timeout_s: float):
+        import json
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if faults.fire_check("serving.stream.cut"):
+                self.close()
+                raise StreamCut(
+                    f"HTTP stream to replica {self.replica.replica_id} "
+                    f"cut at the transport seam")
+            try:
+                kind, item = self._q.get(timeout=min(0.02, timeout_s))
+            except queue_mod.Empty:
+                if time.monotonic() >= deadline:
+                    return None
+                continue
+            if kind == "cut" or (kind == "eof" and not self._done_seen):
+                raise StreamCut(
+                    f"HTTP stream to replica {self.replica.replica_id} "
+                    f"died mid-stream: {item or 'connection closed'}")
+            if kind == "eof":
+                return {"done": True}   # trailing read past the terminal
+            try:
+                ev = json.loads(item)
+            except ValueError:
+                raise StreamCut(
+                    f"HTTP stream to replica {self.replica.replica_id}: "
+                    f"malformed ndjson line {item[:80]!r}")
+            if ev.get("done") or "error" in ev:
+                self._done_seen = True
+            if ev.get("error") == "queue_full":
+                # the submit-race refusal arrives in-stream (headers were
+                # already out): same typed backpressure as the 503 path,
+                # same no-breaker-strike contract
+                raise QueueFull(0, 0)
+            return ev
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for closeable in (self._resp, self._conn):
+            try:
+                closeable.close()
+            except Exception:
+                pass
+        self._reader.join(timeout=5.0)
+
+
+class HTTPReplica:
+    """The real HTTP transport client behind the same three-method seam:
+    speaks serve.py's ``/healthz`` + ``/stats`` + ``/generate`` ndjson
+    protocol, so a Router drives a live serving process exactly as it
+    drives an InProcessReplica (same failover, breaker and drain
+    behavior — the router cannot tell them apart). Connections are
+    per-call: one cut stream never poisons a pooled socket, and a probe
+    answers on a fresh socket even while streams are open."""
+
+    def __init__(self, host: str, port: int, replica_id: int = 0,
+                 timeout_s: float = 5.0, stream_timeout_s: float = 60.0):
+        faults.check_flag_spec()
+        self.host = str(host)
+        self.port = int(port)
+        self.replica_id = int(replica_id)
+        self.timeout_s = float(timeout_s)
+        self.stream_timeout_s = float(stream_timeout_s)
+
+    def _connect(self):
+        import http.client
+
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_s)
+
+    def probe(self) -> dict:
+        """GET /healthz — the same readiness dict InProcessReplica.probe
+        returns (serve.py answers 503 once the engine driver died, which
+        maps to ReplicaDead exactly like a dead in-process driver)."""
+        import http.client
+        import json
+
+        try:
+            conn = self._connect()
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                status, body = resp.status, resp.read()
+            finally:
+                conn.close()
+        except (ConnectionError, OSError, http.client.HTTPException) as e:
+            raise ReplicaDead(
+                f"replica {self.replica_id} unreachable at "
+                f"{self.host}:{self.port}: {type(e).__name__}: {e}")
+        try:
+            st = json.loads(body)
+        except ValueError:
+            st = {}
+        if status != 200 or not st.get("ok", False):
+            raise ReplicaDead(
+                f"replica {self.replica_id} unhealthy (HTTP {status}): "
+                f"{st.get('error') or body[:120]!r}")
+        st.setdefault("replica", self.replica_id)
+        return st
+
+    def open_stream(self, payload: dict) -> HTTPReplicaStream:
+        """POST /generate; returns the streaming handle. A 503 refusal
+        (bounded queue) raises QueueFull — backpressure, not ill health —
+        a connection failure ReplicaDead, any other non-200 ReplicaError."""
+        import http.client
+        import json
+
+        body = json.dumps(
+            {k: (np.asarray(v).tolist() if isinstance(v, np.ndarray)
+                 else v)
+             for k, v in payload.items() if v is not None}).encode()
+        try:
+            conn = self._connect()
+            with obs_tracing.span(
+                    "replica.open_stream", component="replica",
+                    trace_id=(str(payload.get("trace"))
+                              if payload.get("trace") else None),
+                    replica=self.replica_id, transport="http"):
+                conn.request("POST", "/generate", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+        except (ConnectionError, OSError, http.client.HTTPException) as e:
+            raise ReplicaDead(
+                f"replica {self.replica_id} unreachable at "
+                f"{self.host}:{self.port}: {type(e).__name__}: {e}")
+        if resp.status == 503:
+            raw = resp.read()
+            conn.close()
+            raise QueueFull(0, 0)
+        if resp.status != 200:
+            raw = resp.read()
+            conn.close()
+            raise ReplicaError(
+                f"replica {self.replica_id} refused dispatch "
+                f"(HTTP {resp.status}): {raw[:120]!r}")
+        if conn.sock is not None:
+            # token gaps are bounded by the router's gap timeout, not the
+            # connect timeout: a legitimately slow decode step must not
+            # read as a socket death
+            conn.sock.settimeout(self.stream_timeout_s)
+        return HTTPReplicaStream(self, conn, resp)
+
+    def close(self):
+        """Stateless between calls — nothing pooled to tear down (the
+        Router calls close(close_transports=True) uniformly)."""

@@ -455,9 +455,15 @@ class Router:
 
     def _pick(self, key, exclude) -> _Slot | None:
         with self._lock:
+            # role-aware placement (PR-19 disaggregation): PREFILL-role
+            # replicas never take generate dispatches — they serve the
+            # KV-page handoff plane. Decode and mixed replicas form the
+            # dispatch pool, and the existing prefix-affinity hashing
+            # therefore applies to the decode side of a split fleet.
             cands = [s for s in self._slots.values()
                      if s.circuit == "closed" and not s.draining
-                     and s.rid not in exclude]
+                     and s.rid not in exclude
+                     and s.probe.get("role", "mixed") != "prefill"]
             if not cands:
                 return None
             if key is not None:
@@ -785,6 +791,10 @@ class Router:
                         "dispatches": s.dispatches, "trips": s.trips,
                         "consecutive_failures": s.consecutive_failures,
                         "last_cause": s.last_cause,
+                        # the placement snapshot: which pool this replica
+                        # serves (prefill-role replicas never take
+                        # generate dispatches)
+                        "role": s.probe.get("role", "mixed"),
                         "probe": dict(s.probe),
                         "probe_err": s.probe_err,
                     } for s in self._slots.values()},

@@ -49,11 +49,14 @@ CHAOS_POINTS = [
 # matrix in test_router.py (transport points), the speculative-decode
 # degradation test in test_serving.py (serving.spec.verify_mismatch), and
 # the host-tier degradation tests in test_kv_hierarchy.py
-# (serving.kv.promote_fail) — these points fire on serving traffic, so
+# (serving.kv.promote_fail), and the disaggregated prefill/decode
+# exactly-once tests in test_disagg.py (serving.prefill.kill,
+# serving.handoff.drop) — these points fire on serving traffic, so
 # injecting them into a Model.fit run would test nothing
 SERVING_CHAOS_POINTS = [
-    "serving.dispatch.drop", "serving.kv.promote_fail",
-    "serving.lora.swap_fail", "serving.replica.kill",
+    "serving.dispatch.drop", "serving.handoff.drop",
+    "serving.kv.promote_fail", "serving.lora.swap_fail",
+    "serving.prefill.kill", "serving.replica.kill",
     "serving.replica.slow", "serving.spec.verify_mismatch",
     "serving.stream.cut",
 ]
@@ -107,7 +110,8 @@ def _make_step_factory(n_total):
 
 class TestFaultRegistry:
     def test_points_register_at_import(self):
-        import paddle_tpu.serving.replica  # noqa: F401 — serving.* points
+        import paddle_tpu.serving.disagg  # noqa: F401 — serving.* points
+        import paddle_tpu.serving.replica  # noqa: F401
         import paddle_tpu.serving.router  # noqa: F401
         assert (set(CHAOS_POINTS) | set(SERVING_CHAOS_POINTS)
                 <= set(faults.registered()))
@@ -627,13 +631,14 @@ class TestFitChaosMatrix:
         # serving points register at import of the serving modules; pull
         # them in so the pin is deterministic whether or not another test
         # module imported paddle_tpu.serving first
+        import paddle_tpu.serving.disagg  # noqa: F401
         import paddle_tpu.serving.replica  # noqa: F401
         import paddle_tpu.serving.router  # noqa: F401
         assert (sorted(CHAOS_POINTS + SERVING_CHAOS_POINTS)
                 == sorted(faults.registered())), (
             "a fault point was registered without being added to a chaos "
             "matrix (CHAOS_POINTS here, SERVING_CHAOS_POINTS -> "
-            "test_router.py)")
+            "test_router.py / test_disagg.py)")
 
     @pytest.mark.slow
     def test_every_point_recovers_with_fault_free_trajectory(self, tmp_path):

@@ -229,6 +229,79 @@ def _lora_gates(cur: dict):
             f"fail over adapter traffic with nothing lost")
 
 
+def _disagg_gates(cur: dict):
+    """Disaggregated prefill/decode self-consistency gates
+    (docs/serving.md): packed multi-prompt prefill must run >= 1.5x the
+    one-at-a-time chunked path with page bytes AND greedy streams
+    bit-equal, the split (decode engine + prefill workers) must beat the
+    mixed-role engine on decode p99 inter-token gap under the bursty
+    workload with a prefill worker killed mid-run, hold goodput within
+    5%, lose zero streams (every one bit-equal to the fault-free mixed
+    reference — exactly-once under worker death), and neither arm may
+    retrace after warmup."""
+    dis = (cur["detail"] or {}).get("disagg") or {}
+    if not dis:
+        # fail CLOSED: the arm goes missing exactly when the disagg probe
+        # crashed, which is when these gates matter most
+        raise SystemExit(
+            "DISAGG REGRESSION: the DISAGG_JSON arm is missing from the "
+            "bench report (probe failed?) — the prefill/decode gates "
+            "cannot run")
+    packed = dis["packed"]
+    mixed, split = dis["mixed"], dis["split"]
+    retr = dis["retraces"]
+    speedup = _snapshot_value(cur, "bench_disagg_packed_speedup",
+                              packed["speedup"])
+    split_p99 = _snapshot_value(cur, "bench_disagg_split_decode_p99_ms",
+                                split["decode_gap_p99_ms"])
+    mixed_p99 = _snapshot_value(cur, "bench_disagg_mixed_decode_p99_ms",
+                                mixed["decode_gap_p99_ms"])
+    print(f"disagg: packed prefill {speedup:.2f}x, decode p99 split "
+          f"{split_p99} vs mixed {mixed_p99} ms, goodput "
+          f"{split['goodput_tok_s']} vs {mixed['goodput_tok_s']} tok/s, "
+          f"kill fired={split['fired']} reclaims={split['reclaims']} "
+          f"lost={split['lost']} fill={split['fill']}")
+    if speedup < 1.5:
+        raise SystemExit(
+            f"DISAGG REGRESSION: packed prefill {speedup:.2f}x below the "
+            f"1.5x gate over one-at-a-time chunked prefill")
+    if not packed.get("pages_equal", False):
+        raise SystemExit(
+            "DISAGG REGRESSION: packed prefill page bytes diverged from "
+            "the sequential reference (must be bit-equal)")
+    if not packed.get("streams_equal", False):
+        raise SystemExit(
+            "DISAGG REGRESSION: packed prefill greedy streams diverged "
+            "from the sequential reference (must be bit-equal)")
+    if split_p99 is None or mixed_p99 is None or split_p99 > mixed_p99:
+        raise SystemExit(
+            f"DISAGG REGRESSION: split decode p99 {split_p99} ms must "
+            f"beat the mixed-role engine's {mixed_p99} ms on the same "
+            f"bursty workload")
+    if split["goodput_tok_s"] < 0.95 * mixed["goodput_tok_s"]:
+        raise SystemExit(
+            f"DISAGG REGRESSION: split goodput {split['goodput_tok_s']} "
+            f"below 0.95x the mixed arm's {mixed['goodput_tok_s']} tok/s")
+    if split.get("fired") != 1 or split.get("reclaims", 0) < 1:
+        raise SystemExit(
+            f"DISAGG REGRESSION: the prefill-worker kill did not "
+            f"exercise reclaim (fired={split.get('fired')}, "
+            f"reclaims={split.get('reclaims')})")
+    if split.get("lost", 1) != 0 or mixed.get("lost", 1) != 0:
+        raise SystemExit(
+            f"DISAGG REGRESSION: lost streams (split={split.get('lost')}, "
+            f"mixed={mixed.get('lost')}) — every request must complete")
+    if not split.get("streams_equal", False):
+        raise SystemExit(
+            "DISAGG REGRESSION: split streams under the worker kill "
+            "diverged from the fault-free mixed reference (exactly-once "
+            "broke)")
+    if retr.get("mixed", 1) != 0 or retr.get("split", 1) != 0:
+        raise SystemExit(
+            f"DISAGG REGRESSION: decode recompiled after warmup "
+            f"(mixed={retr.get('mixed')}, split={retr.get('split')})")
+
+
 def main():
     cur = run_bench()
     platform = cur["detail"]["platform"]
@@ -243,6 +316,7 @@ def main():
     _moe_gates(cur)
     _cache_gates(cur)
     _lora_gates(cur)
+    _disagg_gates(cur)
 
     if not os.path.exists(BASELINE):
         raise SystemExit(f"no {BASELINE}; record one with --update")
