@@ -3158,6 +3158,141 @@ def _observability_probe():
     return None
 
 
+TUNE_PROBE = r"""
+import json, os, time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                     LlamaPretrainingCriterion,
+                                     llama_tiny_config)
+from paddle_tpu.parallel import CompiledTrainStep
+from paddle_tpu.serving import ServingConfig, ServingEngine
+from paddle_tpu.tuning import (last_resolution, program_counters,
+                               tuning_counters)
+
+# driver env: FLAGS_program_cache_dir + FLAGS_tuning_cache_dir point at one
+# shared temp dir; FLAGS_autotune is "search" on the cold pass (time the
+# lattice, persist the winners) and "load" on the warm pass (consume them).
+out = {}
+paddle.seed(0)
+cfg = llama_tiny_config(num_hidden_layers=1)
+model = LlamaForCausalLM(cfg)
+crit = LlamaPretrainingCriterion(cfg)
+opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+step = CompiledTrainStep(model, lambda o, l: crit(o, l), opt)
+rng = np.random.RandomState(0)
+ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int64)
+lab = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int64)
+t0 = time.perf_counter()
+loss = float(step(ids, lab))
+out["train"] = dict(step.program_cache)  # {"status": hit|miss, "ms": ...}
+out["train"]["first_step_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+out["train"]["loss"] = loss
+
+# serving time-to-ready: engine build -> first greedy stream done. The warm
+# pass must LOAD the decode + prefill programs the cold pass compiled.
+paddle.seed(0)
+m2 = LlamaForCausalLM(llama_tiny_config())
+m2.eval()
+eng = ServingEngine(m2, ServingConfig(page_size=4, num_pages=64,
+                                      decode_batch=4, prefill_chunk=8,
+                                      max_seq_len=64))
+prompt = np.arange(1, 6, dtype=np.int32)
+t0 = time.perf_counter()
+outs = eng.generate([prompt], max_new_tokens=8)
+ready_ms = round((time.perf_counter() - t0) * 1e3, 1)
+eng.mark_warmup()
+pc = eng.stats()["program_cache"]
+out["serving"] = {
+    "ready_ms": ready_ms, "tokens": [int(t) for t in outs[0]],
+    "programs": {k: v["status"] for k, v in pc["programs"].items()}}
+
+# the tuning-cache half: rmsnorm through the shared resolver at a fixed
+# geometry. Cold pass: search tier times the row-block lattice and persists
+# the winner; warm pass must resolve it with provenance "tuned", 0 trials.
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.rmsnorm_kernel import rmsnorm
+
+x = jnp.ones((256, 128), jnp.float32)
+w = jnp.ones((128,), jnp.float32)
+rmsnorm(x, w)
+res = last_resolution("rmsnorm")
+out["autotune"] = {"provenance": res.provenance if res else None,
+                   "values": dict(res.values) if res else None,
+                   "trials": tuning_counters()["autotune_trials"]}
+out["program_counters"] = program_counters()
+print("TUNE_JSON " + json.dumps(out))
+"""
+
+
+def _tuning_probe():
+    """Warm-vs-cold AOT probe (TUNE_JSON): the SAME child — tiny train step
+    + serving engine + rmsnorm through the block resolver — runs twice
+    against one cache directory. The cold pass compiles every program,
+    persists it, and autotune-searches the rmsnorm lattice; the warm pass
+    must LOAD each program faster than its cold compile, reproduce the loss
+    and token stream bit-for-bit, and consume the persisted tuned blocks."""
+    import shutil
+    import tempfile
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="bench_tune_")
+    env["FLAGS_program_cache_dir"] = os.path.join(tmp, "programs")
+    env["FLAGS_tuning_cache_dir"] = os.path.join(tmp, "tuning")
+
+    def run_once(mode):
+        env["FLAGS_autotune"] = mode
+        res = subprocess.run([sys.executable, "-c", TUNE_PROBE],
+                             capture_output=True, text=True, timeout=600,
+                             env=env)
+        for line in res.stdout.splitlines():
+            if line.startswith("TUNE_JSON "):
+                return json.loads(line[len("TUNE_JSON "):])
+        print(f"tuning probe ({mode}) produced no result; stderr tail:\n"
+              f"{res.stderr[-800:]}", file=sys.stderr)
+        return None
+
+    try:
+        cold = run_once("search")
+        warm = run_once("load") if cold else None
+        if not cold or not warm:
+            return None
+        tc, tw = cold["train"], warm["train"]
+        return {
+            "cold": cold, "warm": warm,
+            "train_cold_compile_ms": tc["ms"],
+            "train_warm_load_ms": tw["ms"],
+            "warm_speedup": round(tc["ms"] / max(tw["ms"], 1e-9), 2),
+            "ready_cold_ms": cold["serving"]["ready_ms"],
+            "ready_warm_ms": warm["serving"]["ready_ms"],
+            "statuses_ok": (
+                tc["status"] == "miss" and tw["status"] == "hit"
+                and all(s == "miss"
+                        for s in cold["serving"]["programs"].values())
+                and bool(warm["serving"]["programs"])
+                and all(s == "hit"
+                        for s in warm["serving"]["programs"].values())),
+            "loss_bit_equal": tc["loss"] == tw["loss"],
+            "tokens_equal": (cold["serving"]["tokens"]
+                             == warm["serving"]["tokens"]),
+            "autotune_trials_cold": cold["autotune"]["trials"],
+            "tuned_consumed": (warm["autotune"]["provenance"] == "tuned"
+                               and warm["autotune"]["trials"] == 0),
+        }
+    except Exception as e:
+        print(f"tuning probe failed: {e!r}", file=sys.stderr)
+        return None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _pipeline_overhead():
     """Run the compiled-pipeline bubble probe on a virtual CPU mesh."""
     env = dict(os.environ)
@@ -3191,6 +3326,22 @@ def _has_full_logits(lowered_text, batch, seq, vocab):
     dims = (f"{batch}x{seq}x{vocab}", f"{batch * seq}x{vocab}")
     return any(f"tensor<{d}x{t}>" in lowered_text
                for d in dims for t in ("f32", "bf16", "f16"))
+
+
+def _timed_compile(lowered, tag):
+    """(compiled, compile_ms, compile_cache): compile through the
+    persistent AOT program cache when FLAGS_program_cache_dir is set —
+    compile_cache records provenance ("hit" deserialized, "miss" compiled
+    then persisted, "off" cache disabled) next to every compile_ms the
+    report carries."""
+    from paddle_tpu.tuning import process_cache
+
+    pc = process_cache()
+    if pc is not None:
+        compiled, status, ms = pc.load_or_compile(lowered, tag)
+        return compiled, ms, status
+    t0 = time.perf_counter()
+    return lowered.compile(), (time.perf_counter() - t0) * 1e3, "off"
 
 
 def _peak_bytes(compiled):
@@ -3276,9 +3427,8 @@ def _measure(cfg, batch, seq, iters_small, iters_big, remat=False,
     hlo_bytes = len(lowered_txt)
     # compile wall-time + peak-HBM accounting for the step program (the
     # trajectory tracks both alongside throughput)
-    t0 = time.perf_counter()
-    compiled = lowered.compile()
-    compile_ms = (time.perf_counter() - t0) * 1e3
+    compiled, compile_ms, compile_cache = _timed_compile(
+        lowered, f"bench_step:r{remat}_s{scan}_f{fused_head}")
     peak_hbm = _peak_bytes(compiled)
     # honest FLOPs: XLA's own cost model of the compiled step program —
     # what the MFU number derives from (hand-counted formulas drift as the
@@ -3333,7 +3483,8 @@ def _measure(cfg, batch, seq, iters_small, iters_big, remat=False,
             "n_params": int(n_params), "loss": loss_val,
             "flash_on_hot_path": flash_on_hot_path,
             "full_logits_live": full_logits_live,
-            "compile_ms": round(compile_ms, 1), "peak_hbm_bytes": peak_hbm,
+            "compile_ms": round(compile_ms, 1), "compile_cache": compile_cache,
+            "peak_hbm_bytes": peak_hbm,
             "hlo_bytes": hlo_bytes, "xla_flops_per_step": xla_flops}
 
 
@@ -3373,10 +3524,10 @@ def _scan_remat_probe(layers=8):
             jax.random.key(0), jnp.asarray(1e-4, jnp.float32),
             jnp.asarray(1, jnp.int32))
         hlo_bytes = len(lowered.as_text())
-        t0 = time.perf_counter()
-        compiled = lowered.compile()
-        compile_ms = (time.perf_counter() - t0) * 1e3
+        compiled, compile_ms, compile_cache = _timed_compile(
+            lowered, f"scan_remat:{layers}_{scan}_{remat}")
         return {"compile_ms": round(compile_ms, 1),
+                "compile_cache": compile_cache,
                 "peak_hbm_bytes": _peak_bytes(compiled),
                 "hlo_bytes": hlo_bytes}
 
@@ -3538,6 +3689,7 @@ def main():
     kv_cache = _cache_probe()
     lora = _lora_probe()
     observability = _observability_probe()
+    tuning_aot = _tuning_probe()
     # fixed-geometry 8-layer probe: compile-time O(1)-in-depth + remat-policy
     # memory lever, comparable across rounds on any platform. The measured
     # bench arms are attached UNCONDITIONALLY: a probe failure must not
@@ -3548,8 +3700,9 @@ def main():
     # every measured arm records its normalized throughput: the BENCH_*
     # trajectory needs a tokens_per_sec series per arm to compare PRs
     scan_remat["bench_arms"] = {
-        name: {k: m[k] for k in ("compile_ms", "peak_hbm_bytes",
-                                 "hlo_bytes", "step_s", "tokens_per_sec")}
+        name: {k: m.get(k) for k in ("compile_ms", "compile_cache",
+                                     "peak_hbm_bytes", "hlo_bytes",
+                                     "step_s", "tokens_per_sec")}
         for name, m in arms.items() if m is not None}
 
     # the canonical bench numbers land in the metrics registry and the
@@ -3647,6 +3800,26 @@ def main():
         reg.gauge("bench_disagg_prefill_fill",
                   "mean packed prefill frame fill on the split arm").set(
             float(disagg["split"]["fill"]))
+    if tuning_aot:
+        # AOT program-cache instrument (PR 20): cold compile vs warm load
+        # for the SAME train-step program, and whether the warm numbers
+        # stayed bit-equal — gated by bench_regression
+        reg.gauge("bench_aot_train_cold_compile_ms",
+                  "tiny train-step program: cold-process compile (cache "
+                  "miss, then persisted)").set(
+            float(tuning_aot["train_cold_compile_ms"]))
+        reg.gauge("bench_aot_train_warm_load_ms",
+                  "same program, next process: deserialize from the "
+                  "persistent cache (must beat the compile)").set(
+            float(tuning_aot["train_warm_load_ms"]))
+        reg.gauge("bench_aot_warm_speedup",
+                  "cold compile ms / warm load ms for the train-step "
+                  "program").set(float(tuning_aot["warm_speedup"]))
+        reg.gauge("bench_aot_bit_equal",
+                  "1 when the warm pass reproduced the cold loss and "
+                  "token stream bit-for-bit").set(
+            1.0 if (tuning_aot["loss_bit_equal"]
+                    and tuning_aot["tokens_equal"]) else 0.0)
     snap = reg.snapshot()
     metrics_snapshot = {
         name: snap[name]["samples"][0]["value"]
@@ -3668,7 +3841,11 @@ def main():
                      "bench_disagg_packed_speedup",
                      "bench_disagg_split_decode_p99_ms",
                      "bench_disagg_mixed_decode_p99_ms",
-                     "bench_disagg_prefill_fill")
+                     "bench_disagg_prefill_fill",
+                     "bench_aot_train_cold_compile_ms",
+                     "bench_aot_train_warm_load_ms",
+                     "bench_aot_warm_speedup",
+                     "bench_aot_bit_equal")
         if name in snap}
     metrics_snapshot["mfu_source"] = mfu_source
 
@@ -3689,6 +3866,7 @@ def main():
                    "flash_on_hot_path": main_m["flash_on_hot_path"],
                    "full_logits_live": main_m["full_logits_live"],
                    "compile_ms": main_m["compile_ms"],
+                   "compile_cache": main_m.get("compile_cache", "off"),
                    "peak_hbm_bytes": main_m["peak_hbm_bytes"],
                    "tokens_per_sec": round(main_m["tokens_per_sec"], 2),
                    "projection_7b": projection,
@@ -3706,7 +3884,8 @@ def main():
                    "disagg": disagg,
                    "kv_cache": kv_cache,
                    "lora": lora,
-                   "observability": observability},
+                   "observability": observability,
+                   "tuning_aot": tuning_aot},
     }))
 
 

@@ -12,7 +12,8 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable
 
-__all__ = ["define_flag", "set_flags", "get_flags", "flag", "flags_snapshot"]
+__all__ = ["define_flag", "set_flags", "get_flags", "flag", "flags_snapshot",
+           "flag_explicit"]
 
 _lock = threading.Lock()
 
@@ -24,6 +25,7 @@ class _Flag:
     default: Any
     help: str
     value: Any
+    explicit: bool = False
 
 
 _REGISTRY: dict[str, _Flag] = {}
@@ -44,10 +46,12 @@ def define_flag(name: str, default: Any, help: str = "", type: type | None = Non
         if name in _REGISTRY:
             return _REGISTRY[name]
         value = default
+        explicit = False
         env = os.environ.get(f"FLAGS_{name}")
         if env is not None:
             value = _coerce(typ, env)
-        f = _Flag(name, typ, default, help, value)
+            explicit = True
+        f = _Flag(name, typ, default, help, value, explicit)
         _REGISTRY[name] = f
         return f
 
@@ -61,6 +65,7 @@ def set_flags(flags: dict):
                 raise KeyError(f"unknown flag: {key}")
             f = _REGISTRY[name]
             f.value = _coerce(f.type, val)
+            f.explicit = True
 
 
 def get_flags(keys) -> dict:
@@ -78,6 +83,15 @@ def get_flags(keys) -> dict:
 def flag(name: str):
     """Fast read of a flag's current value."""
     return _REGISTRY[name].value
+
+
+def flag_explicit(name: str) -> bool:
+    """True when the flag was set by the user (env FLAGS_<name> at import or
+    a set_flags call) rather than sitting at its registered default. The
+    tuning resolver uses this to rank 'explicit FLAGS override' above a
+    tuning-cache hit for flags whose default is a real value (not a 0/auto
+    sentinel), e.g. serving_page_size."""
+    return _REGISTRY[name].explicit
 
 
 def flags_snapshot() -> dict:
@@ -384,3 +398,25 @@ define_flag("serving_adapter_slots", 16,
             "be RESIDENT (servable) at once per engine; registered "
             "adapters beyond this page host<->HBM on demand (LRU over "
             "refcount-0 slots, pinned slots never evicted)", type=int)
+define_flag("rmsnorm_block_rows", 0,
+            "Pallas fused-RMSNorm row-block override (0 = auto: 256, "
+            "clamped to the row count); resolved through the shared "
+            "tuning.blocks helper like every kernel block knob", type=int)
+define_flag("autotune", "off",
+            "block-size tuning mode of the shared kernel resolver "
+            "(tuning.blocks.resolve_blocks): 'off' = heuristics/flags "
+            "only (the zero-surprise default), 'load' = consult the JSON "
+            "tuning cache under FLAGS_tuning_cache_dir and fall back to "
+            "the heuristic on miss, 'search' = on miss ALSO time the "
+            "legal block lattice now, persist the winner, and use it "
+            "(docs/autotuning.md)")
+define_flag("tuning_cache_dir", "",
+            "directory of the JSON block-shape tuning cache consumed by "
+            "FLAGS_autotune=load|search; empty disables the cache tier "
+            "of the resolver")
+define_flag("program_cache_dir", "",
+            "directory of the persistent AOT compiled-program cache: "
+            "CompiledTrainStep and the serving engine's decode/verify/"
+            "prefill programs serialize compiled executables keyed by "
+            "(HLO fingerprint, platform, flags, jax version) so a cold "
+            "process LOADS instead of recompiling; empty disables")

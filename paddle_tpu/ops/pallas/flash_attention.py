@@ -189,19 +189,7 @@ def _validated_block(v, which, seq_len, prefix="flash_block"):
     return min(v, seq_len)
 
 
-def _pick_blocks(seq_len: int):
-    from paddle_tpu.core.flags import flag
-
-    bq_f, bk_f = flag("flash_block_q"), flag("flash_block_k")
-    if bq_f or bk_f:
-        if not (bq_f and bk_f):
-            import warnings
-
-            warnings.warn("set BOTH FLAGS_flash_block_q and "
-                          "FLAGS_flash_block_k; partial override ignored")
-        else:
-            return (_validated_block(bq_f, "q", seq_len),
-                    _validated_block(bk_f, "k", seq_len))
+def _heuristic_blocks(seq_len: int):
     # swept end-to-end on v5e at seq 2048 (round 3): (512, 1024) beats the
     # old (256, 512) default by ~7% MFU (0.725 -> 0.778)
     bq = next((b for b in (512, 256, 128) if seq_len % b == 0), seq_len)
@@ -209,22 +197,39 @@ def _pick_blocks(seq_len: int):
     return min(bq, seq_len), min(bk, seq_len)
 
 
+def _make_validate(seq_len: int, prefix: str):
+    def validate(values, geometry):
+        _validated_block(values["block_q"], "q", seq_len, prefix)
+        _validated_block(values["block_k"], "k", seq_len, prefix)
+
+    return validate
+
+
+def _pick_blocks(seq_len: int):
+    """Forward Q/K tiles through the shared resolver (FLAGS override >
+    tuning-cache hit > heuristic; the once-duplicated partial-override
+    warn branch now lives in tuning.blocks.resolve_blocks)."""
+    from paddle_tpu.tuning.blocks import resolve_blocks
+
+    res = resolve_blocks("flash_fwd", {"seq_len": seq_len},
+                         default=lambda g: _heuristic_blocks(seq_len),
+                         validate=_make_validate(seq_len, "flash_block"))
+    bq, bk = res.as_tuple()
+    return min(bq, seq_len), min(bk, seq_len)
+
+
 def _pick_blocks_bwd(seq_len: int):
     """Backward kernels tile independently of the forward (different
-    arithmetic intensity); FLAGS_flash_bwd_block_q/k override."""
-    from paddle_tpu.core.flags import flag
+    arithmetic intensity); FLAGS_flash_bwd_block_q/k override, tuned
+    'flash_bwd' entries next, forward picks as the default."""
+    from paddle_tpu.tuning.blocks import resolve_blocks
 
-    bq_f, bk_f = flag("flash_bwd_block_q"), flag("flash_bwd_block_k")
-    if bq_f or bk_f:
-        if not (bq_f and bk_f):
-            import warnings
-
-            warnings.warn("set BOTH FLAGS_flash_bwd_block_q and "
-                          "FLAGS_flash_bwd_block_k; partial override ignored")
-        else:
-            return (_validated_block(bq_f, "q", seq_len, "flash_bwd_block"),
-                    _validated_block(bk_f, "k", seq_len, "flash_bwd_block"))
-    return _pick_blocks(seq_len)
+    res = resolve_blocks("flash_bwd", {"seq_len": seq_len},
+                         default=lambda g: _pick_blocks(seq_len),
+                         validate=_make_validate(seq_len,
+                                                 "flash_bwd_block"))
+    bq, bk = res.as_tuple()
+    return min(bq, seq_len), min(bk, seq_len)
 
 
 def pallas_blocks_ok(seq_len: int):

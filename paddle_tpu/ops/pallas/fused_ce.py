@@ -547,11 +547,18 @@ def _resolve_cfg(n, vloc, ignore_index, label_smoothing, z_loss, chunk_tokens,
                  chunk_vocab, variant, mp_axis, has_w, has_bias):
     from paddle_tpu.core.flags import flag
 
-    if chunk_tokens == 0:
-        chunk_tokens = int(flag("fused_ce_chunk_tokens"))
-    if chunk_vocab == 0:
-        chunk_vocab = int(flag("fused_ce_chunk_vocab"))
-    ct, cv = resolve_chunks(n, vloc, chunk_tokens, chunk_vocab)
+    if chunk_tokens > 0 or chunk_vocab > 0:
+        # caller-supplied chunking wins outright (resolve_chunks fills a
+        # partially-specified pair from the heuristic)
+        ct, cv = resolve_chunks(n, vloc, chunk_tokens, chunk_vocab)
+    else:
+        from paddle_tpu.tuning.blocks import resolve_blocks
+
+        res = resolve_blocks(
+            "fused_ce", {"n_tokens": int(n), "vocab": int(vloc)},
+            default=lambda g: resolve_chunks(n, vloc))
+        ct = min(int(res.values["chunk_tokens"]), max(int(n), 1))
+        cv = min(int(res.values["chunk_vocab"]), max(int(vloc), 1))
     # fp8_policy='matmuls+head': the projection matmuls quantize (stats stay
     # fp32). The Pallas stats kernel is bf16/fp32-only, so fp8 resolves to
     # the token-chunked scan variant instead.

@@ -57,20 +57,24 @@ __all__ = ["grouped_matmul", "grouped_matmul_visit_counts",
            "expected_visit_counts", "pick_block_rows"]
 
 
-def pick_block_rows(n_rows: int, num_groups: int) -> int:
-    """Rows per grid block: 128 (MXU-friendly) when buckets are large enough
-    that per-group alignment padding stays small, stepping down for tiny
-    problems (the interpret-mode test shapes). FLAGS_moe_block_rows
-    overrides."""
-    from paddle_tpu.core.flags import flag
-
-    override = int(flag("moe_block_rows"))
-    if override > 0:
-        return override
+def _heuristic_block_rows(n_rows: int, num_groups: int) -> int:
     for bm in (128, 32, 8):
         if n_rows >= bm * max(num_groups, 1):
             return bm
     return 8
+
+
+def pick_block_rows(n_rows: int, num_groups: int) -> int:
+    """Rows per grid block, through the shared tuning resolver:
+    FLAGS_moe_block_rows override > tuned entry > heuristic (128 —
+    MXU-friendly — when buckets are large enough that per-group alignment
+    padding stays small, stepping down for tiny problems)."""
+    from paddle_tpu.tuning.blocks import resolve_blocks
+
+    res = resolve_blocks(
+        "grouped_matmul", {"n_rows": n_rows, "num_groups": num_groups},
+        default=lambda g: (_heuristic_block_rows(n_rows, num_groups),))
+    return res.values["block_rows"]
 
 
 def _resolve_backend(backend: str | None) -> str:
@@ -259,14 +263,17 @@ def grouped_matmul(x, w, gids, *, block_rows: int | None = None,
         # Surface the bad launch config here with its provenance — without
         # this check it dies inside Pallas grid setup with an opaque shape
         # error (the flash-attention block-validation idiom from PR-5).
-        from paddle_tpu.core.flags import flag
-
         if block_rows is not None:
             src = f"block_rows={block_rows} (caller-supplied)"
-        elif int(flag("moe_block_rows")) > 0:
-            src = f"block_rows={bm} (FLAGS_moe_block_rows override)"
         else:
-            src = f"block_rows={bm} (auto-picked)"
+            from paddle_tpu.tuning.blocks import last_resolution
+
+            res = last_resolution("grouped_matmul")
+            prov = res.provenance if res is not None else "default"
+            detail = {"flag": "FLAGS_moe_block_rows override",
+                      "tuned": "tuning-cache entry",
+                      "default": "auto-picked"}.get(prov, prov)
+            src = f"block_rows={bm} ({detail})"
         raise ValueError(
             f"grouped_matmul: rows {m} not a multiple of {src}; pad the "
             f"row count to a multiple of the block, or set "

@@ -73,16 +73,31 @@ def _rmsnorm_bwd_kernel(x_ref, w_ref, dy_ref, dx_ref, dw_ref, *, eps: float):
     dw_ref[0, :] = dw_ref[0, :] + jnp.sum(dy * x * rstd, axis=0)
 
 
+def _pick_rows(rows: int, d: int, block_rows: int) -> int:
+    """Row-block through the shared tuning resolver when the caller left
+    it at 0=auto: FLAGS_rmsnorm_block_rows > tuned entry > 256. Called
+    identically from _fwd and _bwd (the resolver is deterministic, so
+    both sides of the custom_vjp tile the same way)."""
+    if block_rows > 0:
+        return min(block_rows, rows)
+    from paddle_tpu.tuning.blocks import resolve_blocks
+
+    res = resolve_blocks("rmsnorm", {"rows": rows, "d": d},
+                         default=lambda g: (256,))
+    return min(int(res.values["block_rows"]), rows)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def rmsnorm(x, w, eps: float = 1e-6, block_rows: int = 256):
+def rmsnorm(x, w, eps: float = 1e-6, block_rows: int = 0):
     """y = x * rsqrt(mean(x^2, -1) + eps) * w over the trailing axis.
-    x: [rows, d] (callers flatten leading dims), w: [d]."""
+    x: [rows, d] (callers flatten leading dims), w: [d]. block_rows 0
+    resolves through tuning.blocks (flag > tuned > 256)."""
     return _fwd(x, w, eps, block_rows)[0]
 
 
 def _fwd(x, w, eps, block_rows):
     rows, d = x.shape
-    br = min(block_rows, rows)
+    br = _pick_rows(rows, d, block_rows)
     interpret = not _on_tpu()
     # x64 mode (paddle int64 parity, enabled at package import) makes index
     # maps emit i64 constants Mosaic can't legalize — same guard as flash
@@ -102,7 +117,7 @@ def _fwd(x, w, eps, block_rows):
 def _bwd(eps, block_rows, res, dy):
     x, w = res
     rows, d = x.shape
-    br = min(block_rows, rows)
+    br = _pick_rows(rows, d, block_rows)
     n_blocks = pl.cdiv(rows, br)
     interpret = not _on_tpu()
     with _x64_off():

@@ -291,6 +291,21 @@ class ServingEngine:
                 f"serving_max_seq_len={self.max_seq_len} exceeds the hoisted "
                 f"RoPE table (rope_max_position={rope_limit}); raise "
                 f"LlamaConfig.rope_max_position to serve longer contexts")
+        if self.config.page_size == 0:
+            # page size IS the paged kernel's K-block granularity, so it
+            # resolves through the same shared helper as every other
+            # Pallas block knob: explicit FLAGS_serving_page_size >
+            # tuned entry > the flag's default (16)
+            from paddle_tpu.tuning.blocks import resolve_blocks
+
+            heur = self.page_size
+            res = resolve_blocks(
+                "paged_attention",
+                {"num_kv_heads": self.num_kv_heads,
+                 "head_dim": self.head_dim,
+                 "max_seq_len": self.max_seq_len},
+                default=lambda g: (heur,))
+            self.page_size = int(res.values["page_size"])
         self.pages_per_seq = -(-self.max_seq_len // self.page_size)
 
         params = [p._value for p in model.parameters()]
@@ -391,6 +406,11 @@ class ServingEngine:
         self._decode_traces_at_warmup: int | None = None
         self._donate = (jax.devices()[0].platform == "tpu")
         from collections import deque
+        # AOT program cache (FLAGS_program_cache_dir): per-program
+        # {tag: {"status": hit|miss, "ms"}} — /stats surfaces it and
+        # mark_warmup snapshots it as the replica's time-to-ready record
+        self._program_cache_status: dict = {}
+        self._program_cache_at_warmup: dict | None = None
         self._decode_fn = None
         self._verify_fns: dict[int, object] = {}    # draft window K -> fn
         self._copy_fn = None
@@ -511,6 +531,18 @@ class ServingEngine:
                 rows[i] = self.adapters.slot_of(req.adapter)
         return rows
 
+    def _maybe_aot(self, jitted, tag: str):
+        """Route a compiled serving program through the persistent AOT
+        cache when FLAGS_program_cache_dir is set: a cold replica LOADS
+        the serialized decode/verify/prefill executables instead of
+        recompiling them — the seconds-fast scale-up path of ROADMAP
+        item 4. The plain jitted callable when the cache is off."""
+        from paddle_tpu.tuning.program_cache import AotProgram, process_cache
+
+        if process_cache() is None:
+            return jitted
+        return AotProgram(jitted, tag, self._program_cache_status)
+
     def _decode(self):
         if self._decode_fn is None:
             from paddle_tpu.parallel.train_step import functional_call
@@ -534,8 +566,8 @@ class ServingEngine:
                 # stay live between steps for nothing
                 return tokens, new_keys, cache
 
-            self._decode_fn = jax.jit(
-                fn, donate_argnums=(1,) if self._donate else ())
+            self._decode_fn = self._maybe_aot(jax.jit(
+                fn, donate_argnums=(1,) if self._donate else ()), "decode")
         return self._decode_fn
 
     def _prefill(self, chunk_pad: int, ctx_pad: int):
@@ -564,8 +596,9 @@ class ServingEngine:
                         training=False, method="decode_forward")
                 return cache
 
-            self._prefill_fns[key] = jax.jit(
-                fn, donate_argnums=(1,) if self._donate else ())
+            self._prefill_fns[key] = self._maybe_aot(
+                jax.jit(fn, donate_argnums=(1,) if self._donate else ()),
+                f"prefill:{chunk_pad}x{ctx_pad}")
         return self._prefill_fns[key]
 
     def _prefill_packed(self, frame: int):
@@ -593,8 +626,9 @@ class ServingEngine:
                         training=False, method="decode_forward")
                 return cache
 
-            self._prefill_packed_fns[frame] = jax.jit(
-                fn, donate_argnums=(1,) if self._donate else ())
+            self._prefill_packed_fns[frame] = self._maybe_aot(
+                jax.jit(fn, donate_argnums=(1,) if self._donate else ()),
+                f"prefill_packed:{frame}")
         return self._prefill_packed_fns[frame]
 
     def _plan_frames(self, seq, length_of):
@@ -734,8 +768,9 @@ class ServingEngine:
                     keyc, accepted[:, None, None], axis=1)[:, 0]
                 return tokens, accepted, new_keys, cache
 
-            self._verify_fns[k] = jax.jit(
-                fn, donate_argnums=(1,) if self._donate else ())
+            self._verify_fns[k] = self._maybe_aot(
+                jax.jit(fn, donate_argnums=(1,) if self._donate else ()),
+                f"verify:{k}")
         return self._verify_fns[k]
 
     def _copy_page(self):
@@ -823,15 +858,19 @@ class ServingEngine:
         # pool sufficiency is a CONSTRUCTOR invariant (>= pages_per_seq
         # usable pages), so any request within serving_max_seq_len fits
         # alone; the scheduler enforces the length limit
-        try:
-            rid = self.scheduler.submit(req)
-        except Exception:
-            if adapter:
-                self.adapters.release(adapter)
-            raise
-        self._keys[rid] = self._new_key()
-        if self.spec_k > 0:
-            self._proposer.add_request(rid, req.prompt)
+        # under _step_lock: a concurrent step() must never see the request
+        # as admittable before its RNG key (and draft table) exist — the
+        # submit-vs-step gap was a real KeyError under bursty feeders
+        with self._step_lock:
+            try:
+                rid = self.scheduler.submit(req)
+            except Exception:
+                if adapter:
+                    self.adapters.release(adapter)
+                raise
+            self._keys[rid] = self._new_key()
+            if self.spec_k > 0:
+                self._proposer.add_request(rid, req.prompt)
         return rid
 
     def _new_key(self) -> np.ndarray:
@@ -1635,8 +1674,12 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def mark_warmup(self):
         """Call after the first real decode step: any trace past this point
-        is a retrace bug (`decode_retraces_after_warmup`)."""
+        is a retrace bug (`decode_retraces_after_warmup`). Also snapshots
+        the AOT program-cache outcomes (which programs loaded vs compiled
+        on the way to ready) — the replica's time-to-ready record."""
         self._decode_traces_at_warmup = self._decode_traces
+        self._program_cache_at_warmup = {
+            tag: dict(st) for tag, st in self._program_cache_status.items()}
 
     @property
     def decode_retraces_after_warmup(self) -> int:
@@ -1707,6 +1750,21 @@ class ServingEngine:
             "handoff_pages": self._handoff_pages,
             "handoff_ms": round(self._handoff_ms_last, 3),
             "handoff_ms_total": round(self._handoff_ms_total, 3),
+            # PR-20 AOT program cache: per-program hit/miss + resolution ms
+            # (what a scaled-up replica's operator checks to confirm the
+            # cold start LOADED instead of compiling)
+            "program_cache": self.program_cache_stats(),
+        }
+
+    def program_cache_stats(self) -> dict:
+        from paddle_tpu.core.flags import flag
+
+        return {
+            "enabled": bool(str(flag("program_cache_dir"))),
+            "dir": str(flag("program_cache_dir")),
+            "programs": {tag: dict(st)
+                         for tag, st in self._program_cache_status.items()},
+            "at_warmup": self._program_cache_at_warmup,
         }
 
     @property

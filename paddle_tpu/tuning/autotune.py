@@ -1,0 +1,281 @@
+"""Block-size autotuner: search the legal block-shape lattice by timing
+real kernel invocations, persist winners in the JSON tuning cache.
+
+Search space (docs/autotuning.md#search-space): per kernel, the candidate
+lattice is the cross product of power-of-two tiles that satisfy the
+kernel's OWN legality constraints — divisibility where the grid tiles
+exactly (flash seq blocks, grouped-matmul row blocks) and a VMEM-fit
+bound derived from the kernel's BlockSpecs against the ~16 MB/core TPU
+VMEM budget (conservatively 3/4 of it, fp32 accumulation accounted).
+
+Each candidate is timed through the kernel's REAL public entry point
+under a `trial_blocks` override (so the exact dispatch path being tuned
+is the path being timed), jitted with the candidate index as a static
+argument so every candidate compiles its own program. Off-TPU the
+Pallas kernels run under `force_interpret()` — functionally exact, so a
+CPU search exercises the full search → persist → load → dispatch loop
+end-to-end; the TIMINGS only rank meaningfully on real hardware
+(ROADMAP item 5 keeps real-TPU sweeps as the remainder).
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["candidate_blocks", "make_runner", "autotune_kernel",
+           "autotune_report", "VMEM_BUDGET_BYTES"]
+
+# ~16 MiB VMEM per TensorCore (pallas guide); leave headroom for
+# double-buffered pipelines and scratch
+VMEM_BUDGET_BYTES = int(16 * 1024 * 1024 * 0.75)
+
+
+def _pow2_divisors(n: int, cands: tuple) -> list[int]:
+    out = [b for b in cands if b <= n and n % b == 0]
+    return out or [n]
+
+
+def candidate_blocks(kernel: str, geometry: dict,
+                     dtype: str = "") -> list[dict]:
+    """The legal lattice for one (kernel, geometry). Every entry is a full
+    values dict the resolver can consume."""
+    if kernel in ("flash_fwd", "flash_bwd"):
+        s = int(geometry["seq_len"])
+        d = int(geometry.get("head_dim", 128))  # fit-check upper bound
+        qs = _pow2_divisors(s, (128, 256, 512))
+        ks = _pow2_divisors(s, (128, 256, 512, 1024))
+        out = []
+        for bq in qs:
+            for bk in ks:
+                # fp32 working set: q tile + k/v tiles + the [BQ, BK]
+                # score tile + fp32 accumulator
+                fit = (bq * d + 2 * bk * d + bq * bk + bq * d) * 4
+                if fit <= VMEM_BUDGET_BYTES:
+                    out.append({"block_q": bq, "block_k": bk})
+        return out or [{"block_q": min(qs), "block_k": min(ks)}]
+    if kernel == "grouped_matmul":
+        m = int(geometry["n_rows"])
+        return [{"block_rows": b}
+                for b in _pow2_divisors(m, (8, 16, 32, 64, 128))]
+    if kernel == "fused_ce":
+        n = int(geometry["n_tokens"])
+        v = int(geometry["vocab"])
+        cts = sorted({max(1, min(n, t)) for t in (64, 256, 1024, 4096)})
+        cvs = sorted({max(1, min(v, c)) for c in (512, 2048, 8192)})
+        return [{"chunk_tokens": ct, "chunk_vocab": cv}
+                for ct in cts for cv in cvs
+                if ct * cv * 4 <= VMEM_BUDGET_BYTES]
+    if kernel == "rmsnorm":
+        rows = int(geometry["rows"])
+        brs = sorted({min(rows, b) for b in (8, 32, 128, 256, 512)})
+        return [{"block_rows": b} for b in brs]
+    if kernel == "paged_attention":
+        s = int(geometry["max_seq_len"])
+        return [{"page_size": p} for p in (8, 16, 32, 64, 128) if p <= s]
+    raise ValueError(f"no candidate lattice for kernel {kernel!r} "
+                     f"(known: {sorted(candidate_kernels())})")
+
+
+def candidate_kernels() -> list[str]:
+    from paddle_tpu.tuning.blocks import KERNELS
+
+    return list(KERNELS)
+
+
+# ---------------------------------------------------------------------------
+# runners: values -> one timed invocation of the real public entry point
+# ---------------------------------------------------------------------------
+
+
+def _interpret_ctx():
+    import jax
+
+    from paddle_tpu.ops.pallas.flash_attention import force_interpret
+
+    if jax.devices()[0].platform == "tpu":
+        from contextlib import nullcontext
+
+        return nullcontext()
+    return force_interpret()
+
+
+def make_runner(kernel: str, geometry: dict, dtype: str = ""):
+    """run(cand_index, values) executing the kernel once for that
+    candidate (jitted per candidate index so each candidate compiles its
+    own program) and blocking until the result is ready."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype) if dtype else jnp.float32
+    key = jax.random.PRNGKey(0)
+
+    if kernel in ("flash_fwd", "flash_bwd"):
+        from paddle_tpu.ops.pallas.flash_attention import \
+            flash_attention_bhsd
+
+        s = int(geometry["seq_len"])
+        d = int(geometry.get("head_dim", 64))
+        q = jax.random.normal(key, (1, 2, s, d), dt)
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def fwd(idx, q):
+            return flash_attention_bhsd(q, q, q, causal=True)
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def bwd(idx, q):
+            return jax.grad(
+                lambda qq: flash_attention_bhsd(qq, qq, qq,
+                                                causal=True).sum())(q)
+
+        fn = fwd if kernel == "flash_fwd" else bwd
+
+        def run(idx, values):
+            return fn(idx, q).block_until_ready()
+
+        return run
+
+    if kernel == "grouped_matmul":
+        from paddle_tpu.ops.pallas.grouped_matmul import grouped_matmul
+
+        m = int(geometry["n_rows"])
+        g = int(geometry["num_groups"])
+        x = jax.random.normal(key, (m, 64), dt)
+        w = jax.random.normal(key, (g, 64, 64), dt)
+        # group-contiguous layout: equal buckets, padded tail to group g
+        per = max(1, m // g)
+        gids = jnp.minimum(jnp.arange(m, dtype=jnp.int32) // per, g - 1)
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def fn(idx, x, w, gids):
+            return grouped_matmul(x, w, gids)
+
+        def run(idx, values):
+            return fn(idx, x, w, gids).block_until_ready()
+
+        return run
+
+    if kernel == "fused_ce":
+        from paddle_tpu.ops.pallas.fused_ce import \
+            fused_linear_cross_entropy_loss
+
+        n = int(geometry["n_tokens"])
+        v = int(geometry["vocab"])
+        x = jax.random.normal(key, (n, 64), dt)
+        w = jax.random.normal(key, (64, v), dt)
+        labels = jnp.arange(n, dtype=jnp.int32) % v
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def fn(idx, x, w, labels):
+            return fused_linear_cross_entropy_loss(x, w, labels)
+
+        def run(idx, values):
+            return fn(idx, x, w, labels).block_until_ready()
+
+        return run
+
+    if kernel == "rmsnorm":
+        from paddle_tpu.ops.pallas.rmsnorm_kernel import rmsnorm
+
+        rows = int(geometry["rows"])
+        d = int(geometry["d"])
+        x = jax.random.normal(key, (rows, d), dt)
+        w = jnp.ones((d,), dt)
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def fn(idx, x, w):
+            return rmsnorm(x, w)
+
+        def run(idx, values):
+            return fn(idx, x, w).block_until_ready()
+
+        return run
+
+    if kernel == "paged_attention":
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention
+
+        h = int(geometry["num_kv_heads"])
+        d = int(geometry["head_dim"])
+        s = int(geometry["max_seq_len"])
+
+        def run(idx, values):
+            ps = int(values["page_size"])
+            pages_per_seq = -(-s // ps)
+            num_pages = pages_per_seq + 2   # + null page + slack
+            q = jax.random.normal(key, (2, h, d), dt)
+            kp = jax.random.normal(key, (h, num_pages, ps, d), dt)
+            table = jnp.tile(
+                jnp.arange(1, pages_per_seq + 1,
+                           dtype=jnp.int32)[None], (2, 1))
+            lens = jnp.array([s, s // 2 + 1], jnp.int32)
+            return paged_attention(q, kp, kp, table,
+                                   lens).block_until_ready()
+
+        return run
+
+    raise ValueError(f"no runner for kernel {kernel!r}")
+
+
+# ---------------------------------------------------------------------------
+# the search
+# ---------------------------------------------------------------------------
+
+
+def autotune_kernel(kernel: str, geometry: dict, *, dtype: str = "",
+                    cache=None, trials: int = 2,
+                    candidates: list | None = None) -> dict | None:
+    """Time every legal candidate, persist the winner in `cache` (a
+    TuningCache), return {"values", "ms", "candidates"} or None when no
+    candidate survives. Each candidate runs once to compile/warm and
+    `trials` timed repetitions; min time ranks (robust to host jitter)."""
+    from paddle_tpu.observability import events as _events
+    from paddle_tpu.tuning import blocks
+
+    cands = candidates if candidates is not None \
+        else candidate_blocks(kernel, geometry, dtype)
+    run = make_runner(kernel, geometry, dtype)
+    best_values, best_ms = None, float("inf")
+    with _interpret_ctx():
+        for idx, values in enumerate(cands):
+            with blocks.trial_blocks(kernel, values):
+                try:
+                    run(idx, values)          # compile + warm
+                    ms = float("inf")
+                    for _ in range(max(1, trials)):
+                        t0 = time.perf_counter()
+                        run(idx, values)
+                        ms = min(ms, (time.perf_counter() - t0) * 1e3)
+                except Exception as e:
+                    _events.emit("tuning", "autotune_skip", severity="warn",
+                                 kernel=kernel, values=dict(values),
+                                 error=str(e)[:200])
+                    continue
+            blocks.bump_counter("autotune_trials")
+            if ms < best_ms:
+                best_values, best_ms = dict(values), ms
+    if best_values is None:
+        return None
+    key = blocks.cache_key(kernel, geometry, dtype)
+    if cache is not None:
+        cache.store(key, best_values, ms=best_ms, trials=len(cands))
+    _events.emit("tuning", "autotune", kernel=kernel, key=key,
+                 values=best_values, ms=round(best_ms, 4),
+                 candidates=len(cands))
+    return {"values": best_values, "ms": best_ms, "candidates": len(cands)}
+
+
+def autotune_report(geometries: dict, *, cache_dir: str,
+                    dtype: str = "", trials: int = 2) -> dict:
+    """Batch entry: {kernel: geometry} -> winners, persisted under
+    `cache_dir`. The offline-sweep face of the same machinery
+    FLAGS_autotune=search runs at dispatch time."""
+    from paddle_tpu.tuning.blocks import TuningCache, cache_key
+
+    cache = TuningCache.load(cache_dir)
+    out = {}
+    for kernel, geometry in geometries.items():
+        won = autotune_kernel(kernel, geometry, dtype=dtype, cache=cache,
+                              trials=trials)
+        if won is not None:
+            out[cache_key(kernel, geometry, dtype)] = won
+    return out

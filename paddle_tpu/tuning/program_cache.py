@@ -1,0 +1,267 @@
+"""Persistent AOT compiled-program cache.
+
+Reference analog: the reference's cached program lookup in its
+kernel-selection/compile layer; the JAX-native shape is
+`jax.experimental.serialize_executable` — a compiled executable
+round-trips through bytes, so a cold process can LOAD yesterday's
+compilation instead of redoing it.
+
+Key anatomy (docs/autotuning.md#cache-key-anatomy): sha256 over the
+lowered program's StableHLO text (the HLO fingerprint — geometry, dtypes
+and shardings are all in there), the platform, the jax AND jaxlib
+versions, the full flags snapshot, and a caller tag. ANY of those
+changing produces a different key, so geometry/dtype/flag/version drift
+can only MISS — it can never load a stale executable. The three
+cache-CONTROL flags (autotune / tuning_cache_dir / program_cache_dir) are
+the one exclusion: they pick where to cache, not what compiles, and the
+block shapes they influence are already in the HLO text. Corrupted or
+truncated entries fall back to a normal compile with a one-time warning;
+the cache is an accelerator, never a correctness dependency.
+
+Consumers: `CompiledTrainStep` (first real dispatch) and the serving
+engine's decode/verify/prefill programs (`serving/engine.py`), both
+gated on FLAGS_program_cache_dir being non-empty.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import warnings
+
+__all__ = ["ProgramCache", "PROGRAM_SCHEMA", "process_cache",
+           "AotProgram", "program_counters"]
+
+PROGRAM_SCHEMA = "paddle_tpu-prog1"
+
+# cache-CONTROL flags are excluded from the key fingerprint: they select
+# where/whether to cache, not what gets compiled. Anything they influence
+# (e.g. a tuned block shape picked under FLAGS_autotune=search) is already
+# baked into the lowered HLO text — so a warm process may load programs a
+# search-mode process compiled.
+_CONTROL_FLAGS = ("autotune", "tuning_cache_dir", "program_cache_dir")
+
+_lock = threading.Lock()
+_counters = {"hits": 0, "misses": 0, "corrupt": 0}
+_last_load_ms = 0.0
+_warned: set = set()
+
+
+def program_counters() -> dict:
+    with _lock:
+        out = dict(_counters)
+        out["last_load_ms"] = _last_load_ms
+    return out
+
+
+def _bump(name: str):
+    with _lock:
+        _counters[name] += 1
+    from paddle_tpu.tuning import ensure_metrics_collector
+
+    ensure_metrics_collector()
+
+
+def _warn_once(key, msg):
+    if key in _warned:
+        return
+    _warned.add(key)
+    warnings.warn(msg)
+
+
+class ProgramCache:
+    """One directory of serialized executables: `<key>.prog` files, each a
+    one-line JSON header + the serialize_executable payload."""
+
+    def __init__(self, cache_dir: str):
+        self.dir = str(cache_dir)
+
+    # -- key -----------------------------------------------------------------
+    def key_for(self, lowered, tag: str, extra: str = "", *,
+                _jax_version: str | None = None,
+                _flags_fp: str | None = None) -> str:
+        """The underscore kwargs exist so tests can prove version/flag
+        sensitivity without monkeypatching jax itself."""
+        import jax
+        import jaxlib
+
+        from paddle_tpu.core.flags import flags_snapshot
+
+        h = hashlib.sha256()
+        for part in (
+            lowered.as_text(),
+            jax.devices()[0].platform,
+            _jax_version or f"{jax.__version__}/{jaxlib.__version__}",
+            _flags_fp or json.dumps(
+                {k: v for k, v in flags_snapshot().items()
+                 if k not in _CONTROL_FLAGS},
+                sort_keys=True, default=str),
+            tag, extra,
+        ):
+            h.update(part.encode("utf-8"))
+            h.update(b"\x00")
+        return h.hexdigest()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}.prog")
+
+    # -- load / store --------------------------------------------------------
+    def load(self, key: str, lowered):
+        """Deserialize the cached executable for `key`, or None on miss.
+        A corrupted/truncated/alien entry warns ONCE and returns None —
+        the caller compiles as if the cache were cold."""
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                head = f.readline()
+            header = json.loads(head.decode("utf-8"))
+            if header.get("format") != PROGRAM_SCHEMA:
+                raise ValueError(f"format {header.get('format')!r} != "
+                                 f"{PROGRAM_SCHEMA!r}")
+            with open(path, "rb") as f:
+                f.readline()
+                payload = f.read()
+            if len(payload) != int(header["payload_bytes"]):
+                raise ValueError(
+                    f"truncated payload: {len(payload)} of "
+                    f"{header['payload_bytes']} bytes")
+            import jax.tree_util as jtu
+            from jax.experimental.serialize_executable import \
+                deserialize_and_load
+
+            return deserialize_and_load(
+                payload, jtu.tree_structure(lowered.args_info),
+                jtu.tree_structure(lowered.out_info))
+        except Exception as e:
+            _bump("corrupt")
+            _warn_once(f"prog-corrupt:{path}",
+                       f"{path!r}: unusable program-cache entry ({e}); "
+                       f"falling back to a fresh compile — delete the file "
+                       f"to silence this")
+            from paddle_tpu.observability import events as _events
+
+            _events.emit("tuning", "program_corrupt", severity="warn",
+                         path=path, error=str(e)[:200])
+            return None
+
+    def store(self, key: str, compiled, tag: str):
+        from jax.experimental.serialize_executable import serialize
+
+        payload, _, _ = serialize(compiled)
+        import jax
+
+        header = json.dumps({
+            "format": PROGRAM_SCHEMA, "tag": tag,
+            "jax": jax.__version__,
+            "platform": jax.devices()[0].platform,
+            "payload_bytes": len(payload),
+        }).encode("utf-8")
+        os.makedirs(self.dir, exist_ok=True)
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(header + b"\n" + payload)
+        os.replace(tmp, path)
+
+    def load_or_compile(self, lowered, tag: str, extra: str = ""):
+        """(executable, status, ms): status 'hit' loaded the serialized
+        program (ms = deserialize time), 'miss' compiled and stored it
+        (ms = compile time). Numerics are bit-equal either way — a hit
+        executes the same compiled bytes a fresh compile produces."""
+        global _last_load_ms
+        from paddle_tpu.observability import events as _events
+
+        key = self.key_for(lowered, tag, extra)
+        t0 = time.perf_counter()
+        compiled = self.load(key, lowered)
+        if compiled is not None:
+            ms = (time.perf_counter() - t0) * 1e3
+            with _lock:
+                _last_load_ms = ms
+            _bump("hits")
+            _events.emit("tuning", "program_load", tag=tag, status="hit",
+                         key=key[:16], ms=round(ms, 3))
+            return compiled, "hit", ms
+        compiled = lowered.compile()
+        ms = (time.perf_counter() - t0) * 1e3
+        _bump("misses")
+        try:
+            self.store(key, compiled, tag)
+        except Exception as e:  # un-serializable program: cache skips it
+            _warn_once(f"prog-store:{tag}",
+                       f"program cache could not serialize {tag!r} ({e}); "
+                       f"this program will recompile every cold start")
+        _events.emit("tuning", "program_load", tag=tag, status="miss",
+                     key=key[:16], ms=round(ms, 3))
+        return compiled, "miss", ms
+
+
+_proc_memo: dict[str, ProgramCache] = {}
+
+
+def process_cache() -> ProgramCache | None:
+    """The flag-gated process cache: a ProgramCache when
+    FLAGS_program_cache_dir is set, else None (the default — no behavior
+    change, no disk writes)."""
+    from paddle_tpu.core.flags import flag
+
+    d = str(flag("program_cache_dir"))
+    if not d:
+        return None
+    cache = _proc_memo.get(d)
+    if cache is None:
+        cache = _proc_memo[d] = ProgramCache(d)
+    return cache
+
+
+class AotProgram:
+    """Wrap a jitted callable with first-call AOT caching: the first
+    dispatch lowers (cheap trace), loads-or-compiles through the
+    persistent cache, and every call runs the AOT executable. Any
+    signature change or AOT dispatch error falls back to the plain jitted
+    path permanently — the wrapper may only ever be faster, never a new
+    failure mode."""
+
+    def __init__(self, jitted, tag: str, status_sink: dict | None = None):
+        self._jitted = jitted
+        self._tag = tag
+        self._compiled = None
+        self._fallback = False
+        # tag -> {"status", "ms"}; the engine surfaces this in /stats
+        self._sink = status_sink if status_sink is not None else {}
+
+    @property
+    def status(self) -> dict:
+        return dict(self._sink.get(self._tag, {}))
+
+    def __call__(self, *args):
+        if not self._fallback:
+            if self._compiled is None:
+                cache = process_cache()
+                if cache is None:
+                    self._fallback = True
+                    return self._jitted(*args)
+                try:
+                    lowered = self._jitted.lower(*args)
+                    compiled, status, ms = cache.load_or_compile(
+                        lowered, self._tag)
+                    self._compiled = compiled
+                    self._sink[self._tag] = {"status": status,
+                                             "ms": round(ms, 3)}
+                except Exception as e:
+                    _warn_once(f"aot:{self._tag}",
+                               f"AOT program cache disabled for "
+                               f"{self._tag!r} ({e}); using plain jit")
+                    self._fallback = True
+                    return self._jitted(*args)
+            try:
+                return self._compiled(*args)
+            except TypeError:
+                # signature drift (new shapes/dtypes): the plain jitted
+                # path retraces transparently; stop AOT for this program
+                self._fallback = True
+        return self._jitted(*args)

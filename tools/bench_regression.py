@@ -302,6 +302,62 @@ def _disagg_gates(cur: dict):
             f"(mixed={retr.get('mixed')}, split={retr.get('split')})")
 
 
+def _tuning_gates(cur: dict):
+    """AOT program-cache self-consistency gates (docs/autotuning.md): the
+    warm pass must LOAD every program the cold pass compiled (train step
+    hit, every serving program hit), the warm load must beat the cold
+    compile with time-to-ready dropping too, numerics must be bit-equal
+    (same loss, same token stream — a hit executes the same compiled
+    bytes), and the warm pass must consume the tuned block entry the cold
+    pass's autotune search persisted without re-searching."""
+    tune = (cur["detail"] or {}).get("tuning_aot") or {}
+    if not tune:
+        # fail CLOSED: the arm goes missing exactly when the tuning probe
+        # crashed, which is when these gates matter most
+        raise SystemExit(
+            "TUNING REGRESSION: the TUNE_JSON arm is missing from the "
+            "bench report (probe failed?) — the AOT cache gates cannot run")
+    cold_ms = _snapshot_value(cur, "bench_aot_train_cold_compile_ms",
+                              tune["train_cold_compile_ms"])
+    warm_ms = _snapshot_value(cur, "bench_aot_train_warm_load_ms",
+                              tune["train_warm_load_ms"])
+    print(f"tuning/aot: train compile {cold_ms:.0f} -> load {warm_ms:.0f} "
+          f"ms ({tune['warm_speedup']}x), ready {tune['ready_cold_ms']} -> "
+          f"{tune['ready_warm_ms']} ms, bit_equal loss="
+          f"{tune['loss_bit_equal']} tokens={tune['tokens_equal']}, "
+          f"trials cold={tune['autotune_trials_cold']}, tuned_consumed="
+          f"{tune['tuned_consumed']}")
+    if not tune.get("statuses_ok", False):
+        raise SystemExit(
+            "TUNING REGRESSION: the warm pass did not LOAD every program "
+            "the cold pass compiled (hit/miss statuses wrong — cold must "
+            "be all miss, warm all hit)")
+    if warm_ms >= cold_ms:
+        raise SystemExit(
+            f"TUNING REGRESSION: warm program load {warm_ms:.0f} ms not "
+            f"below the cold compile {cold_ms:.0f} ms — the persistent "
+            f"cache stopped paying for itself")
+    if tune["ready_warm_ms"] >= tune["ready_cold_ms"]:
+        raise SystemExit(
+            f"TUNING REGRESSION: warm-cache time-to-ready "
+            f"{tune['ready_warm_ms']} ms not below the cold-compile "
+            f"{tune['ready_cold_ms']} ms")
+    if not (tune.get("loss_bit_equal", False)
+            and tune.get("tokens_equal", False)):
+        raise SystemExit(
+            "TUNING REGRESSION: warm-cache numerics diverged from the "
+            "cold compile (loss and token stream must be bit-equal)")
+    if tune.get("autotune_trials_cold", 0) < 1:
+        raise SystemExit(
+            "TUNING REGRESSION: the cold pass timed no autotune "
+            "candidates — the search tier did not run")
+    if not tune.get("tuned_consumed", False):
+        raise SystemExit(
+            "TUNING REGRESSION: the warm pass did not consume the tuned "
+            "block entry the cold search persisted (provenance must be "
+            "'tuned' with zero new trials)")
+
+
 def main():
     cur = run_bench()
     platform = cur["detail"]["platform"]
@@ -317,6 +373,7 @@ def main():
     _cache_gates(cur)
     _lora_gates(cur)
     _disagg_gates(cur)
+    _tuning_gates(cur)
 
     if not os.path.exists(BASELINE):
         raise SystemExit(f"no {BASELINE}; record one with --update")

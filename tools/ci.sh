@@ -11,10 +11,14 @@
 # Stages (full):
 #   1. import hygiene: importing paddle_tpu must NOT initialize the XLA
 #      backend (jax.distributed would break)
+#   1c. tuning plane: block-size resolver precedence/provenance, the JSON
+#      tuning cache, and the persistent AOT program cache (key safety,
+#      corrupt-entry fallback, warm-load bit-equality)
 #   2. unit suite on the virtual 8-device CPU mesh
 #   3. driver multichip gate: 8-device dryrun of the full sharded train step
 #   4. bench smoke (CPU config) + regression check against the recorded
-#      baseline (tools/bench_regression.py)
+#      baseline (tools/bench_regression.py), incl. the warm-vs-cold
+#      TUNE_JSON gates
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -33,17 +37,23 @@ echo "== [1b] observability plane (not slow) =="
 # registry exposition, trace-id propagation, step telemetry, event journal
 python -m pytest tests/test_observability.py -q -m "not slow"
 
+echo "== [1c] tuning plane (not slow) =="
+# the autotuner + AOT program cache feed every compile the later stages
+# time: resolver precedence, cache-key safety and corrupt-entry fallback
+# are verified before any stage that could silently eat a stale program
+python -m pytest tests/test_tuning.py -q -m "not slow"
+
 if [ "$TIER" = "quick" ]; then
   echo "== [2] unit suite (quick tier) =="
-  # [1b] already ran the observability module; don't pay its two XLA
-  # compiles twice per CI run
-  python -m pytest tests/ -q -m "not slow" --ignore=tests/test_observability.py
+  # [1b]/[1c] already ran the observability + tuning modules; don't pay
+  # their XLA compiles twice per CI run
+  python -m pytest tests/ -q -m "not slow" --ignore=tests/test_observability.py --ignore=tests/test_tuning.py
   echo "CI QUICK TIER PASSED"
   exit 0
 fi
 
 echo "== [2] unit suite (full) =="
-python -m pytest tests/ -q --ignore=tests/test_observability.py
+python -m pytest tests/ -q --ignore=tests/test_observability.py --ignore=tests/test_tuning.py
 
 echo "== [3] multichip gate =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
